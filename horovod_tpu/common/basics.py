@@ -1,0 +1,282 @@
+"""Process topology and lifecycle — the TPU-native analog of
+``HorovodBasics`` (reference ``horovod/common/basics.py:22-258``).
+
+Topology model
+--------------
+Horovod runs one process per accelerator; ``rank``/``size`` count processes.
+A TPU pod slice is driven by one process per **host**, each owning
+``local_device_count`` chips, and the training step is one SPMD program over
+all chips. The Horovod notions map as:
+
+===============  ======================================  =====================
+Horovod          horovod_tpu                             reference anchor
+===============  ======================================  =====================
+``size``         total chip slots ``jax.device_count()``  ``basics.py:142``
+``local_size``   chips on this host                       ``basics.py:166``
+``rank``         global index of this host's first chip   ``basics.py:130``
+``local_rank``   0 (the process drives slot
+                 ``rank()..rank()+local_size()``)         ``basics.py:154``
+``cross_size``   number of hosts                          ``basics.py:190``
+``cross_rank``   host index                               ``basics.py:178``
+===============  ======================================  =====================
+
+``rank() == 0`` is true exactly on the coordinator host, so the ubiquitous
+``if hvd.rank() == 0:`` idiom keeps working. Per-chip ranks exist *inside*
+the compiled program (``jax.lax.axis_index``); see
+``horovod_tpu/ops/collective_ops.py``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+
+_lock = threading.Lock()
+_initialized = False
+_started_jax_distributed = False
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def init(comm=None, process_sets=None):
+    """Initialize horovod_tpu.
+
+    Reference call stack: ``hvd.init()`` → ``InitializeHorovodOnce``
+    (``operations.cc:649``) spawns the background engine thread and runs the
+    controller rendezvous. TPU-natively:
+
+    1. If launched multi-host (env from the ``hvtrun`` launcher or a
+       pre-configured ``jax.distributed`` cluster), join the cluster via
+       ``jax.distributed.initialize`` — this is the DCN control-plane
+       rendezvous, the analog of Gloo's HTTP-store rendezvous
+       (``gloo/gloo_context.cc``).
+    2. Build the default global device mesh (ICI data plane).
+    3. Start the eager-path C++ engine lazily on first eager collective.
+
+    ``comm`` is accepted for API parity (the reference takes an MPI comm or
+    rank lists); passing a non-default value raises, since process placement
+    on TPU is owned by the launcher.
+    """
+    global _initialized
+    if comm not in (None, 0):
+        raise ValueError(
+            "horovod_tpu.init(comm=...) is not supported: process "
+            "placement on TPU is owned by the launcher (hvtrun)")
+    with _lock:
+        if _initialized:
+            return
+        jax = _jax()
+
+        coordinator = os.environ.get("HVT_COORDINATOR_ADDR")
+        nprocs = os.environ.get("HVT_NUM_PROCESSES")
+        procid = os.environ.get("HVT_PROCESS_ID")
+        if coordinator and nprocs and int(nprocs) > 1:
+            global _started_jax_distributed
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=int(nprocs),
+                process_id=int(procid) if procid is not None else None,
+            )
+            _started_jax_distributed = True
+
+        # Materialize the device list once; this is the global communicator.
+        from horovod_tpu.parallel import mesh as _mesh
+
+        _mesh.build_global_mesh()
+
+        from horovod_tpu.common import process_sets as _ps
+
+        _ps._init_global_process_set()
+        if process_sets:
+            for ps in process_sets:
+                _ps.add_process_set(ps)
+
+        _initialized = True
+
+
+def shutdown():
+    """Tear down the engine and (if we started it) the jax.distributed client.
+
+    Reference: ``horovod_shutdown`` (``operations.cc:728``) joins the
+    background thread and finalizes pending tensors with SHUT_DOWN_ERROR.
+    """
+    global _initialized, _started_jax_distributed
+    with _lock:
+        if not _initialized:
+            return
+        from horovod_tpu.engine import api as _engine_api
+
+        _engine_api.shutdown_if_running()
+        if _started_jax_distributed:
+            try:
+                _jax().distributed.shutdown()
+            except Exception:
+                pass
+            _started_jax_distributed = False
+        from horovod_tpu.parallel import mesh as _mesh
+
+        _mesh._reset()
+        from horovod_tpu.common import process_sets as _ps
+
+        _ps._reset()
+        _initialized = False
+
+
+atexit.register(shutdown)
+
+
+def is_initialized():
+    """Parity with ``basics.py:212`` (is_initialized)."""
+    return _initialized
+
+
+def _ensure_init():
+    if not _initialized:
+        raise ValueError(
+            "horovod_tpu has not been initialized; run hvt.init() first.")
+
+
+def size() -> int:
+    """Total number of chip slots (Horovod world size)."""
+    _ensure_init()
+    return _jax().device_count()
+
+
+def local_size() -> int:
+    """Chips driven by this process (one host)."""
+    _ensure_init()
+    return _jax().local_device_count()
+
+
+def rank() -> int:
+    """Global slot index of this process's first chip.
+
+    ``rank() == 0`` exactly on the coordinator process. Per-chip ranks live
+    inside the compiled program (``lax.axis_index``).
+    """
+    _ensure_init()
+    jax = _jax()
+    local = jax.local_devices()
+    if not local:
+        return 0
+    return min(d.id for d in local)
+
+
+def local_rank() -> int:
+    """Index of this process among processes on the same physical host.
+
+    One process drives all chips of a host, so this is 0 unless several
+    horovod_tpu processes share a host (supported for CPU testing, where the
+    launcher sets HVT_LOCAL_PROCESS_ID)."""
+    _ensure_init()
+    return int(os.environ.get("HVT_LOCAL_PROCESS_ID", "0"))
+
+
+def cross_rank() -> int:
+    """Host index (reference CROSS communicator rank, ``common.h:115-119``)."""
+    _ensure_init()
+    return _jax().process_index()
+
+
+def cross_size() -> int:
+    """Number of hosts."""
+    _ensure_init()
+    return _jax().process_count()
+
+
+def process_rank() -> int:
+    """This Python process's index (== cross_rank on TPU pods)."""
+    _ensure_init()
+    return _jax().process_index()
+
+
+def process_size() -> int:
+    """Number of Python processes."""
+    _ensure_init()
+    return _jax().process_count()
+
+
+def is_homogeneous() -> bool:
+    """True when every host drives the same number of chips
+    (reference ``mpi_controller.cc:51-63`` homogeneity detection)."""
+    _ensure_init()
+    jax = _jax()
+    counts = {}
+    for d in jax.devices():
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return len(set(counts.values())) <= 1
+
+
+# --- build-info surface (reference basics.py:216-258) -----------------------
+# These exist so reference scripts that branch on them keep working; the TPU
+# build has exactly one data plane (XLA over ICI/DCN) plus the C++ TCP engine
+# for eager/CPU collectives (the Gloo-equivalent).
+
+def nccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    return False
+
+
+def gloo_built() -> bool:
+    """The C++ TCP ring engine is the Gloo equivalent; True when its shared
+    library is available."""
+    from horovod_tpu.engine import api as _engine_api
+
+    return _engine_api.library_available()
+
+
+def gloo_enabled() -> bool:
+    return gloo_built()
+
+
+def xla_built() -> bool:
+    """TPU-native addition: the XLA/ICI data plane is always built in."""
+    return True
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False):
+    """Begin recording a Chrome-trace timeline (reference
+    ``operations.cc:738``, ``basics.py:75``)."""
+    _ensure_init()
+    from horovod_tpu.utils import timeline as _tl
+
+    _tl.start(file_path, mark_cycles=mark_cycles)
+
+
+def stop_timeline():
+    _ensure_init()
+    from horovod_tpu.utils import timeline as _tl
+
+    _tl.stop()

@@ -1,0 +1,39 @@
+"""Exceptions. Parity with reference ``horovod/common/exceptions.py``."""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective fails mid-flight
+    (reference ``horovod/common/exceptions.py:18``).
+
+    On TPU this surfaces when an XLA collective aborts (peer host lost, ICI
+    link error) or when the C++ engine delivers an ERROR response for a
+    tensor (cross-rank dtype/shape/op mismatch). Elastic training catches it
+    and restores from the last committed state.
+    """
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised at a commit point when the elastic driver has notified this
+    worker of a host-set change (reference ``horovod/common/exceptions.py:26``).
+
+    ``skip_sync`` mirrors the reference: when True, the worker that observed
+    the update does not need a state re-sync (its state is current).
+    """
+
+    def __init__(self, skip_sync=False):
+        super().__init__("Hosts updated; re-initialization required")
+        self.skip_sync = skip_sync
+
+
+class HorovodVersionMismatchError(ImportError):
+    """Extension was built against a different core version."""
+
+
+class TensorShapeMismatchError(ValueError):
+    """Cross-rank shape mismatch detected by the controller consistency
+    checks (reference ``controller.cc:481-706`` turns these into per-tensor
+    ERROR responses instead of hangs)."""
+
+
+class TensorDtypeMismatchError(ValueError):
+    """Cross-rank dtype mismatch (see :class:`TensorShapeMismatchError`)."""

@@ -1,0 +1,116 @@
+"""Process sets — named subsets of slots that collectives can run over.
+
+Parity with ``horovod.ProcessSet`` (present in the reference lineage;
+the surveyed version routes everything through the GLOBAL communicator).
+TPU-natively a process set is a subset of chip slots:
+
+- eager path: a sub-mesh over the set's devices / engine sub-communicator;
+- traced path: ``axis_index_groups`` on the XLA collective — XLA's native
+  replica-group mechanism replaces the reference's device-map-keyed
+  communicator cache (``nccl_operations.cc:61-94``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_process_sets = {}
+_next_id = 0
+
+
+class ProcessSet:
+    def __init__(self, ranks=None):
+        """``ranks=None`` means all slots (the global set)."""
+        self.ranks = sorted(ranks) if ranks is not None else None
+        self.process_set_id = None  # assigned by add_process_set / init
+
+    def included(self) -> bool:
+        """Is this process's slot range included in the set?"""
+        if self.ranks is None:
+            return True
+        from horovod_tpu.common import basics
+
+        lo = basics.rank()
+        hi = lo + basics.local_size()
+        return any(lo <= r < hi for r in self.ranks)
+
+    def size(self) -> int:
+        from horovod_tpu.common import basics
+
+        return basics.size() if self.ranks is None else len(self.ranks)
+
+    def rank_in_set(self, global_rank: int) -> int:
+        if self.ranks is None:
+            return global_rank
+        return self.ranks.index(global_rank)
+
+    def axis_index_groups(self, world_size: int):
+        """Replica groups for XLA collectives: the set plus the complement
+        (XLA requires groups to partition the axis). Shards outside the set
+        reduce among themselves; callers outside the set should ignore the
+        result, matching the reference's 'not included' semantics."""
+        if self.ranks is None or len(self.ranks) == world_size:
+            return None
+        rest = [r for r in range(world_size) if r not in set(self.ranks)]
+        groups = [list(self.ranks)]
+        if rest:
+            groups.append(rest)
+        return groups
+
+    def __repr__(self):
+        r = "global" if self.ranks is None else self.ranks
+        return f"ProcessSet(id={self.process_set_id}, ranks={r})"
+
+
+global_process_set = ProcessSet(None)
+
+
+def _init_global_process_set():
+    global _next_id
+    with _lock:
+        global_process_set.process_set_id = 0
+        _process_sets[0] = global_process_set
+        _next_id = 1
+
+
+def _reset():
+    global _next_id
+    with _lock:
+        _process_sets.clear()
+        _next_id = 0
+        global_process_set.process_set_id = None
+
+
+def add_process_set(process_set) -> ProcessSet:
+    """Register a process set (list of ranks or ProcessSet). Returns it with
+    an id assigned."""
+    global _next_id
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(list(process_set))
+    with _lock:
+        for ps in _process_sets.values():
+            if ps.ranks == process_set.ranks:
+                return ps
+        process_set.process_set_id = _next_id
+        _process_sets[_next_id] = process_set
+        _next_id += 1
+    return process_set
+
+
+def remove_process_set(process_set: ProcessSet):
+    with _lock:
+        if process_set.process_set_id in _process_sets \
+                and process_set.process_set_id != 0:
+            del _process_sets[process_set.process_set_id]
+            process_set.process_set_id = None
+
+
+def process_set_included_ranks(process_set_id: int):
+    with _lock:
+        ps = _process_sets[process_set_id]
+    if ps.ranks is None:
+        from horovod_tpu.common import basics
+
+        return list(range(basics.size()))
+    return list(ps.ranks)

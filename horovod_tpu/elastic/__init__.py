@@ -1,0 +1,19 @@
+"""Elastic (fault-tolerant) training.
+
+Parity: ``horovod/common/elastic.py`` (State machine, run_fn wrapper) +
+framework states (``horovod/torch/elastic/state.py``,
+``horovod/tensorflow/elastic.py``). The driver/discovery side lives in
+``horovod_tpu/runner/elastic``.
+
+TPU mapping of the recovery loop (reference ``common/elastic.py:147``):
+a TPU pre-emption notice / lost host surfaces as
+:class:`HorovodInternalError` (collective abort) or
+:class:`HostsUpdatedInterrupt` (driver notification at a commit point);
+the wrapper restores the last committed state, re-initializes the runtime
+(new rendezvous → new mesh shape), and re-enters the train function.
+"""
+
+from horovod_tpu.elastic.state import State, ObjectState, JaxState
+from horovod_tpu.elastic.run import run
+
+__all__ = ["State", "ObjectState", "JaxState", "run"]

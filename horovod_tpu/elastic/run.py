@@ -1,0 +1,54 @@
+"""The elastic run wrapper (reference ``horovod/common/elastic.py:147``)."""
+
+from __future__ import annotations
+
+import functools
+
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+
+
+def run(func):
+    """Decorator: ``@hvt.elastic.run`` around ``train(state, ...)``.
+
+    Loop semantics match the reference run_fn (``common/elastic.py:147``):
+
+    - HorovodInternalError (collective failed — host lost mid-step):
+      restore() to the last commit, then re-initialize and retry.
+    - HostsUpdatedInterrupt (driver notified a host change at commit()):
+      keep current state, re-initialize and retry (sync unless skip_sync).
+    """
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        from horovod_tpu.runner.elastic import notification
+
+        notification.init_worker_notification(state)
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _reset()
+                state.on_reset()
+            try:
+                if not skip_sync:
+                    state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                skip_sync = e.skip_sync
+            reset_required = True
+
+    return wrapper
+
+
+def _reset():
+    """Re-initialize the runtime after a world change: shutdown + init gives
+    a fresh rendezvous and a fresh mesh (the analog of the reference's
+    shutdown/init cycle inside reset, ``common/elastic.py:95-109``)."""
+    from horovod_tpu.common import basics
+
+    basics.shutdown()
+    basics.init()

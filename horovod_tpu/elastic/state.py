@@ -1,0 +1,136 @@
+"""Elastic state objects (reference ``horovod/common/elastic.py:26-144``,
+``horovod/torch/elastic/state.py:27-140``)."""
+
+from __future__ import annotations
+
+import copy
+
+import jax
+
+
+class State:
+    """Tracked training state with commit / restore / sync
+    (reference ``common/elastic.py:26``).
+
+    - ``commit()``: snapshot state in host memory and check for pending
+      host updates (raising HostsUpdatedInterrupt at a safe point).
+    - ``restore()``: roll back to the last commit (after a failure).
+    - ``sync()``: broadcast state from the new coordinator after a
+      re-initialization.
+    """
+
+    def __init__(self, **kwargs):
+        self._host_messages = []
+        self._reset_callbacks = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks):
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp, update_res):
+        self._host_messages.append((timestamp, update_res))
+
+    def commit(self):
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt if the driver reported a host-set
+        change since the last check (reference ``common/elastic.py:73-93``)."""
+        from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+
+        if self._host_messages:
+            # skip_sync when only additions occurred and our state is current
+            self._host_messages.clear()
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class ObjectState(State):
+    """Snapshot of plain Python attributes (reference
+    ``common/elastic.py:112``)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._saved_state = {}
+        self.save()
+
+    def _tracked(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self._tracked())
+
+    def restore(self):
+        for k, v in copy.deepcopy(self._saved_state).items():
+            setattr(self, k, v)
+
+    def sync(self):
+        from horovod_tpu.ops.functions import broadcast_object
+
+        synced = broadcast_object(self._tracked(), root_rank=0,
+                                  name="elastic.ObjectState")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Elastic state for a JAX training loop: params + optimizer state
+    pytrees plus arbitrary scalars (epoch, batch).
+
+    The analog of ``TorchState`` (``torch/elastic/state.py:27``): pytree
+    leaves are snapshotted to host memory on commit (device HBM is lost on
+    pre-emption) and broadcast from the new rank 0 on sync.
+    """
+
+    def __init__(self, params=None, opt_state=None, **kwargs):
+        self.params = params
+        self.opt_state = opt_state
+        super().__init__(**kwargs)
+
+    def save(self):
+        state = self._tracked()
+        # jax arrays → host numpy for a durable snapshot
+        self._saved_state = jax.tree.map(
+            lambda x: jax.device_get(x) if hasattr(x, "devices") else
+            copy.deepcopy(x), state)
+
+    def restore(self):
+        for k, v in self._saved_state.items():
+            setattr(self, k, jax.tree.map(lambda x: x, v))
+
+    def sync(self):
+        from horovod_tpu.ops.functions import broadcast_parameters
+
+        self.params = broadcast_parameters(self.params, root_rank=0)
+        if self.opt_state is not None:
+            self.opt_state = broadcast_parameters(self.opt_state,
+                                                  root_rank=0)
+        from horovod_tpu.ops.functions import broadcast_object
+
+        scalars = {k: v for k, v in self._tracked().items()
+                   if k not in ("params", "opt_state")}
+        synced = broadcast_object(scalars, root_rank=0,
+                                  name="elastic.JaxState")
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()

@@ -1,0 +1,249 @@
+"""Eager-path engine — Python facade over the C++ core runtime.
+
+The reference's engine (``operations.cc``: background thread + rank-0
+coordinator + fusion + response cache) serves *every* collective because
+frameworks there run op-by-op. Here it serves only the **eager,
+cross-process** path (metrics, parameter broadcast, object collectives, the
+PyTorch binding); the TPU training hot path compiles collectives into the
+SPMD program (see ``ops/collective_ops.py``).
+
+Facade layering:
+
+- ``library_available()`` → the C++ core (``horovod_tpu/csrc``) built and
+  loadable; multi-process eager collectives require it.
+- Single-process jobs (including a whole pod driven by one process — the
+  common single-host case) do not need a cross-process data plane at all;
+  collectives reduce over one contribution and complete immediately, exactly
+  like a world-size-1 reference job.
+
+Every call returns a :class:`Handle`; ``synchronize``/``poll`` in
+``collective_ops`` mirror ``torch/mpi_ops.py:807-845``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.common.process_sets import global_process_set
+
+
+class Handle:
+    """Async completion handle (reference ``torch/handle_manager.h:23-60``)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def _set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def _set_error(self, err: Exception):
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("collective did not complete in time")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+def _immediate(value) -> Handle:
+    h = Handle()
+    h._set_result(value)
+    return h
+
+
+def _nprocs() -> int:
+    n = os.environ.get("HVT_NUM_PROCESSES")
+    if n is not None:
+        return int(n)
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def library_available() -> bool:
+    from horovod_tpu.engine import native
+
+    return native.available()
+
+
+def shutdown_if_running():
+    from horovod_tpu.engine import native
+
+    native.shutdown_if_running()
+
+
+def _require_multiproc_engine():
+    from horovod_tpu.engine import native
+
+    if not native.available():
+        raise HorovodInternalError(
+            "multi-process eager collectives require the C++ engine "
+            "(horovod_tpu/csrc); build it with `python setup.py build_ext` "
+            "or run single-process")
+    return native
+
+
+def _to_numpy(tensor):
+    """Normalize eager inputs (numpy / jax.Array / scalar / torch.Tensor)."""
+    if hasattr(tensor, "detach") and hasattr(tensor, "numpy"):  # torch
+        return tensor.detach().cpu().numpy(), "torch"
+    if isinstance(tensor, np.ndarray):
+        return tensor, "numpy"
+    try:
+        import jax
+
+        if isinstance(tensor, jax.Array):
+            return np.asarray(tensor), "jax"
+    except Exception:
+        pass
+    return np.asarray(tensor), "numpy"
+
+
+def _from_numpy(arr: np.ndarray, kind: str):
+    if kind == "jax":
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+    if kind == "torch":
+        import torch
+
+        return torch.from_numpy(np.ascontiguousarray(arr))
+    return arr
+
+
+def _scale(arr, factor):
+    if factor == 1.0:
+        return arr
+    return arr * np.asarray(factor, dtype=arr.dtype if
+                            np.issubdtype(arr.dtype, np.floating)
+                            else np.float64).astype(arr.dtype)
+
+
+# --------------------------------------------------------------------------
+# collectives
+# --------------------------------------------------------------------------
+
+def allreduce(tensor, op, name=None, prescale_factor=1.0,
+              postscale_factor=1.0, process_set=global_process_set) -> Handle:
+    from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min,
+                                                Product, Sum)
+
+    arr, kind = _to_numpy(tensor)
+    n = _nprocs()
+    if n == 1:
+        out = _scale(_scale(arr.copy(), prescale_factor), postscale_factor)
+        return _immediate(_from_numpy(out, kind))
+    native = _require_multiproc_engine()
+    opname = {Average: "avg", Sum: "sum", Adasum: "adasum", Min: "min",
+              Max: "max", Product: "prod"}[op]
+    return native.submit("allreduce", arr, kind, name=name, op=opname,
+                         prescale=prescale_factor, postscale=postscale_factor,
+                         process_set=process_set)
+
+
+def grouped_allreduce(tensors, op, name=None, prescale_factor=1.0,
+                      postscale_factor=1.0,
+                      process_set=global_process_set) -> Handle:
+    handles = [allreduce(t, op, name=f"{name}.{i}" if name else None,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor,
+                         process_set=process_set)
+               for i, t in enumerate(tensors)]
+    h = Handle()
+
+    def _gather():
+        try:
+            h._set_result([x.wait() for x in handles])
+        except Exception as e:  # pragma: no cover
+            h._set_error(e)
+
+    if all(x.done() for x in handles):
+        _gather()
+    else:
+        threading.Thread(target=_gather, daemon=True).start()
+    return h
+
+
+def allgather(tensor, name=None, process_set=global_process_set) -> Handle:
+    arr, kind = _to_numpy(tensor)
+    if _nprocs() == 1:
+        return _immediate(_from_numpy(arr.copy(), kind))
+    native = _require_multiproc_engine()
+    return native.submit("allgather", arr, kind, name=name,
+                         process_set=process_set)
+
+
+def grouped_allgather(tensors, name=None,
+                      process_set=global_process_set) -> Handle:
+    handles = [allgather(t, name=f"{name}.{i}" if name else None,
+                         process_set=process_set)
+               for i, t in enumerate(tensors)]
+    return _immediate([h.wait() for h in handles])
+
+
+def broadcast(tensor, root_rank=0, name=None,
+              process_set=global_process_set) -> Handle:
+    arr, kind = _to_numpy(tensor)
+    if _nprocs() == 1:
+        return _immediate(_from_numpy(arr.copy(), kind))
+    native = _require_multiproc_engine()
+    return native.submit("broadcast", arr, kind, name=name,
+                         root_rank=root_rank, process_set=process_set)
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set) -> Handle:
+    arr, kind = _to_numpy(tensor)
+    if _nprocs() == 1:
+        out = _from_numpy(arr.copy(), kind)
+        recv_splits = (np.asarray(splits).copy()
+                       if splits is not None
+                       else np.asarray([arr.shape[0]]))
+        return _immediate((out, recv_splits))
+    native = _require_multiproc_engine()
+    return native.submit("alltoall", arr, kind, name=name, splits=splits,
+                         process_set=process_set)
+
+
+def reducescatter(tensor, op, name=None,
+                  process_set=global_process_set) -> Handle:
+    arr, kind = _to_numpy(tensor)
+    if _nprocs() == 1:
+        return _immediate(_from_numpy(arr.copy(), kind))
+    native = _require_multiproc_engine()
+    from horovod_tpu.ops.collective_ops import Average
+
+    return native.submit("reducescatter", arr, kind, name=name,
+                         op="avg" if op is Average else "sum",
+                         process_set=process_set)
+
+
+def join() -> int:
+    if _nprocs() == 1:
+        return 0
+    native = _require_multiproc_engine()
+    return native.submit("join", None, "numpy").wait()
+
+
+def barrier(process_set=global_process_set):
+    if _nprocs() == 1:
+        return
+    native = _require_multiproc_engine()
+    native.submit("barrier", None, "numpy",
+                  process_set=process_set).wait()

@@ -1,0 +1,70 @@
+"""ctypes bridge to the C++ core (``horovod_tpu/csrc`` → ``libhvt_core.so``).
+
+Analog of the reference's ctypes bridge (``horovod/common/basics.py:22-65``
+loading ``libhorovod``). The C++ core provides, per SURVEY.md §2.1-2.2:
+background engine thread, rank-0 coordinator protocol, tensor queue,
+fusion buffers, response cache with cross-rank bit sync, stall inspector,
+and TCP ring collectives with HTTP-store rendezvous (the Gloo-equivalent
+CPU data plane).
+
+This module degrades gracefully: when the shared library is absent (not yet
+built on this machine), ``available()`` is False and single-process eager
+semantics still work through ``engine/api.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_lib = None
+_load_attempted = False
+_running = False
+
+
+def _lib_path():
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(here), "csrc", "build",
+                        "libhvt_core.so")
+
+
+def _load():
+    global _lib, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _lib
+        _load_attempted = True
+        path = _lib_path()
+        if not os.path.exists(path):
+            return None
+        import ctypes
+
+        try:
+            _lib = ctypes.CDLL(path)
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def shutdown_if_running():
+    global _running
+    with _lock:
+        if not _running:
+            return
+        lib = _lib
+        if lib is not None:
+            lib.hvt_shutdown()
+        _running = False
+
+
+def submit(op, arr, kind, **kwargs):
+    """Submit an eager collective to the C++ engine. Wired up when the
+    native extension lands (phase B); see ``horovod_tpu/csrc``."""
+    raise NotImplementedError(
+        "C++ engine submission not yet wired; multi-process eager "
+        "collectives arrive with horovod_tpu/csrc")

@@ -1,0 +1,94 @@
+"""Adasum — scale-invariant gradient combination.
+
+Math (reference ``horovod/common/ops/adasum/adasum.h:338-420``): for two
+gradients a, b,
+
+    adasum(a, b) = (1 - a·b / (2‖a‖²)) a  +  (1 - a·b / (2‖b‖²)) b
+
+applied recursively over a binary tree of ranks (vector-halving
+distance-doubling in the reference, ``adasum.h:194-336``; power-of-two world
+size required, enforced at ``tensorflow/__init__.py:146-147``).
+
+TPU-native design: each recursion level pairs ranks with stride 2^k and runs
+ONE pairwise ``psum`` (via ``axis_index_groups``) to give both members
+s = a + b; from s each member reconstructs its partner's vector locally
+(partner = s − mine), so a·b, ‖a‖², ‖b‖² and the combine are all local math
+— no point-to-point sends, no extra scalar collectives. log2(n) small-group
+psums replace VHDD's halved-vector MPI exchanges; XLA schedules them on ICI.
+Dot/norm accumulation is fp32 regardless of input dtype, like the
+reference's ``DispatchComputeDotAndNormSqrds`` (``adasum.h:434-466``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def pairwise_adasum(a, b):
+    """The scalar-coefficient pairwise combine, fp32 accumulation.
+
+    Guards the zero-norm cases like the reference (``adasum.h:372-383``).
+    Exposed for tests and for the eager/C++ path to cross-check against.
+    """
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.sum(af * bf)
+    a_sq = jnp.sum(af * af)
+    b_sq = jnp.sum(bf * bf)
+    ca = jnp.where(a_sq > 0, 1.0 - dot / (2.0 * a_sq), 1.0)
+    cb = jnp.where(b_sq > 0, 1.0 - dot / (2.0 * b_sq), 1.0)
+    return (ca * af + cb * bf).astype(a.dtype)
+
+
+def adasum_reduce(t, axis_name, axis_index_groups=None):
+    """Adasum-combine ``t`` across the mesh axis (traced path).
+
+    At level k, ranks pair with stride 2^k inside blocks of 2^(k+1); after
+    log2(n) levels every rank holds adasum over all ranks, matching the
+    reference's recursion order (``adasum.h:194-336``).
+    """
+    if axis_index_groups is not None:
+        raise NotImplementedError(
+            "Adasum over a strict process subset is not yet supported on "
+            "the traced path; use the global process set")
+    n = lax.axis_size(axis_name)
+    if n & (n - 1):
+        raise ValueError(
+            f"Adasum requires a power-of-two number of workers, got {n} "
+            "(reference enforces the same: tensorflow/__init__.py:146)")
+    if n == 1:
+        return t
+
+    idx = lax.axis_index(axis_name)
+    orig_dtype = t.dtype
+    v = t.astype(jnp.float32)
+
+    levels = int(n).bit_length() - 1
+    for k in range(levels):
+        stride = 1 << k
+        block = stride << 1
+        groups = []
+        for base in range(0, n, block):
+            for off in range(stride):
+                groups.append([base + off, base + off + stride])
+        is_lower = (idx & stride) == 0
+
+        from horovod_tpu.ops.collective_ops import Sum, _grouped_reduce
+
+        s = _grouped_reduce(v, Sum, axis_name, groups)  # a + b
+        partner = s - v
+        my_sq = jnp.sum(v * v)
+        partner_sq = jnp.sum(partner * partner)
+        dot = jnp.sum(v * partner)
+
+        # 'a' is the lower pair member on both sides so coefficients agree.
+        a_sq = jnp.where(is_lower, my_sq, partner_sq)
+        b_sq = jnp.where(is_lower, partner_sq, my_sq)
+        ca = jnp.where(a_sq > 0, 1.0 - dot / (2.0 * a_sq), 1.0)
+        cb = jnp.where(b_sq > 0, 1.0 - dot / (2.0 * b_sq), 1.0)
+        a = jnp.where(is_lower, v, partner)
+        b = jnp.where(is_lower, partner, v)
+        v = ca * a + cb * b
+
+    return v.astype(orig_dtype)

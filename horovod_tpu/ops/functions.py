@@ -1,0 +1,105 @@
+"""Object / parameter collectives.
+
+Parity: ``horovod/tensorflow/functions.py`` (allgather_object,
+broadcast_object, broadcast_variables) and ``horovod/torch/functions.py``
+(broadcast_parameters, broadcast_optimizer_state, broadcast_object,
+allgather_object). Objects travel as pickled uint8 tensors over the eager
+engine path, exactly the reference's mechanism
+(``tensorflow/functions.py:96-177``).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+
+import numpy as np
+
+from horovod_tpu.ops import collective_ops as C
+
+
+def _serialize(obj) -> np.ndarray:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+
+
+def _deserialize(arr: np.ndarray):
+    return pickle.load(io.BytesIO(arr.tobytes()))
+
+
+def allgather_object(obj, name=None, process_set=C.global_process_set):
+    """Gather one picklable object per process; returns the list ordered by
+    rank (``torch/functions.py:163``). Sizes are exchanged first so payloads
+    may differ per rank, like the reference's size-allgather +
+    payload-allgather pair."""
+    payload = _serialize(obj)
+    sizes = C.allgather(np.asarray([payload.shape[0]], dtype=np.int64),
+                        name=f"{name or 'allgather_object'}.sizes",
+                        process_set=process_set)
+    sizes = np.asarray(sizes).reshape(-1)
+    gathered = C.allgather(payload,
+                           name=f"{name or 'allgather_object'}.data",
+                           process_set=process_set)
+    gathered = np.asarray(gathered)
+    out, off = [], 0
+    for s in sizes:
+        out.append(_deserialize(gathered[off:off + int(s)]))
+        off += int(s)
+    return out
+
+
+def broadcast_object(obj=None, root_rank=0, name=None,
+                     process_set=C.global_process_set):
+    """Broadcast a picklable object from root (``torch/functions.py:122``)."""
+    from horovod_tpu.common import basics
+
+    if basics.process_rank() == root_rank:
+        payload = _serialize(obj)
+    else:
+        payload = np.zeros((0,), dtype=np.uint8)
+    size = C.broadcast(np.asarray([payload.shape[0]], dtype=np.int64),
+                       root_rank=root_rank,
+                       name=f"{name or 'broadcast_object'}.size",
+                       process_set=process_set)
+    n = int(np.asarray(size).reshape(-1)[0])
+    if basics.process_rank() != root_rank:
+        payload = np.zeros((n,), dtype=np.uint8)
+    data = C.broadcast(payload, root_rank=root_rank,
+                       name=f"{name or 'broadcast_object'}.data",
+                       process_set=process_set)
+    return _deserialize(np.asarray(data))
+
+
+def broadcast_parameters(params, root_rank=0,
+                         process_set=C.global_process_set):
+    """Broadcast a pytree of arrays (model params / optimizer state) from
+    root so all processes start identical — the reference's
+    ``broadcast_parameters`` / ``BroadcastGlobalVariablesCallback``
+    (``torch/functions.py:32``, ``_keras/callbacks.py:22``).
+
+    Returns the broadcast pytree. Under a single controller process the tree
+    is already consistent; multi-controller jobs route each leaf through the
+    engine broadcast.
+    """
+    import jax
+
+    leaves, treedef = jax.tree.flatten(params)
+    out = [C.broadcast(l, root_rank=root_rank,
+                       name=f"broadcast_parameters.{i}",
+                       process_set=process_set)
+           for i, l in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, out)
+
+
+# TF-parity alias (``tensorflow/functions.py`` broadcast_variables)
+broadcast_variables = broadcast_parameters
+
+
+def broadcast_optimizer_state(opt_state, root_rank=0,
+                              process_set=C.global_process_set):
+    """Broadcast optimizer state (optax pytree) from root
+    (``torch/functions.py:59`` broadcasts per-param optimizer tensors;
+    optax state is already a pytree, so this is broadcast_parameters)."""
+    return broadcast_parameters(opt_state, root_rank=root_rank,
+                                process_set=process_set)

@@ -1,0 +1,19 @@
+from horovod_tpu.parallel.mesh import (
+    build_global_mesh,
+    global_mesh,
+    hierarchical_mesh,
+    make_parallel_mesh,
+    WORLD_AXIS,
+    LOCAL_AXIS,
+    CROSS_AXIS,
+)
+
+__all__ = [
+    "build_global_mesh",
+    "global_mesh",
+    "hierarchical_mesh",
+    "make_parallel_mesh",
+    "WORLD_AXIS",
+    "LOCAL_AXIS",
+    "CROSS_AXIS",
+]

@@ -1,0 +1,88 @@
+"""Worker-side host-update notifications (reference
+``horovod/runner/elastic/worker.py:37`` WorkerNotificationManager).
+
+Each elastic worker runs a small HTTP server; the driver POSTs host-set
+changes to it, and the manager forwards them to every registered State via
+``on_hosts_updated`` so the next ``state.commit()`` raises
+HostsUpdatedInterrupt. Outside an elastic launch (no
+``HVT_ELASTIC_NOTIFY_ADDR`` env), this is inert and states simply never see
+host updates — matching the reference, where the manager only initializes
+under horovodrun-elastic."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_manager = None
+_lock = threading.Lock()
+
+
+class WorkerNotificationManager:
+    def __init__(self):
+        self._states = []
+        self._server = None
+        self._port = None
+
+    def register_state(self, state):
+        self._states.append(state)
+
+    def remove_state(self, state):
+        if state in self._states:
+            self._states.remove(state)
+
+    @property
+    def port(self):
+        return self._port
+
+    def handle_hosts_updated(self, timestamp, update_res):
+        for s in list(self._states):
+            s.on_hosts_updated(timestamp, update_res)
+
+    def start_server(self):
+        mgr = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_PUT(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                mgr.handle_hosts_updated(body.get("timestamp", time.time()),
+                                         body.get("res", 0))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def init(self, rendezvous_addr=None):
+        """Register with the elastic driver's rendezvous so it can notify us
+        (reference worker.py:44-66 PUTs its address to the driver)."""
+        self.start_server()
+        addr = rendezvous_addr or os.environ.get("HVT_ELASTIC_NOTIFY_ADDR")
+        if addr:
+            from horovod_tpu.runner.http_client import put_json
+
+            rank = os.environ.get("HVT_PROCESS_ID", "0")
+            put_json(addr, f"/worker/{rank}/notify",
+                     {"host": "127.0.0.1", "port": self._port})
+
+
+def init_worker_notification(state):
+    """Called by @hvt.elastic.run: lazily start the manager and register the
+    state. Inert outside an elastic launch."""
+    global _manager
+    with _lock:
+        if _manager is None:
+            _manager = WorkerNotificationManager()
+            if os.environ.get("HVT_ELASTIC_NOTIFY_ADDR"):
+                _manager.init()
+        _manager.register_state(state)
+    return _manager

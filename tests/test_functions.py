@@ -1,0 +1,58 @@
+"""Object/parameter collectives + compression unit tests
+(reference ``torch/functions.py`` / ``tensorflow/functions.py`` suites)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import horovod_tpu as hvt
+from horovod_tpu.ops.compression import Compression
+
+
+def test_allgather_object_single_process():
+    out = hvt.allgather_object({"a": 1, "b": [2, 3]})
+    assert out == [{"a": 1, "b": [2, 3]}]
+
+
+def test_broadcast_object_single_process():
+    obj = ("epoch", 7)
+    assert hvt.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_broadcast_parameters_pytree():
+    params = {"w": jnp.ones((2, 2)), "b": np.zeros(3)}
+    out = hvt.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    np.testing.assert_allclose(np.asarray(out["b"]), 0.0)
+
+
+def test_broadcast_optimizer_state():
+    import optax
+
+    tx = optax.adam(1e-3)
+    state = tx.init({"w": jnp.ones((2,))})
+    out = hvt.broadcast_optimizer_state(state, root_rank=0)
+    assert len(out) == len(state)
+
+
+def test_fp16_compressor():
+    x = jnp.asarray(np.random.RandomState(0).randn(16).astype(np.float32))
+    c, ctx = Compression.fp16.compress(x)
+    assert c.dtype == jnp.float16
+    d = Compression.fp16.decompress(c, ctx)
+    assert d.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(d), np.asarray(x), rtol=1e-3)
+
+
+def test_bf16_compressor():
+    x = jnp.asarray(np.random.RandomState(1).randn(16).astype(np.float32))
+    c, ctx = Compression.bf16.compress(x)
+    assert c.dtype == jnp.bfloat16
+    d = Compression.bf16.decompress(c, ctx)
+    assert d.dtype == jnp.float32
+
+
+def test_compressor_skips_ints():
+    x = jnp.arange(4)
+    c, ctx = Compression.fp16.compress(x)
+    assert c.dtype == x.dtype and ctx is None
+    assert Compression.none.compress(x)[0] is x

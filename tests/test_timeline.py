@@ -1,0 +1,45 @@
+"""Timeline tests (reference ``test/parallel/test_timeline.py`` runs a job
+with HOROVOD_TIMELINE and validates the JSON)."""
+
+import json
+
+import numpy as np
+
+import horovod_tpu as hvt
+from horovod_tpu.utils import timeline
+
+
+def test_timeline_produces_valid_chrome_trace(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    hvt.start_timeline(path, mark_cycles=True)
+    timeline.negotiate_start("grad/w", "ALLREDUCE")
+    timeline.negotiate_end("grad/w")
+    timeline.activity_start("grad/w", "MEMCPY_IN_FUSION_BUFFER")
+    timeline.activity_end("grad/w")
+    timeline.activity_start("grad/b", "XLA_ALLREDUCE")
+    timeline.activity_end("grad/b")
+    timeline.mark_cycle()
+    hvt.stop_timeline()
+
+    with open(path) as f:
+        events = json.load(f)
+    assert isinstance(events, list) and events
+    names = {e.get("args", {}).get("name") for e in events
+             if e.get("ph") == "M"}
+    assert {"grad/w", "grad/b"} <= names
+    assert any(e.get("name") == "NEGOTIATE_ALLREDUCE" for e in events)
+    assert any(e.get("name") == "CYCLE_START" for e in events)
+    # B/E events must balance per lane
+    for tid in {e["tid"] for e in events if e.get("ph") in "BE"}:
+        b = sum(1 for e in events if e.get("tid") == tid and e["ph"] == "B")
+        e_ = sum(1 for e in events if e.get("tid") == tid and e["ph"] == "E")
+        assert b == e_
+
+
+def test_timeline_start_stop_idempotent(tmp_path):
+    path = str(tmp_path / "t2.json")
+    hvt.start_timeline(path)
+    hvt.start_timeline(path)  # second start is a no-op (ref: returns DUPLICATE)
+    hvt.stop_timeline()
+    hvt.stop_timeline()
+    assert not timeline.active()
