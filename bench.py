@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 benchmark — the TPU-native analog of the reference's
+``examples/pytorch/pytorch_synthetic_benchmark.py`` (prints img/sec ± stdev;
+reference lines :110,:117) and ``examples/tensorflow2/tensorflow2_synthetic_benchmark.py``.
+
+Data-parallel over every visible chip via the global mesh; the gradient
+reduction is compiled into the step (XLA ICI allreduce), which is the whole
+point of the TPU-native design.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+vs_baseline denominator: the reference's only published absolute number,
+1656.82 img/sec for ResNet-101 on 16 GPUs (``docs/benchmarks.rst:43``)
+= 103.55 img/sec/device.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_IMG_SEC_PER_DEVICE = 1656.82 / 16.0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "resnet101"])
+    p.add_argument("--batch-size", type=int, default=128,
+                   help="per-chip batch size")
+    p.add_argument("--num-iters", type=int, default=5)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--fp32", action="store_true",
+                   help="use float32 instead of bfloat16")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvt
+    from horovod_tpu.models import ResNet50, ResNet101
+    from horovod_tpu.parallel import mesh as M
+
+    hvt.init()
+    mesh = M.global_mesh()
+    n = hvt.size()
+
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    model_cls = ResNet50 if args.model == "resnet50" else ResNet101
+    model = model_cls(num_classes=1000, dtype=dtype)
+
+    global_batch = args.batch_size * n
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(global_batch, 224, 224, 3),
+                         dtype=dtype)
+    labels = jnp.asarray(rng.randint(0, 1000, (global_batch,)))
+    data_sharding = NamedSharding(mesh, P(M.WORLD_AXIS))
+    images = jax.device_put(images, data_sharding)
+    labels = jax.device_put(labels, data_sharding)
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3), dtype), train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    repl = NamedSharding(mesh, P())
+    params = jax.device_put(params, repl)
+    batch_stats = jax.device_put(batch_stats, repl)
+
+    # reference benchmark uses SGD momentum 0.9 via hvd.DistributedOptimizer
+    tx = hvt.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                  axis_name=None)  # pjit: XLA reduces
+    opt_state = jax.device_put(tx.init(params), repl)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, mutated["batch_stats"]
+
+    @jax.jit
+    def train_step(params, batch_stats, opt_state, images, labels):
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_bs, opt_state, loss
+
+    # warmup / compile
+    params, batch_stats, opt_state, loss = train_step(
+        params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_secs.append(global_batch * args.num_batches_per_iter / dt)
+
+    img_sec_mean = float(np.mean(img_secs))
+    img_sec_std = float(np.std(img_secs))
+    per_chip = img_sec_mean / n
+    print(f"# {args.model} bs={args.batch_size}/chip chips={n} "
+          f"dtype={'fp32' if args.fp32 else 'bf16'}: "
+          f"{img_sec_mean:.1f} +- {img_sec_std:.1f} img/sec total, "
+          f"{per_chip:.1f} img/sec/chip, final loss {float(loss):.3f}",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": f"{args.model}_synthetic_img_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_DEVICE, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

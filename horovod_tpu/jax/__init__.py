@@ -143,11 +143,9 @@ def DistributedGradientTransformation(
                 "(reference tensorflow/__init__.py:585)")
         # Average = Sum with pre/post scales (reference splits it this way).
         op = C.Sum
-        world = None  # resolved at trace time per axis
         prescale_factor = prescale_factor * gradient_predivide_factor
         postscale_factor = postscale_factor / gradient_predivide_factor
         _predivide_by_size = True
-        del world
     else:
         _predivide_by_size = False
 

@@ -1,0 +1,5 @@
+from horovod_tpu.models.resnet import ResNet, ResNet50, ResNet101, ResNet152
+from horovod_tpu.models.transformer import GPT, GPTConfig
+
+__all__ = ["ResNet", "ResNet50", "ResNet101", "ResNet152", "GPT",
+           "GPTConfig"]

@@ -1,0 +1,100 @@
+"""ResNet for the Horovod-parity benchmarks.
+
+The reference benchmarks data-parallel ResNet-50/101 throughput
+(``examples/pytorch/pytorch_synthetic_benchmark.py``,
+``docs/benchmarks.rst:28-43``); this is the TPU-native model used by
+``bench.py`` and the examples.
+
+TPU-first choices:
+- NHWC layout (XLA:TPU's native conv layout — channels last feeds the MXU
+  without transposes).
+- bfloat16 activations/weights with fp32 BatchNorm statistics and fp32
+  residual adds where it matters for accuracy.
+- ``BatchNorm(axis_name=...)`` gives cross-replica (synchronized) batch
+  norm — the parity feature the reference implements by hand with
+  allreduces of mean/var (``horovod/tensorflow/sync_batch_norm.py:22``,
+  ``horovod/torch/sync_batch_norm.py``); on TPU it is one flag because the
+  collective is compiled into the program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """Standard bottleneck residual block (1x1 → 3x3 → 1x1, expansion 4)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,
+                                                     self.strides))(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale: residual branch starts as identity
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="proj_conv")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5 (stride-2 in the 3x3, like the reference torchvision
+    models the benchmarks use)."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+    axis_name: Optional[str] = None  # set → synchronized batch norm
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32, axis_name=self.axis_name)
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3),
+                                                              (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(self.width * 2 ** i, strides=strides,
+                                    conv=conv, norm=norm, act=act)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])

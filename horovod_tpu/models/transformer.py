@@ -1,0 +1,168 @@
+"""Decoder-only transformer (GPT) — the flagship model for multi-chip
+sharding (dp/tp/sp over a mesh).
+
+The reference framework is model-agnostic (it ships gradients for
+arbitrary TF/torch models); its benchmark models are CNNs. A modern
+distributed-training framework is exercised hardest by transformer LMs, so
+this is the model `__graft_entry__.py` shards over dp×tp×sp and the
+long-context (ring attention) path targets.
+
+TPU-first choices:
+- bfloat16 activations, fp32 params + fp32 softmax/logits accumulation.
+- shapes static, attention as batched einsums on the MXU.
+- ``param_partition_spec`` maps every parameter to a PartitionSpec
+  (Megatron-style tensor parallelism: column-parallel qkv/up projections,
+  row-parallel out/down projections) so pjit/XLA inserts the ICI
+  collectives — the TPU-native replacement for NCCL allreduce layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    n_layers: int = 4
+    d_model: int = 256
+    n_heads: int = 4
+    d_ff: int = 1024
+    max_seq_len: int = 1024
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False  # jax.checkpoint each block (HBM ↔ FLOPs trade)
+
+
+def _rotary(x, positions):
+    """Rotary position embeddings (fp32 phase math)."""
+    *_, seq, heads, head_dim = x.shape
+    half = head_dim // 2
+    freqs = 1.0 / (10000.0 ** (np.arange(0, half) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [.., seq, half]
+    cos = jnp.cos(angles)[..., None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[..., None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        return (x32 * jax.lax.rsqrt(var + self.eps) * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.n_heads
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, axis=-1, use_bias=False, dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name)
+        q = dense((cfg.n_heads, head_dim), "q")(x)
+        k = dense((cfg.n_heads, head_dim), "k")(x)
+        v = dense((cfg.n_heads, head_dim), "v")(x)
+        q = _rotary(q, positions)
+        k = _rotary(k, positions)
+
+        scores = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                            preferred_element_type=jnp.float32)
+        scores = scores / np.sqrt(head_dim)
+        qpos = positions[..., :, None]
+        kpos = positions[..., None, :]
+        causal = (kpos <= qpos)[..., None, :, :]
+        scores = jnp.where(causal, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("...hqk,...khd->...qhd", probs, v)
+        return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
+                               dtype=cfg.dtype, param_dtype=jnp.float32,
+                               name="o")(out)
+
+
+class MLP(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="up")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="down")(h)
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(name="ln1")(x), positions)
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="ln2")(x))
+        return x
+
+
+class GPT(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[-1]), tokens.shape)
+        emb = self.param("embedding", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        x = emb[tokens].astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.n_layers):
+            x = block(cfg, name=f"block_{i}")(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        logits = jnp.einsum("...ld,vd->...lv", x.astype(jnp.float32), emb)
+        return logits
+
+
+def param_partition_spec(params, *, tp_axis="tp"):
+    """PartitionSpec pytree for Megatron-style tensor parallelism.
+
+    Column-parallel: q/k/v and MLP up kernels shard their output dim over
+    ``tp_axis``; row-parallel: attention out and MLP down kernels shard
+    their input dim, so XLA inserts exactly one psum per row-parallel
+    matmul (the NCCL-allreduce-per-layer pattern, compiled).
+    Embedding shards the vocab dim. Norm scales replicate.
+    """
+
+    def spec_for(path):
+        names = [getattr(p, "key", None) for p in path]
+        if "embedding" in names:
+            return P(tp_axis, None)
+        if any(n in ("q", "k", "v") for n in names):
+            return P(None, tp_axis, None)      # (d_model, heads, head_dim)
+        if "o" in names:
+            return P(tp_axis, None, None)      # (heads, head_dim, d_model)
+        if "up" in names:
+            return P(None, tp_axis)
+        if "down" in names:
+            return P(tp_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for(path), params)

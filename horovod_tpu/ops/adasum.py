@@ -60,7 +60,6 @@ def adasum_reduce(t, axis_name, axis_index_groups=None):
     if n == 1:
         return t
 
-    idx = lax.axis_index(axis_name)
     orig_dtype = t.dtype
     v = t.astype(jnp.float32)
 
@@ -72,8 +71,6 @@ def adasum_reduce(t, axis_name, axis_index_groups=None):
         for base in range(0, n, block):
             for off in range(stride):
                 groups.append([base + off, base + off + stride])
-        is_lower = (idx & stride) == 0
-
         from horovod_tpu.ops.collective_ops import Sum, _grouped_reduce
 
         s = _grouped_reduce(v, Sum, axis_name, groups)  # a + b
@@ -82,13 +79,10 @@ def adasum_reduce(t, axis_name, axis_index_groups=None):
         partner_sq = jnp.sum(partner * partner)
         dot = jnp.sum(v * partner)
 
-        # 'a' is the lower pair member on both sides so coefficients agree.
-        a_sq = jnp.where(is_lower, my_sq, partner_sq)
-        b_sq = jnp.where(is_lower, partner_sq, my_sq)
-        ca = jnp.where(a_sq > 0, 1.0 - dot / (2.0 * a_sq), 1.0)
-        cb = jnp.where(b_sq > 0, 1.0 - dot / (2.0 * b_sq), 1.0)
-        a = jnp.where(is_lower, v, partner)
-        b = jnp.where(is_lower, partner, v)
-        v = ca * a + cb * b
+        # The pairwise combine is symmetric in (a, b), so both members
+        # compute the identical result with their own/partner roles.
+        cv = jnp.where(my_sq > 0, 1.0 - dot / (2.0 * my_sq), 1.0)
+        cp = jnp.where(partner_sq > 0, 1.0 - dot / (2.0 * partner_sq), 1.0)
+        v = cv * v + cp * partner
 
     return v.astype(orig_dtype)

@@ -86,6 +86,32 @@ def _axis_world_size(axis_name):
     return lax.axis_size(axis_name)
 
 
+def _equal_groups(process_set: ProcessSet, axis_name, op_name):
+    """Replica groups + group size for shape-changing collectives
+    (allgather / alltoall / reducescatter).
+
+    XLA requires replica groups to partition the axis into EQUAL-size
+    groups for these ops (the output shape depends on group size). A
+    process set whose complement has a different size cannot be lowered;
+    raise an actionable error instead of XLA's 'Invalid replica id -1'.
+    """
+    if process_set is None or process_set.ranks is None:
+        return None, _axis_world_size(axis_name)
+    world = _axis_world_size(axis_name)
+    groups = process_set.axis_index_groups(world)
+    if groups is None:
+        return None, world
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"traced {op_name} over a process set requires the set and its "
+            f"complement to have equal sizes (XLA replica groups must "
+            f"partition the axis evenly); got sizes "
+            f"{sorted(len(g) for g in groups)}. Use the eager path or a "
+            f"set of size {world // 2}.")
+    return groups, sizes.pop()
+
+
 # --------------------------------------------------------------------------
 # allreduce
 # --------------------------------------------------------------------------
@@ -245,7 +271,7 @@ def allgather(tensor, name=None, process_set=global_process_set,
     """
     if _is_traced(tensor):
         axis = _axis_or_default(axis_name)
-        groups = _groups(process_set, axis)
+        groups, _ = _equal_groups(process_set, axis, "allgather")
         return jax.tree.map(
             lambda t: lax.all_gather(t, axis, axis_index_groups=groups,
                                      tiled=True),
@@ -329,15 +355,15 @@ def alltoall(tensor, splits=None, name=None,
                 "statically-shaped XLA program; pad to even splits or use "
                 "the eager path")
         axis = _axis_or_default(axis_name)
+        groups, group_size = _equal_groups(process_set, axis, "alltoall")
 
         def _a2a(t):
-            n = _axis_world_size(axis)
-            if t.shape[0] % n != 0:
+            if t.shape[0] % group_size != 0:
                 raise ValueError(
-                    f"alltoall dim 0 ({t.shape[0]}) must divide the axis "
-                    f"size ({n}) for the traced path")
+                    f"alltoall dim 0 ({t.shape[0]}) must divide the group "
+                    f"size ({group_size}) for the traced path")
             return lax.all_to_all(t, axis, split_axis=0, concat_axis=0,
-                                  tiled=True)
+                                  tiled=True, axis_index_groups=groups)
 
         return jax.tree.map(_a2a, tensor)
     return synchronize(alltoall_async(tensor, splits=splits, name=name,
@@ -363,20 +389,20 @@ def reducescatter(tensor, op=None, name=None,
     hierarchical and bandwidth-optimal allreduce
     (``nccl_operations.cc:188-350`` uses ReduceScatter+AllGather).
 
-    Traced: ``lax.psum_scatter``. Average divides by world size after the
-    sum, matching the reference's postscale convention.
+    Traced: ``lax.psum_scatter``. Average divides by the reducing group's
+    size after the sum, matching the reference's postscale convention.
     """
     rop = op if op is not None else Average
     if _is_traced(tensor):
         axis = _axis_or_default(axis_name)
-        groups = _groups(process_set, axis)
+        groups, group_size = _equal_groups(process_set, axis,
+                                           "reducescatter")
 
         def _rs(t):
-            n = _axis_world_size(axis)
-            if t.shape[0] % n != 0:
+            if t.shape[0] % group_size != 0:
                 raise ValueError(
                     f"reducescatter dim 0 ({t.shape[0]}) must divide the "
-                    f"axis size ({n}) for the traced path")
+                    f"group size ({group_size}) for the traced path")
             if prescale_factor != 1.0:
                 t2 = t * jnp.asarray(prescale_factor, t.dtype)
             else:
@@ -384,7 +410,7 @@ def reducescatter(tensor, op=None, name=None,
             r = lax.psum_scatter(t2, axis, scatter_dimension=0, tiled=True,
                                  axis_index_groups=groups)
             if rop is Average:
-                r = r / n
+                r = r / group_size
             post = postscale_factor
             if post != 1.0:
                 r = r * jnp.asarray(post, r.dtype)

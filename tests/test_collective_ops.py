@@ -165,6 +165,43 @@ def test_reducescatter_average(world_mesh):
     np.testing.assert_allclose(out[2], mean[2:3], rtol=1e-5)
 
 
+def test_reducescatter_process_set_average(world_mesh):
+    # regression: Average over a 4-rank set must divide by 4, not world (8)
+    ps = hvt.add_process_set([0, 1, 2, 3])
+    x = per_rank(shape=(8, 2), seed=20)
+    f = shmap(lambda t: hvt.reducescatter(t[0], process_set=ps)[None],
+              world_mesh)
+    out = np.asarray(f(x))
+    set_mean = x[:4].mean(axis=0)  # [8, 2]
+    np.testing.assert_allclose(out[1], set_mean[2:4], rtol=1e-5)
+    hvt.remove_process_set(ps)
+
+
+def test_alltoall_process_set(world_mesh):
+    # regression: alltoall must exchange only within the set
+    ps = hvt.add_process_set([0, 1, 2, 3])
+    x = per_rank(shape=(4, 3), seed=21)
+    f = shmap(lambda t: hvt.alltoall(t[0], process_set=ps)[None], world_mesh)
+    out = np.asarray(f(x))
+    for r in range(4):
+        expected = np.stack([x[s, r] for s in range(4)])
+        np.testing.assert_allclose(out[r], expected, rtol=1e-6)
+    hvt.remove_process_set(ps)
+
+
+def test_uneven_process_set_rejected_for_shape_changing_ops(world_mesh):
+    # regression: uneven set+complement used to crash XLA lowering with
+    # 'Invalid replica id -1'; must raise an actionable error instead
+    ps = hvt.add_process_set([0, 1, 2])
+    x = per_rank(shape=(6, 2), seed=22)
+    for fn in (lambda t: hvt.allgather(t, process_set=ps),
+               lambda t: hvt.reducescatter(t, process_set=ps),
+               lambda t: hvt.alltoall(t, process_set=ps)):
+        with pytest.raises(ValueError, match="equal size"):
+            shmap(lambda t, fn=fn: fn(t[0])[None], world_mesh)(x)
+    hvt.remove_process_set(ps)
+
+
 # --------------------------------------------------------------------------
 # eager path (single process)
 # --------------------------------------------------------------------------
