@@ -84,6 +84,24 @@ def init(comm=None, process_sets=None):
             )
             _started_jax_distributed = True
 
+        # CPU engine mode (hvtrun -np N for the eager/torch path): bring up
+        # the C++ core — control star + TCP data mesh + background thread
+        # (the analog of the reference's InitializeHorovodOnce spawning
+        # BackgroundThreadLoop, operations.cc:649,688).
+        master = os.environ.get("HVT_MASTER_ADDR")
+        if master and nprocs and int(nprocs) > 1:
+            from horovod_tpu.engine import native as _native
+
+            if not _native.available():
+                raise RuntimeError(
+                    "hvtrun multi-process launch requires the C++ engine; "
+                    "build it with `make -C horovod_tpu/csrc`")
+            _native.init_engine(
+                rank=int(procid or 0), size=int(nprocs),
+                master_addr=master,
+                master_port=int(os.environ.get("HVT_MASTER_PORT", "29510")),
+                cycle_ms=int(os.environ.get("HVT_CYCLE_TIME_MS", "2")))
+
         # Materialize the device list once; this is the global communicator.
         from horovod_tpu.parallel import mesh as _mesh
 
@@ -141,15 +159,28 @@ def _ensure_init():
             "horovod_tpu has not been initialized; run hvt.init() first.")
 
 
+def _engine():
+    from horovod_tpu.engine import native
+
+    return native if native.engine_running() else None
+
+
 def size() -> int:
-    """Total number of chip slots (Horovod world size)."""
+    """Horovod world size: engine processes in CPU engine mode, chip slots
+    in TPU/SPMD mode."""
     _ensure_init()
+    eng = _engine()
+    if eng is not None:
+        return eng.engine_size()
     return _jax().device_count()
 
 
 def local_size() -> int:
-    """Chips driven by this process (one host)."""
+    """Engine mode: processes on this host (launcher env); TPU mode: chips
+    driven by this process."""
     _ensure_init()
+    if _engine() is not None:
+        return int(os.environ.get("HVT_LOCAL_SIZE", "1"))
     return _jax().local_device_count()
 
 
@@ -157,9 +188,13 @@ def rank() -> int:
     """Global slot index of this process's first chip.
 
     ``rank() == 0`` exactly on the coordinator process. Per-chip ranks live
-    inside the compiled program (``lax.axis_index``).
+    inside the compiled program (``lax.axis_index``). Engine mode: the
+    process rank assigned by the launcher.
     """
     _ensure_init()
+    eng = _engine()
+    if eng is not None:
+        return eng.engine_rank()
     jax = _jax()
     local = jax.local_devices()
     if not local:
@@ -180,24 +215,34 @@ def local_rank() -> int:
 def cross_rank() -> int:
     """Host index (reference CROSS communicator rank, ``common.h:115-119``)."""
     _ensure_init()
+    if _engine() is not None:
+        return int(os.environ.get("HVT_CROSS_RANK", "0"))
     return _jax().process_index()
 
 
 def cross_size() -> int:
     """Number of hosts."""
     _ensure_init()
+    if _engine() is not None:
+        return int(os.environ.get("HVT_CROSS_SIZE", "1"))
     return _jax().process_count()
 
 
 def process_rank() -> int:
     """This Python process's index (== cross_rank on TPU pods)."""
     _ensure_init()
+    eng = _engine()
+    if eng is not None:
+        return eng.engine_rank()
     return _jax().process_index()
 
 
 def process_size() -> int:
     """Number of Python processes."""
     _ensure_init()
+    eng = _engine()
+    if eng is not None:
+        return eng.engine_size()
     return _jax().process_count()
 
 

@@ -1,0 +1,106 @@
+// extern "C" surface for the ctypes bridge (horovod_tpu/engine/native.py) —
+// the counterpart of the reference's C API (horovod/common/operations.cc:
+// 708-896 horovod_init/rank/size + per-framework enqueue entry points).
+#include <cstring>
+
+#include "engine.h"
+
+using hvt::DataType;
+using hvt::Engine;
+using hvt::EntryPtr;
+using hvt::OpType;
+using hvt::ReduceKind;
+using hvt::TensorTableEntry;
+
+extern "C" {
+
+int hvt_init(int rank, int size, const char* master_addr, int master_port,
+             int cycle_ms) {
+  auto s = Engine::Get().Init(rank, size, master_addr ? master_addr : "",
+                              master_port, cycle_ms);
+  return s.ok() ? 0 : -1;
+}
+
+void hvt_shutdown() { Engine::Get().Shutdown(); }
+
+int hvt_initialized() { return Engine::Get().initialized() ? 1 : 0; }
+int hvt_rank() { return Engine::Get().rank(); }
+int hvt_size() { return Engine::Get().size(); }
+
+// Returns handle >= 0, or -1 when the engine is not initialized.
+int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,
+               const long long* dims, const void* data, long long nbytes,
+               int root_rank, double prescale, double postscale,
+               int nsplits, const long long* splits) {
+  auto e = std::make_shared<TensorTableEntry>();
+  e->name = name ? name : "";
+  e->op = static_cast<OpType>(op);
+  e->reduce = static_cast<ReduceKind>(reduce);
+  e->dtype = static_cast<DataType>(dtype);
+  for (int i = 0; i < ndims; ++i) e->shape.dims.push_back(dims[i]);
+  e->root_rank = root_rank;
+  e->prescale = prescale;
+  e->postscale = postscale;
+  if (data && nbytes > 0) {
+    e->input.resize(static_cast<size_t>(nbytes));
+    memcpy(e->input.data(), data, static_cast<size_t>(nbytes));
+  }
+  for (int i = 0; i < nsplits; ++i) e->splits.push_back(splits[i]);
+  return Engine::Get().Submit(std::move(e));
+}
+
+int hvt_poll(int handle) { return Engine::Get().Poll(handle) ? 1 : 0; }
+
+// Blocks. Returns 0 on success; <0 on collective error (message readable
+// via hvt_error_message into caller buffer).
+static thread_local std::string g_last_error;
+static thread_local hvt::HandleState g_last_state;
+
+int hvt_wait(int handle) {
+  g_last_state = Engine::Get().Wait(handle);
+  if (!g_last_state.status.ok()) {
+    g_last_error = g_last_state.status.reason;
+    return -static_cast<int>(g_last_state.status.type);
+  }
+  return 0;
+}
+
+long long hvt_result_bytes(int handle) {
+  (void)handle;
+  return static_cast<long long>(g_last_state.output.size());
+}
+
+void hvt_result_read(int handle, void* dst, long long nbytes) {
+  (void)handle;
+  memcpy(dst, g_last_state.output.data(),
+         static_cast<size_t>(nbytes) < g_last_state.output.size()
+             ? static_cast<size_t>(nbytes)
+             : g_last_state.output.size());
+}
+
+int hvt_result_recv_splits(int handle, long long* dst, int max_n) {
+  (void)handle;
+  int n = static_cast<int>(g_last_state.recv_splits.size());
+  for (int i = 0; i < n && i < max_n; ++i)
+    dst[i] = g_last_state.recv_splits[i];
+  return n;
+}
+
+int hvt_join_result(int handle) {
+  (void)handle;
+  return g_last_state.join_result;
+}
+
+void hvt_release(int handle) { Engine::Get().Release(handle); }
+
+int hvt_error_message(char* dst, int max_n) {
+  int n = static_cast<int>(g_last_error.size());
+  if (max_n > 0) {
+    int k = n < max_n - 1 ? n : max_n - 1;
+    memcpy(dst, g_last_error.data(), static_cast<size_t>(k));
+    dst[k] = '\0';
+  }
+  return n;
+}
+
+}  // extern "C"

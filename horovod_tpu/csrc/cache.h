@@ -1,0 +1,130 @@
+// Response cache — steady-state fast path of the coordination protocol
+// (reference horovod/common/response_cache.{h,cc}: LRU of negotiated
+// responses whose *bit positions* are synchronized across ranks, so a
+// repeating training step skips the full request gather; fast path at
+// controller.cc:194-237).
+//
+// Determinism requirement (reference controller.cc:226-236): every rank
+// must hold an identical cache (same entries at same positions, same
+// eviction order). Guaranteed here because insertions and touches happen
+// only while executing the coordinator-ordered response list, which is
+// identical on all ranks.
+#pragma once
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "wire.h"
+
+namespace hvt {
+
+struct CachedParams {
+  OpType op;
+  ReduceKind reduce;
+  DataType dtype;
+  TensorShape shape;
+  int32_t root_rank;
+  double prescale, postscale;
+  std::vector<int64_t> splits;
+
+  bool Matches(const Request& r) const {
+    return op == r.op && reduce == r.reduce && dtype == r.dtype &&
+           shape == r.shape && root_rank == r.root_rank &&
+           prescale == r.prescale && postscale == r.postscale &&
+           splits == r.splits;
+  }
+};
+
+class ResponseCache {
+ public:
+  explicit ResponseCache(size_t capacity = 1024) : capacity_(capacity) {}
+
+  static constexpr int32_t kMiss = -1;
+  static constexpr int32_t kInvalid = -2;
+
+  // kMiss: not cached. position >= 0: cached with matching params.
+  // kInvalid: cached under different params → must be evicted everywhere.
+  int32_t Lookup(const Request& r) const {
+    auto it = index_.find(r.name);
+    if (it == index_.end()) return kMiss;
+    return it->second.params.Matches(r) ? it->second.position : kInvalid;
+  }
+
+  const CachedParams* ParamsAt(int32_t position) const {
+    auto it = by_position_.find(position);
+    return it == by_position_.end() ? nullptr : &index_.at(it->second).params;
+  }
+  const std::string& NameAt(int32_t position) const {
+    return by_position_.at(position);
+  }
+  int32_t PositionOf(const std::string& name) const {
+    auto it = index_.find(name);
+    return it == index_.end() ? kMiss : it->second.position;
+  }
+  // Evict by position; returns the evicted name ("" if not present).
+  std::string EvictPosition(int32_t position) {
+    auto it = by_position_.find(position);
+    if (it == by_position_.end()) return "";
+    std::string name = it->second;
+    Evict(name);
+    return name;
+  }
+
+  // Insert after execution (same order on all ranks). Returns position.
+  int32_t Insert(const std::string& name, const CachedParams& p) {
+    auto it = index_.find(name);
+    if (it != index_.end()) {
+      it->second.params = p;
+      Touch(name);
+      return it->second.position;
+    }
+    if (index_.size() >= capacity_) EvictLRU();
+    int32_t pos = next_position_++;
+    index_[name] = Entry{p, pos};
+    by_position_[pos] = name;
+    lru_.push_back(name);
+    return pos;
+  }
+
+  void Touch(const std::string& name) {
+    lru_.remove(name);
+    lru_.push_back(name);
+  }
+
+  void Evict(const std::string& name) {
+    auto it = index_.find(name);
+    if (it == index_.end()) return;
+    by_position_.erase(it->second.position);
+    lru_.remove(name);
+    index_.erase(it);
+  }
+
+  size_t size() const { return index_.size(); }
+
+  // Dense bitvector over live positions; positions are monotonically
+  // assigned, so the bit index is the position itself (sparse but bounded
+  // by total distinct tensors; fine for the control plane frame).
+  int32_t max_position() const { return next_position_; }
+
+ private:
+  void EvictLRU() {
+    if (lru_.empty()) return;
+    Evict(lru_.front());
+  }
+
+  struct Entry {
+    CachedParams params;
+    int32_t position;
+  };
+  size_t capacity_;
+  int32_t next_position_ = 0;
+  std::unordered_map<std::string, Entry> index_;
+  std::unordered_map<int32_t, std::string> by_position_;
+  std::list<std::string> lru_;  // front = least recently used
+};
+
+}  // namespace hvt

@@ -1,0 +1,141 @@
+// Core types for the hvt engine — the TPU-native counterpart of the
+// reference's framework-agnostic abstractions (horovod/common/common.h:
+// Status:134, TensorShape:170, DataType in message.h:30).
+//
+// Design note: this engine serves the *eager, cross-process* path (metrics,
+// parameter broadcast, the torch binding, CPU-only jobs). The TPU training
+// hot path compiles collectives into the XLA program and never enters this
+// code; that split is the core architectural decision of the port (see
+// horovod_tpu/ops/collective_ops.py docstring).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace hvt {
+
+enum class StatusType : uint8_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+struct Status {
+  StatusType type = StatusType::OK;
+  std::string reason;
+
+  static Status OK() { return Status{}; }
+  static Status Error(const std::string& msg) {
+    return Status{StatusType::UNKNOWN_ERROR, msg};
+  }
+  static Status PreconditionError(const std::string& msg) {
+    return Status{StatusType::PRECONDITION_ERROR, msg};
+  }
+  static Status InvalidArgument(const std::string& msg) {
+    return Status{StatusType::INVALID_ARGUMENT, msg};
+  }
+  static Status Aborted(const std::string& msg) {
+    return Status{StatusType::ABORTED, msg};
+  }
+  bool ok() const { return type == StatusType::OK; }
+};
+
+// Wire dtype ids — stable across the ctypes boundary (numpy interop in
+// horovod_tpu/engine/native.py).
+enum class DataType : uint8_t {
+  UINT8 = 0,
+  INT8 = 1,
+  INT32 = 4,
+  INT64 = 5,
+  FLOAT16 = 6,
+  FLOAT32 = 7,
+  FLOAT64 = 8,
+  BOOL = 9,
+  BFLOAT16 = 10,
+};
+
+inline size_t DataTypeSize(DataType d) {
+  switch (d) {
+    case DataType::UINT8:
+    case DataType::INT8:
+    case DataType::BOOL:
+      return 1;
+    case DataType::FLOAT16:
+    case DataType::BFLOAT16:
+      return 2;
+    case DataType::INT32:
+    case DataType::FLOAT32:
+      return 4;
+    case DataType::INT64:
+    case DataType::FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+enum class OpType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  ALLTOALL = 3,
+  REDUCESCATTER = 4,
+  JOIN = 5,
+  BARRIER = 6,
+};
+
+enum class ReduceKind : uint8_t {
+  SUM = 0,
+  AVERAGE = 1,
+  MIN = 2,
+  MAX = 3,
+  PRODUCT = 4,
+  ADASUM = 5,
+};
+
+struct TensorShape {
+  std::vector<int64_t> dims;
+  int64_t num_elements() const {
+    int64_t n = 1;
+    for (auto d : dims) n *= d;
+    return n;
+  }
+  bool operator==(const TensorShape& o) const { return dims == o.dims; }
+  std::string DebugString() const {
+    std::string s = "[";
+    for (size_t i = 0; i < dims.size(); ++i) {
+      if (i) s += ",";
+      s += std::to_string(dims[i]);
+    }
+    return s + "]";
+  }
+};
+
+// A pending collective submitted by a client thread — the analog of
+// TensorTableEntry (reference common.h:237). Owns copies of the payload so
+// client buffers can be released immediately.
+struct TensorTableEntry {
+  std::string name;
+  int32_t handle = -1;
+  OpType op = OpType::ALLREDUCE;
+  ReduceKind reduce = ReduceKind::SUM;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  int32_t root_rank = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<uint8_t> input;           // payload
+  std::vector<int64_t> splits;          // alltoallv send splits (rows)
+  std::vector<uint8_t> output;          // filled by the op
+  std::vector<int64_t> recv_splits;     // alltoallv result splits
+};
+
+using EntryPtr = std::shared_ptr<TensorTableEntry>;
+
+}  // namespace hvt

@@ -1,0 +1,863 @@
+#include "engine.h"
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <set>
+#include <sstream>
+
+namespace hvt {
+
+static double NowSec() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+static int64_t EnvInt(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  return v ? atoll(v) : dflt;
+}
+
+Engine& Engine::Get() {
+  static Engine* engine = new Engine();
+  return *engine;
+}
+
+// --------------------------------------------------------------------------
+// init / rendezvous / mesh bring-up
+// --------------------------------------------------------------------------
+
+Status Engine::Init(int rank, int size, const std::string& master_addr,
+                    int master_port, int cycle_ms) {
+  if (initialized_.load()) return Status::OK();
+  rank_ = rank;
+  size_ = size;
+  cycle_ms_ = cycle_ms > 0 ? cycle_ms : 2;
+  fusion_threshold_ = EnvInt("HVT_FUSION_THRESHOLD", 64 << 20);
+  stall_warn_sec_ =
+      static_cast<double>(EnvInt("HVT_STALL_WARN_SEC", 60));
+  cache_ = ResponseCache(
+      static_cast<size_t>(EnvInt("HVT_CACHE_CAPACITY", 1024)));
+  try {
+    if (size_ > 1) {
+      data_listener_.Listen(0);
+      const char* host_env = getenv("HVT_HOSTNAME");
+      std::string my_host = host_env ? host_env : "127.0.0.1";
+      std::string my_ep =
+          my_host + ":" + std::to_string(data_listener_.port());
+
+      // endpoint exchange over the control star (the rendezvous;
+      // reference analog: gloo HTTP-store scoped KV, gloo_context.cc)
+      std::vector<std::string> endpoints(size_);
+      if (rank_ == 0) {
+        Listener control_listener;
+        control_listener.Listen(master_port);
+        endpoints[0] = my_ep;
+        workers_.resize(size_);
+        for (int i = 0; i < size_ - 1; ++i) {
+          Sock s = control_listener.Accept();
+          auto frame = s.RecvFrame();
+          Reader rd(frame);
+          int32_t r = rd.i32();
+          endpoints[r] = rd.str();
+          workers_[r] = std::move(s);
+        }
+        Writer w;
+        for (auto& ep : endpoints) w.str(ep);
+        for (int r = 1; r < size_; ++r) workers_[r].SendFrame(w.buf);
+      } else {
+        control_ = Sock::Connect(master_addr, master_port);
+        Writer w;
+        w.i32(rank_);
+        w.str(my_ep);
+        control_.SendFrame(w.buf);
+        auto frame = control_.RecvFrame();
+        Reader rd(frame);
+        for (auto& ep : endpoints) ep = rd.str();
+      }
+
+      // full data mesh: i connects to j for i < j; acceptor learns the
+      // peer's rank from a 4-byte hello
+      std::vector<Sock> peers(size_);
+      int to_accept = rank_;  // ranks below me dial in
+      for (int j = rank_ + 1; j < size_; ++j) {
+        auto pos = endpoints[j].rfind(':');
+        std::string host = endpoints[j].substr(0, pos);
+        int port = atoi(endpoints[j].c_str() + pos + 1);
+        Sock s = Sock::Connect(host, port);
+        int32_t me = rank_;
+        s.SendAll(&me, 4);
+        peers[j] = std::move(s);
+      }
+      for (int k = 0; k < to_accept; ++k) {
+        Sock s = data_listener_.Accept();
+        int32_t who = -1;
+        s.RecvAll(&who, 4);
+        peers[who] = std::move(s);
+      }
+      data_ = std::make_unique<DataPlane>(rank_, size_, std::move(peers));
+    } else {
+      data_ = std::make_unique<DataPlane>(0, 1, std::vector<Sock>{});
+    }
+  } catch (const std::exception& e) {
+    return Status::Error(std::string("hvt init failed: ") + e.what());
+  }
+  rank_joined_.assign(size_, false);
+  rank_shutdown_.assign(size_, false);
+  hit_pending_.assign(size_, {});
+  pending_evictions_.clear();
+  announced_.clear();
+  shutdown_requested_ = false;
+  fatal_ = false;
+  initialized_ = true;
+  thread_ = std::thread([this] { ThreadLoop(); });
+  return Status::OK();
+}
+
+void Engine::Shutdown() {
+  if (!initialized_.load()) return;
+  shutdown_requested_ = true;
+  if (thread_.joinable()) thread_.join();
+  workers_.clear();
+  control_.Close();
+  data_.reset();
+  data_listener_.Close();
+  initialized_ = false;
+  // reset engine-thread state for a potential re-init (elastic restart)
+  pending_.clear();
+  counts_.clear();
+  cache_ = ResponseCache(1024);
+  join_pending_ = false;
+  join_entry_.reset();
+  last_join_rank_ = -1;
+  announced_.clear();
+  counts_.clear();
+  stall_warned_.clear();
+}
+
+// --------------------------------------------------------------------------
+// submission / handles
+// --------------------------------------------------------------------------
+
+int32_t Engine::Submit(EntryPtr entry) {
+  if (!initialized_.load()) return -1;
+  int32_t h;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    h = next_handle_++;
+    handles_[h] = HandleState{};
+  }
+  entry->handle = h;
+  if (fatal_.load()) {
+    CompleteEntry(entry, Status::Aborted("hvt engine failed earlier"));
+    return h;
+  }
+  {
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    submitted_.push_back(std::move(entry));
+  }
+  return h;
+}
+
+bool Engine::Poll(int32_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(handle);
+  return it == handles_.end() || it->second.done;
+}
+
+HandleState Engine::Wait(int32_t handle) {
+  std::unique_lock<std::mutex> lk(handles_mu_);
+  handles_cv_.wait(lk, [&] {
+    auto it = handles_.find(handle);
+    return it == handles_.end() || it->second.done;
+  });
+  auto it = handles_.find(handle);
+  return it == handles_.end() ? HandleState{} : it->second;
+}
+
+void Engine::Release(int32_t handle) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  handles_.erase(handle);
+}
+
+void Engine::CompleteEntry(const EntryPtr& e, const Status& s) {
+  std::lock_guard<std::mutex> lk(handles_mu_);
+  auto it = handles_.find(e->handle);
+  if (it == handles_.end()) return;
+  it->second.done = true;
+  it->second.status = s;
+  it->second.output = std::move(e->output);
+  it->second.recv_splits = std::move(e->recv_splits);
+  handles_cv_.notify_all();
+}
+
+void Engine::FailAll(const std::string& why) {
+  fatal_ = true;
+  for (auto& [name, e] : pending_)
+    CompleteEntry(e, Status::Aborted(why));
+  pending_.clear();
+  if (join_entry_) {
+    CompleteEntry(join_entry_, Status::Aborted(why));
+    join_entry_.reset();
+    join_pending_ = false;
+  }
+  std::lock_guard<std::mutex> lk(queue_mu_);
+  for (auto& e : submitted_) CompleteEntry(e, Status::Aborted(why));
+  submitted_.clear();
+}
+
+// --------------------------------------------------------------------------
+// cycle loop
+// --------------------------------------------------------------------------
+
+void Engine::ThreadLoop() {
+  while (true) {
+    try {
+      if (!RunCycle()) return;
+    } catch (const std::exception& e) {
+      FailAll(std::string("hvt engine: ") + e.what());
+      return;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(cycle_ms_));
+  }
+}
+
+bool Engine::RunCycle() {
+  // 1. drain submissions
+  {
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    for (auto& e : submitted_) {
+      if (e->op == OpType::JOIN) {
+        if (join_pending_) {
+          CompleteEntry(e, Status::InvalidArgument("join already pending"));
+        } else {
+          join_pending_ = true;
+          join_entry_ = e;
+        }
+        continue;
+      }
+      if (pending_.count(e->name)) {
+        // reference DUPLICATE_NAME_ERROR (common.h:165)
+        CompleteEntry(
+            e, Status::InvalidArgument(
+                   "a tensor named '" + e->name +
+                   "' is already pending; names must be unique per cycle"));
+        continue;
+      }
+      pending_[e->name] = e;
+    }
+    submitted_.clear();
+  }
+
+  // 2. build the control frame
+  uint8_t flags = 0;
+  if (shutdown_requested_.load()) flags |= 1;
+  if (join_pending_) flags |= 2;
+  std::vector<int64_t> hit_positions, invalid_positions;
+  std::vector<Request> misses;
+  for (auto& [name, e] : pending_) {
+    if (announced_.count(name)) continue;
+    Request r;
+    r.rank = rank_;
+    r.op = e->op;
+    r.reduce = e->reduce;
+    r.name = name;
+    r.dtype = e->dtype;
+    r.shape = e->shape;
+    r.root_rank = e->root_rank;
+    r.prescale = e->prescale;
+    r.postscale = e->postscale;
+    r.splits = e->splits;
+    // Only ALLREDUCE is cacheable: its execution params are fully
+    // rank-symmetric. allgather/alltoall rows vary per call and per rank.
+    int32_t pos = e->op == OpType::ALLREDUCE ? cache_.Lookup(r)
+                                             : ResponseCache::kMiss;
+    if (pos >= 0 && !join_pending_) {
+      hit_positions.push_back(pos);
+    } else {
+      if (pos == ResponseCache::kInvalid) {
+        // params changed → the whole job must evict this entry before the
+        // name can renegotiate (reference CacheCoordinator invalid bits)
+        int32_t old = cache_.PositionOf(name);
+        if (old >= 0) invalid_positions.push_back(old);
+      }
+      misses.push_back(r);
+    }
+    announced_.insert(name);
+  }
+
+  Writer w;
+  w.u8(flags);
+  w.i64vec(hit_positions);
+  w.i64vec(invalid_positions);
+  EncodeRequestList(w, misses);
+
+  // 3. exchange with the coordinator
+  std::vector<Response> responses;
+  std::vector<int64_t> evictions;
+  uint8_t resp_flags = 0;
+  if (size_ == 1) {
+    std::vector<std::vector<uint8_t>> frames;
+    frames.push_back(std::move(w.buf));
+    responses = Coordinate(frames);
+    resp_flags = rank_shutdown_[0] ? 1 : 0;
+  } else if (rank_ == 0) {
+    std::vector<std::vector<uint8_t>> frames(size_);
+    frames[0] = std::move(w.buf);
+    for (int r = 1; r < size_; ++r) frames[r] = workers_[r].RecvFrame();
+    responses = Coordinate(frames);
+    bool all_down = true;
+    for (bool b : rank_shutdown_)
+      all_down = all_down && b;
+    resp_flags = all_down ? 1 : 0;
+    // evictions gathered by Coordinate into pending_evictions_
+    Writer out;
+    out.u8(resp_flags);
+    out.i64vec(pending_evictions_);
+    EncodeResponseList(out, responses);
+    for (int r = 1; r < size_; ++r) workers_[r].SendFrame(out.buf);
+    evictions = std::move(pending_evictions_);
+    pending_evictions_.clear();
+  } else {
+    control_.SendFrame(w.buf);
+    auto frame = control_.RecvFrame();
+    Reader rd(frame);
+    resp_flags = rd.u8();
+    evictions = rd.i64vec();
+    responses = DecodeResponseList(rd);
+  }
+
+  // 4. apply evictions (cache must stay identical on every rank)
+  for (int64_t pos : evictions) {
+    if (pos < 0) continue;
+    std::string nm = cache_.EvictPosition(static_cast<int32_t>(pos));
+    // only re-announce names that are still pending (unexecuted)
+    if (!nm.empty() && pending_.count(nm)) announced_.erase(nm);
+  }
+
+  // 5. execute
+  for (auto& resp : responses) ExecuteResponse(resp, pending_);
+
+  if (rank_ == 0) CheckStalls();
+
+  if (resp_flags & 1) {
+    // coordinated shutdown: drain anything left as errors
+    for (auto& [n, e] : pending_)
+      CompleteEntry(e, Status::Aborted("hvt shut down"));
+    pending_.clear();
+    announced_.clear();
+    return false;
+  }
+  return true;
+}
+
+// --------------------------------------------------------------------------
+// coordinator (rank 0)
+// --------------------------------------------------------------------------
+
+std::vector<Response> Engine::Coordinate(
+    const std::vector<std::vector<uint8_t>>& frames) {
+  std::vector<Response> out;
+  double now = NowSec();
+
+  for (int r = 0; r < static_cast<int>(frames.size()); ++r) {
+    Reader rd(frames[r]);
+    uint8_t flags = rd.u8();
+    rank_shutdown_[r] = rank_shutdown_[r] || (flags & 1);
+    bool joined = (flags & 2) != 0;
+    rank_joined_[r] = joined;
+    auto hits = rd.i64vec();
+    auto invalids = rd.i64vec();
+    auto reqs = DecodeRequestList(rd);
+    for (auto pos : hits) hit_pending_[r].insert(pos);
+    for (auto pos : invalids)
+      if (pos >= 0) pending_evictions_.push_back(pos);
+    for (auto& q : reqs) {
+      auto& tc = counts_[q.name];
+      if (tc.seen.empty()) tc.seen.assign(size_, false);
+      if (tc.seen[r]) continue;
+      tc.seen[r] = true;
+      tc.requests.push_back(q);
+      if (tc.first_seen_sec == 0) tc.first_seen_sec = now;
+      tc.count++;
+    }
+  }
+
+  int active = 0;
+  for (int r = 0; r < size_; ++r)
+    if (!rank_joined_[r]) active++;
+
+  // JOIN: everyone joined → emit join response (workers drop their joined
+  // flag after executing it; a duplicate response in the crossover cycle
+  // is a harmless no-op)
+  {
+    bool all_joined = size_ > 0;
+    for (int r = 0; r < size_; ++r)
+      all_joined = all_joined && rank_joined_[r];
+    if (all_joined) {
+      Response j;
+      j.kind = Response::Kind::JOIN;
+      j.names = {"<join>"};
+      j.root = size_ - 1;  // deterministic last-joiner id
+      out.push_back(j);
+    }
+  }
+
+  // cache fast path: positions every rank has pending
+  if (active == size_) {
+    std::vector<int64_t> ready;
+    if (!hit_pending_.empty()) {
+      for (auto pos : hit_pending_[0]) {
+        bool all = true;
+        for (int r = 1; r < size_; ++r)
+          all = all && hit_pending_[r].count(pos);
+        if (all) ready.push_back(pos);
+      }
+    }
+    for (auto pos : ready) {
+      for (int r = 0; r < size_; ++r) hit_pending_[r].erase(pos);
+      const CachedParams* p = cache_.ParamsAt(static_cast<int32_t>(pos));
+      if (!p) continue;
+      Response resp;
+      resp.kind = Response::Kind::TENSOR;
+      resp.op = p->op;
+      resp.names = {cache_.NameAt(static_cast<int32_t>(pos))};
+      resp.dtype = p->dtype;
+      resp.reduce = p->reduce;
+      resp.root = p->root_rank;
+      resp.prescale = p->prescale;
+      resp.postscale = p->postscale;
+      resp.numels = {p->shape.num_elements()};
+      out.push_back(resp);
+    }
+  }
+
+  // slow path: tensors every active rank announced
+  std::vector<std::string> complete;
+  for (auto& [name, tc] : counts_) {
+    if (tc.count >= active && active > 0) complete.push_back(name);
+  }
+  for (auto& name : complete) {
+    auto& tc = counts_[name];
+    out.push_back(BuildResponse(tc.requests));
+    counts_.erase(name);
+  }
+
+  FuseResponses(out);
+  return out;
+}
+
+Response Engine::BuildResponse(const std::vector<Request>& reqs) {
+  // cross-rank consistency checks (reference controller.cc:481-706)
+  const Request& a = reqs[0];
+  Response resp;
+  resp.names = {a.name};
+  auto fail = [&](const std::string& why) {
+    resp.kind = Response::Kind::ERROR;
+    resp.error = why;
+    return resp;
+  };
+  for (auto& q : reqs) {
+    if (q.op != a.op)
+      return fail("mismatched collective op for tensor '" + a.name + "'");
+    if (q.dtype != a.dtype)
+      return fail("mismatched dtype for tensor '" + a.name + "'");
+    if (q.reduce != a.reduce)
+      return fail("mismatched reduce op for tensor '" + a.name + "'");
+    if (q.root_rank != a.root_rank)
+      return fail("mismatched root rank for tensor '" + a.name + "'");
+    if (q.prescale != a.prescale || q.postscale != a.postscale)
+      return fail("mismatched scale factors for tensor '" + a.name + "'");
+    bool shape_free_dim0 =
+        a.op == OpType::ALLGATHER || a.op == OpType::ALLTOALL;
+    if (shape_free_dim0) {
+      if (q.shape.dims.size() != a.shape.dims.size())
+        return fail("mismatched rank (ndims) for tensor '" + a.name + "'");
+      for (size_t d = 1; d < a.shape.dims.size(); ++d)
+        if (q.shape.dims[d] != a.shape.dims[d])
+          return fail("mismatched non-leading dims for tensor '" + a.name +
+                      "'");
+    } else if (!(q.shape == a.shape)) {
+      return fail("mismatched shape for tensor '" + a.name + "' (" +
+                  q.shape.DebugString() + " vs " + a.shape.DebugString() +
+                  ")");
+    }
+  }
+  resp.kind = Response::Kind::TENSOR;
+  resp.op = a.op;
+  resp.dtype = a.dtype;
+  resp.reduce = a.reduce;
+  resp.root = a.root_rank;
+  resp.prescale = a.prescale;
+  resp.postscale = a.postscale;
+  resp.numels = {a.shape.num_elements()};
+
+  if (a.op == OpType::BARRIER) resp.kind = Response::Kind::BARRIER;
+
+  if (a.op == OpType::ALLREDUCE && a.reduce == ReduceKind::ADASUM &&
+      (size_ & (size_ - 1)) != 0)
+    return fail("Adasum requires a power-of-two world size");
+
+  if (a.op == OpType::ALLGATHER) {
+    resp.rows_flat.assign(size_, 0);
+    for (auto& q : reqs)
+      resp.rows_flat[q.rank] = q.shape.dims.empty() ? 1 : q.shape.dims[0];
+  }
+  if (a.op == OpType::ALLTOALL) {
+    resp.rows_flat.assign(static_cast<size_t>(size_) * size_, 0);
+    for (auto& q : reqs) {
+      if (static_cast<int>(q.splits.size()) != size_)
+        return fail("alltoall splits length must equal world size for '" +
+                    a.name + "'");
+      int64_t total = 0;
+      for (auto s : q.splits) total += s;
+      if (!q.shape.dims.empty() && total != q.shape.dims[0])
+        return fail("alltoall splits must sum to dim 0 for '" + a.name +
+                    "'");
+      for (int d = 0; d < size_; ++d)
+        resp.rows_flat[static_cast<size_t>(q.rank) * size_ + d] =
+            q.splits[d];
+    }
+  }
+  if (a.op == OpType::REDUCESCATTER) {
+    int64_t rows = a.shape.dims.empty() ? 1 : a.shape.dims[0];
+    if (rows % size_ != 0)
+      return fail("reducescatter dim 0 must divide world size for '" +
+                  a.name + "'");
+  }
+  return resp;
+}
+
+void Engine::FuseResponses(std::vector<Response>& responses) {
+  // merge adjacent allreduce responses with identical execution params
+  // while the fused payload stays under the threshold (reference
+  // controller.cc:777 FuseResponses)
+  std::vector<Response> fused;
+  for (auto& r : responses) {
+    bool can_fuse =
+        !fused.empty() && r.kind == Response::Kind::TENSOR &&
+        fused.back().kind == Response::Kind::TENSOR &&
+        r.op == OpType::ALLREDUCE && fused.back().op == OpType::ALLREDUCE &&
+        r.dtype == fused.back().dtype && r.reduce == fused.back().reduce &&
+        r.prescale == fused.back().prescale &&
+        r.postscale == fused.back().postscale &&
+        r.reduce != ReduceKind::ADASUM;
+    if (can_fuse) {
+      int64_t cur = 0, add = 0;
+      for (auto n : fused.back().numels) cur += n;
+      for (auto n : r.numels) add += n;
+      int64_t el = static_cast<int64_t>(DataTypeSize(r.dtype));
+      if ((cur + add) * el <= fusion_threshold_) {
+        fused.back().names.insert(fused.back().names.end(), r.names.begin(),
+                                  r.names.end());
+        fused.back().numels.insert(fused.back().numels.end(),
+                                   r.numels.begin(), r.numels.end());
+        continue;
+      }
+    }
+    fused.push_back(std::move(r));
+  }
+  responses = std::move(fused);
+}
+
+void Engine::CheckStalls() {
+  double now = NowSec();
+  for (auto& [name, tc] : counts_) {
+    if (tc.first_seen_sec == 0 || stall_warned_[name]) continue;
+    if (now - tc.first_seen_sec > stall_warn_sec_) {
+      std::ostringstream missing;
+      for (int r = 0; r < size_; ++r)
+        if (!tc.seen[r] && !rank_joined_[r]) missing << r << " ";
+      fprintf(stderr,
+              "[hvt] WARNING: tensor '%s' was submitted by some ranks but "
+              "not by ranks [ %s] for %.0f s — possible stall (reference "
+              "stall_inspector semantics)\n",
+              name.c_str(), missing.str().c_str(),
+              now - tc.first_seen_sec);
+      stall_warned_[name] = true;
+    }
+  }
+}
+
+// --------------------------------------------------------------------------
+// execution
+// --------------------------------------------------------------------------
+
+// local Adasum tree combine over gathered per-rank vectors (fp32/fp64)
+template <typename T>
+static void AdasumTree(std::vector<std::vector<T>>& vs) {
+  int n = static_cast<int>(vs.size());
+  for (int stride = 1; stride < n; stride <<= 1) {
+    for (int base = 0; base < n; base += stride << 1) {
+      auto& a = vs[base];
+      auto& b = vs[base + stride];
+      double dot = 0, asq = 0, bsq = 0;
+      for (size_t i = 0; i < a.size(); ++i) {
+        dot += static_cast<double>(a[i]) * b[i];
+        asq += static_cast<double>(a[i]) * a[i];
+        bsq += static_cast<double>(b[i]) * b[i];
+      }
+      double ca = asq > 0 ? 1.0 - dot / (2 * asq) : 1.0;
+      double cb = bsq > 0 ? 1.0 - dot / (2 * bsq) : 1.0;
+      for (size_t i = 0; i < a.size(); ++i)
+        a[i] = static_cast<T>(ca * a[i] + cb * b[i]);
+    }
+  }
+}
+
+void Engine::ExecuteResponse(const Response& resp,
+                             std::map<std::string, EntryPtr>& pending) {
+  auto take = [&](const std::string& name) -> EntryPtr {
+    auto it = pending.find(name);
+    if (it == pending.end()) return nullptr;
+    EntryPtr e = it->second;
+    pending.erase(it);
+    announced_.erase(name);
+    return e;
+  };
+
+  switch (resp.kind) {
+    case Response::Kind::ERROR: {
+      for (auto& name : resp.names) {
+        auto e = take(name);
+        if (e) CompleteEntry(e, Status::PreconditionError(resp.error));
+      }
+      return;
+    }
+    case Response::Kind::BARRIER: {
+      auto e = take(resp.names[0]);
+      if (e) CompleteEntry(e, Status::OK());
+      return;
+    }
+    case Response::Kind::JOIN: {
+      if (join_entry_) {
+        join_entry_->output.clear();
+        HandleState hs;
+        {
+          std::lock_guard<std::mutex> lk(handles_mu_);
+          auto it = handles_.find(join_entry_->handle);
+          if (it != handles_.end()) {
+            it->second.join_result = resp.root;
+            it->second.done = true;
+            it->second.status = Status::OK();
+          }
+          handles_cv_.notify_all();
+        }
+        join_entry_.reset();
+      }
+      join_pending_ = false;
+      // join + cache interact badly (reference controller.cc:87-120);
+      // clearing keeps every rank's cache identical afterwards
+      cache_ = ResponseCache(1024);
+      if (rank_ == 0)
+        for (auto& s : hit_pending_) s.clear();
+      return;
+    }
+    case Response::Kind::TENSOR:
+      break;
+  }
+
+  const size_t el = DataTypeSize(resp.dtype);
+  switch (resp.op) {
+    case OpType::ALLREDUCE: {
+      if (resp.reduce == ReduceKind::ADASUM) {
+        auto e = take(resp.names[0]);
+        int64_t numel = resp.numels[0];
+        std::vector<uint8_t> mine(numel * el, 0);
+        if (e) memcpy(mine.data(), e->input.data(), mine.size());
+        std::vector<uint8_t> gathered(mine.size() * size_);
+        std::vector<int64_t> rows(size_, numel);
+        data_->Allgatherv(mine.data(), numel, rows,
+                          static_cast<int64_t>(el), gathered.data());
+        if (resp.dtype == DataType::FLOAT32) {
+          std::vector<std::vector<float>> vs(size_);
+          for (int r = 0; r < size_; ++r) {
+            vs[r].resize(numel);
+            memcpy(vs[r].data(), gathered.data() + r * mine.size(),
+                   mine.size());
+          }
+          AdasumTree(vs);
+          if (e) {
+            e->output.resize(mine.size());
+            memcpy(e->output.data(), vs[0].data(), mine.size());
+          }
+        } else if (resp.dtype == DataType::FLOAT64) {
+          std::vector<std::vector<double>> vs(size_);
+          for (int r = 0; r < size_; ++r) {
+            vs[r].resize(numel);
+            memcpy(vs[r].data(), gathered.data() + r * mine.size(),
+                   mine.size());
+          }
+          AdasumTree(vs);
+          if (e) {
+            e->output.resize(mine.size());
+            memcpy(e->output.data(), vs[0].data(), mine.size());
+          }
+        } else {
+          if (e)
+            CompleteEntry(e, Status::InvalidArgument(
+                                 "Adasum supports float32/float64"));
+          return;
+        }
+        if (e) CompleteEntry(e, Status::OK());
+        return;
+      }
+
+      // fused path: pack → (prescale) → ring → (postscale) → unpack
+      int64_t total = 0;
+      for (auto n : resp.numels) total += n;
+      fusion_buffer_.resize(static_cast<size_t>(total) * el);
+      std::vector<EntryPtr> entries(resp.names.size());
+      int64_t off = 0;
+      for (size_t i = 0; i < resp.names.size(); ++i) {
+        entries[i] = take(resp.names[i]);
+        size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
+        if (entries[i]) {
+          memcpy(fusion_buffer_.data() + off, entries[i]->input.data(),
+                 bytes);
+        } else {
+          memset(fusion_buffer_.data() + off, 0, bytes);  // joined stand-in
+        }
+        off += bytes;
+      }
+      if (resp.prescale != 1.0)
+        ScaleBuffer(fusion_buffer_.data(), total, resp.dtype,
+                    resp.prescale);
+      data_->Allreduce(fusion_buffer_.data(), total, resp.dtype,
+                       resp.reduce);
+      double post = resp.postscale;
+      if (resp.reduce == ReduceKind::AVERAGE) post /= size_;
+      if (post != 1.0)
+        ScaleBuffer(fusion_buffer_.data(), total, resp.dtype, post);
+      off = 0;
+      for (size_t i = 0; i < resp.names.size(); ++i) {
+        size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
+        if (entries[i]) {
+          entries[i]->output.assign(fusion_buffer_.data() + off,
+                                    fusion_buffer_.data() + off + bytes);
+          // every rank inserts in the same order → identical caches
+          CachedParams p{resp.op,      resp.reduce,    resp.dtype,
+                         entries[i]->shape, resp.root, resp.prescale,
+                         resp.postscale, entries[i]->splits};
+          if (!join_pending_) cache_.Insert(resp.names[i], p);
+          CompleteEntry(entries[i], Status::OK());
+        }
+        off += bytes;
+      }
+      return;
+    }
+
+    case OpType::ALLGATHER: {
+      auto e = take(resp.names[0]);
+      std::vector<int64_t> rows(resp.rows_flat.begin(),
+                                resp.rows_flat.begin() + size_);
+      int64_t trailing = 1;  // elements per row
+      if (e) {
+        for (size_t d = 1; d < e->shape.dims.size(); ++d)
+          trailing *= e->shape.dims[d];
+      } else {
+        int64_t rows0 = 0;
+        for (int r = 0; r < size_; ++r)
+          if (rows[r] > 0) {
+            rows0 = rows[r];
+            break;
+          }
+        trailing = rows0 > 0 ? resp.numels[0] / rows0 : 1;
+      }
+      int64_t row_bytes = trailing * static_cast<int64_t>(el);
+      int64_t my_rows =
+          (e && !e->shape.dims.empty()) ? e->shape.dims[0] : 0;
+      int64_t total_rows = 0;
+      for (auto r : rows) total_rows += r;
+      std::vector<uint8_t> out(static_cast<size_t>(total_rows) * row_bytes);
+      const void* in = e ? static_cast<const void*>(e->input.data())
+                         : static_cast<const void*>(out.data());
+      data_->Allgatherv(in, my_rows, rows, row_bytes, out.data());
+      if (e) {
+        e->output = std::move(out);
+        e->recv_splits = rows;
+        CompleteEntry(e, Status::OK());
+      }
+      return;
+    }
+
+    case OpType::BROADCAST: {
+      auto e = take(resp.names[0]);
+      size_t bytes = static_cast<size_t>(resp.numels[0]) * el;
+      std::vector<uint8_t> buf(bytes, 0);
+      if (e) memcpy(buf.data(), e->input.data(), bytes);
+      data_->Broadcast(buf.data(), static_cast<int64_t>(bytes), resp.root);
+      if (e) {
+        e->output = std::move(buf);
+        CompleteEntry(e, Status::OK());
+      }
+      return;
+    }
+
+    case OpType::ALLTOALL: {
+      auto e = take(resp.names[0]);
+      // rows_flat: sender-major size x size matrix
+      std::vector<int64_t> send_rows(size_, 0), recv_rows(size_, 0);
+      for (int d = 0; d < size_; ++d)
+        send_rows[d] =
+            resp.rows_flat[static_cast<size_t>(rank_) * size_ + d];
+      for (int s = 0; s < size_; ++s)
+        recv_rows[s] =
+            resp.rows_flat[static_cast<size_t>(s) * size_ + rank_];
+      int64_t my_rows = 0;
+      for (auto r : send_rows) my_rows += r;
+      int64_t row_bytes = static_cast<int64_t>(el);
+      if (e && !e->shape.dims.empty() && e->shape.dims[0] > 0)
+        row_bytes =
+            (e->shape.num_elements() / e->shape.dims[0]) *
+            static_cast<int64_t>(el);
+      int64_t total_recv = 0;
+      for (auto r : recv_rows) total_recv += r;
+      std::vector<uint8_t> out(static_cast<size_t>(total_recv) * row_bytes);
+      const void* in = e ? static_cast<const void*>(e->input.data())
+                         : static_cast<const void*>(out.data());
+      data_->Alltoallv(in, send_rows, row_bytes, out.data(), recv_rows);
+      if (e) {
+        e->output = std::move(out);
+        e->recv_splits = recv_rows;
+        CompleteEntry(e, Status::OK());
+      }
+      return;
+    }
+
+    case OpType::REDUCESCATTER: {
+      auto e = take(resp.names[0]);
+      int64_t numel = resp.numels[0];
+      std::vector<uint8_t> buf(static_cast<size_t>(numel) * el, 0);
+      if (e) memcpy(buf.data(), e->input.data(), buf.size());
+      if (resp.prescale != 1.0)
+        ScaleBuffer(buf.data(), numel, resp.dtype, resp.prescale);
+      data_->Allreduce(buf.data(), numel, resp.dtype,
+                       resp.reduce == ReduceKind::AVERAGE
+                           ? ReduceKind::SUM
+                           : resp.reduce);
+      double rs_post = resp.postscale;
+      if (resp.reduce == ReduceKind::AVERAGE) rs_post /= size_;
+      if (rs_post != 1.0)
+        ScaleBuffer(buf.data(), numel, resp.dtype, rs_post);
+      if (e) {
+        int64_t rows = e->shape.dims.empty() ? 1 : e->shape.dims[0];
+        int64_t row_bytes = (e->shape.num_elements() / rows) *
+                            static_cast<int64_t>(el);
+        int64_t chunk_rows = rows / size_;
+        size_t chunk_bytes = static_cast<size_t>(chunk_rows) * row_bytes;
+        e->output.assign(
+            buf.data() + static_cast<size_t>(rank_) * chunk_bytes,
+            buf.data() + static_cast<size_t>(rank_ + 1) * chunk_bytes);
+        CompleteEntry(e, Status::OK());
+      }
+      return;
+    }
+
+    default:
+      return;
+  }
+}
+
+}  // namespace hvt

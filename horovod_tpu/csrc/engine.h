@@ -1,0 +1,134 @@
+// The background engine — counterpart of the reference's
+// BackgroundThreadLoop / RunLoopOnce / PerformOperation
+// (horovod/common/operations.cc:356,587,253) plus the rank-0 coordinator
+// protocol (horovod/common/controller.cc:69 ComputeResponseList).
+//
+// One engine per process. Client threads submit TensorTableEntry and get an
+// integer handle; the engine thread runs a cycle loop:
+//
+//   1. drain the submission queue into the pending table
+//   2. control-plane exchange with rank 0 (cache-hit positions, cache
+//      invalidations, full requests for cache misses, shutdown/join flags)
+//   3. rank 0: AND cache-hit sets, count per-tensor readiness, run
+//      cross-rank consistency checks, fuse, order → ResponseList
+//   4. every rank executes the identical ResponseList against the data
+//      plane (ring collectives), fills outputs, completes handles
+//
+// Consistency checks turn cross-rank mismatches (dtype/shape/op/root) into
+// per-tensor ERROR responses instead of deadlocks, matching
+// controller.cc:481-706. The stall inspector (stall_inspector.h lineage)
+// warns from rank 0 when some ranks submitted a tensor and others haven't.
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "cache.h"
+#include "common.h"
+#include "net.h"
+#include "ring_ops.h"
+#include "wire.h"
+
+namespace hvt {
+
+struct HandleState {
+  bool done = false;
+  Status status;
+  std::vector<uint8_t> output;
+  std::vector<int64_t> recv_splits;
+  int32_t join_result = -1;
+};
+
+class Engine {
+ public:
+  static Engine& Get();
+
+  Status Init(int rank, int size, const std::string& master_addr,
+              int master_port, int cycle_ms);
+  void Shutdown();
+  bool initialized() const { return initialized_.load(); }
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Returns handle (>=0) or -1 when not initialized.
+  int32_t Submit(EntryPtr entry);
+
+  bool Poll(int32_t handle);
+  // Blocks; returns snapshot of the handle state.
+  HandleState Wait(int32_t handle);
+  void Release(int32_t handle);
+
+ private:
+  Engine() = default;
+  void ThreadLoop();
+  bool RunCycle();  // false → exit loop
+  void ExecuteResponse(const Response& resp,
+                       std::map<std::string, EntryPtr>& pending);
+  void CompleteEntry(const EntryPtr& e, const Status& s);
+  void FailAll(const std::string& why);
+
+  // coordinator (rank 0) state + logic
+  struct TensorCount {
+    std::vector<Request> requests;  // one per reporting rank
+    std::vector<bool> seen;
+    double first_seen_sec = 0;
+    int count = 0;
+  };
+  std::vector<Response> Coordinate(
+      const std::vector<std::vector<uint8_t>>& frames);
+  Response BuildResponse(const std::vector<Request>& reqs);
+  void FuseResponses(std::vector<Response>& responses);
+  void CheckStalls();
+
+  // control plane
+  Sock control_;                 // workers: connection to rank 0
+  std::vector<Sock> workers_;    // rank 0: connections from workers
+  std::unique_ptr<DataPlane> data_;
+  Listener data_listener_;
+
+  int rank_ = 0, size_ = 1, cycle_ms_ = 2;
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<bool> fatal_{false};
+  std::thread thread_;
+
+  std::mutex queue_mu_;
+  std::deque<EntryPtr> submitted_;
+
+  std::mutex handles_mu_;
+  std::condition_variable handles_cv_;
+  std::unordered_map<int32_t, HandleState> handles_;
+  int32_t next_handle_ = 0;
+
+  // engine-thread-only state
+  std::map<std::string, EntryPtr> pending_;  // ordered for determinism
+  std::set<std::string> announced_;  // names already sent to coordinator
+  ResponseCache cache_{1024};
+  bool join_pending_ = false;
+  EntryPtr join_entry_;
+
+  // rank-0-only state
+  std::map<std::string, TensorCount> counts_;
+  std::vector<bool> rank_joined_;
+  std::vector<bool> rank_shutdown_;
+  std::vector<std::set<int64_t>> hit_pending_;  // per rank, cache positions
+  std::vector<int64_t> pending_evictions_;
+  int last_join_rank_ = -1;
+  int64_t fusion_threshold_ = 64 << 20;
+  double stall_warn_sec_ = 60.0;
+  std::map<std::string, bool> stall_warned_;
+
+  std::vector<uint8_t> fusion_buffer_;
+};
+
+}  // namespace hvt

@@ -1,0 +1,156 @@
+// TCP transport — the engine's DCN fabric. Replaces the reference's
+// MPI/Gloo contexts (horovod/common/mpi/mpi_context.h:96,
+// horovod/common/gloo/gloo_context.cc): a control star (workers → rank 0)
+// plus a lazily-connected full mesh for the data plane. Endpoint discovery
+// happens over the control star at init, the analog of the Gloo HTTP-store
+// rendezvous.
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace hvt {
+
+class Sock {
+ public:
+  Sock() = default;
+  explicit Sock(int fd) : fd_(fd) {}
+  Sock(const Sock&) = delete;
+  Sock& operator=(const Sock&) = delete;
+  Sock(Sock&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  Sock& operator=(Sock&& o) noexcept {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+    return *this;
+  }
+  ~Sock() { Close(); }
+
+  void Close() {
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+
+  void SendAll(const void* data, size_t n) const {
+    auto* p = static_cast<const uint8_t*>(data);
+    while (n > 0) {
+      ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
+      if (k <= 0) throw std::runtime_error("hvt: send failed (peer lost)");
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+  }
+  void RecvAll(void* data, size_t n) const {
+    auto* p = static_cast<uint8_t*>(data);
+    while (n > 0) {
+      ssize_t k = ::recv(fd_, p, n, 0);
+      if (k <= 0) throw std::runtime_error("hvt: recv failed (peer lost)");
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+  }
+  // Length-prefixed frames for control messages.
+  void SendFrame(const std::vector<uint8_t>& b) const {
+    uint64_t n = b.size();
+    SendAll(&n, 8);
+    if (n) SendAll(b.data(), n);
+  }
+  std::vector<uint8_t> RecvFrame() const {
+    uint64_t n = 0;
+    RecvAll(&n, 8);
+    std::vector<uint8_t> b(n);
+    if (n) RecvAll(b.data(), n);
+    return b;
+  }
+
+  static Sock Connect(const std::string& host, int port,
+                      int timeout_sec = 60) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string p = std::to_string(port);
+    if (getaddrinfo(host.c_str(), p.c_str(), &hints, &res) != 0 || !res)
+      throw std::runtime_error("hvt: getaddrinfo failed for " + host);
+    int fd = -1;
+    // retry loop: peers come up in arbitrary order
+    for (int attempt = 0; attempt < timeout_sec * 10; ++attempt) {
+      fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd < 0) continue;
+      if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+      ::close(fd);
+      fd = -1;
+      struct timespec ts {0, 100000000};  // 100 ms
+      nanosleep(&ts, nullptr);
+    }
+    freeaddrinfo(res);
+    if (fd < 0)
+      throw std::runtime_error("hvt: connect to " + host + ":" + p +
+                               " timed out");
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return Sock(fd);
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+class Listener {
+ public:
+  // port==0 → ephemeral; bound port readable via port().
+  void Listen(int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("hvt: socket() failed");
+    int one = 1;
+    setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("hvt: bind failed on port " +
+                               std::to_string(port));
+    if (::listen(fd_, 128) != 0)
+      throw std::runtime_error("hvt: listen failed");
+    socklen_t len = sizeof(addr);
+    getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+  }
+  Sock Accept() const {
+    int c = ::accept(fd_, nullptr, nullptr);
+    if (c < 0) throw std::runtime_error("hvt: accept failed");
+    int one = 1;
+    setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return Sock(c);
+  }
+  int port() const { return port_; }
+  void Close() {
+    if (fd_ >= 0) {
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+  ~Listener() { Close(); }
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+}  // namespace hvt

@@ -1,0 +1,340 @@
+#include "ring_ops.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <stdexcept>
+
+namespace hvt {
+
+// ---- fp16 / bf16 widening helpers -----------------------------------------
+
+static inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (man == 0) {
+      f = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((man & 0x400u) == 0) {
+        man <<= 1;
+        exp--;
+      }
+      man &= 0x3ffu;
+      f = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 0x1f) {
+    f = sign | 0x7f800000u | (man << 13);
+  } else {
+    f = sign | ((exp + 127 - 15) << 23) | (man << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+static inline uint16_t FloatToHalf(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t sign = (f >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((f >> 23) & 0xff) - 127 + 15;
+  uint32_t man = f & 0x7fffffu;
+  if (exp >= 0x1f) return static_cast<uint16_t>(sign | 0x7c00u);  // inf
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    man |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    return static_cast<uint16_t>(sign | (man >> shift));
+  }
+  return static_cast<uint16_t>(sign | (exp << 10) | (man >> 13));
+}
+
+static inline float Bf16ToFloat(uint16_t h) {
+  uint32_t f = static_cast<uint32_t>(h) << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+static inline uint16_t FloatToBf16(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  // round-to-nearest-even
+  uint32_t rounding = 0x7fffu + ((f >> 16) & 1);
+  return static_cast<uint16_t>((f + rounding) >> 16);
+}
+
+// ---- elementwise reductions ------------------------------------------------
+
+template <typename T>
+static void ReduceTyped(T* dst, const T* src, int64_t n, ReduceKind red) {
+  switch (red) {
+    case ReduceKind::SUM:
+    case ReduceKind::AVERAGE:  // averaged via postscale after the ring
+    case ReduceKind::ADASUM:   // engine lowers adasum to scalar+sum phases
+      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] + src[i];
+      break;
+    case ReduceKind::MIN:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceKind::MAX:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceKind::PRODUCT:
+      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] * src[i];
+      break;
+  }
+}
+
+template <typename T, float (*ToF)(T), T (*FromF)(float)>
+static void ReduceHalfTyped(T* dst, const T* src, int64_t n,
+                            ReduceKind red) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = ToF(dst[i]), b = ToF(src[i]), r;
+    switch (red) {
+      case ReduceKind::MIN:
+        r = std::min(a, b);
+        break;
+      case ReduceKind::MAX:
+        r = std::max(a, b);
+        break;
+      case ReduceKind::PRODUCT:
+        r = a * b;
+        break;
+      default:
+        r = a + b;
+        break;
+    }
+    dst[i] = FromF(r);
+  }
+}
+
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
+                ReduceKind red) {
+  switch (dtype) {
+    case DataType::FLOAT32:
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src),
+                  count, red);
+      break;
+    case DataType::FLOAT64:
+      ReduceTyped(static_cast<double*>(dst),
+                  static_cast<const double*>(src), count, red);
+      break;
+    case DataType::INT32:
+      ReduceTyped(static_cast<int32_t*>(dst),
+                  static_cast<const int32_t*>(src), count, red);
+      break;
+    case DataType::INT64:
+      ReduceTyped(static_cast<int64_t*>(dst),
+                  static_cast<const int64_t*>(src), count, red);
+      break;
+    case DataType::UINT8:
+      ReduceTyped(static_cast<uint8_t*>(dst),
+                  static_cast<const uint8_t*>(src), count, red);
+      break;
+    case DataType::INT8:
+      ReduceTyped(static_cast<int8_t*>(dst),
+                  static_cast<const int8_t*>(src), count, red);
+      break;
+    case DataType::BOOL: {
+      auto* d = static_cast<uint8_t*>(dst);
+      auto* s = static_cast<const uint8_t*>(src);
+      // bool sum == logical or; product/min == and; max == or
+      for (int64_t i = 0; i < count; ++i) {
+        bool a = d[i], b = s[i];
+        bool r = (red == ReduceKind::MIN || red == ReduceKind::PRODUCT)
+                     ? (a && b)
+                     : (a || b);
+        d[i] = r ? 1 : 0;
+      }
+      break;
+    }
+    case DataType::FLOAT16:
+      ReduceHalfTyped<uint16_t, HalfToFloat, FloatToHalf>(
+          static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+          count, red);
+      break;
+    case DataType::BFLOAT16:
+      ReduceHalfTyped<uint16_t, Bf16ToFloat, FloatToBf16>(
+          static_cast<uint16_t*>(dst), static_cast<const uint16_t*>(src),
+          count, red);
+      break;
+  }
+}
+
+void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DataType::FLOAT32: {
+      auto* d = static_cast<float*>(dst);
+      for (int64_t i = 0; i < count; ++i) d[i] *= static_cast<float>(factor);
+      break;
+    }
+    case DataType::FLOAT64: {
+      auto* d = static_cast<double*>(dst);
+      for (int64_t i = 0; i < count; ++i) d[i] *= factor;
+      break;
+    }
+    case DataType::FLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < count; ++i)
+        d[i] = FloatToHalf(HalfToFloat(d[i]) * static_cast<float>(factor));
+      break;
+    }
+    case DataType::BFLOAT16: {
+      auto* d = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < count; ++i)
+        d[i] = FloatToBf16(Bf16ToFloat(d[i]) * static_cast<float>(factor));
+      break;
+    }
+    case DataType::INT32: {
+      auto* d = static_cast<int32_t*>(dst);
+      for (int64_t i = 0; i < count; ++i)
+        d[i] = static_cast<int32_t>(d[i] * factor);
+      break;
+    }
+    case DataType::INT64: {
+      auto* d = static_cast<int64_t*>(dst);
+      for (int64_t i = 0; i < count; ++i)
+        d[i] = static_cast<int64_t>(d[i] * factor);
+      break;
+    }
+    default:
+      throw std::runtime_error("hvt: scale unsupported for dtype");
+  }
+}
+
+// ---- collectives -----------------------------------------------------------
+
+void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
+                          ReduceKind red) {
+  if (size_ == 1 || count == 0) return;
+  const size_t el = DataTypeSize(dtype);
+  auto* bytes = static_cast<uint8_t*>(buf);
+  const int n = size_;
+  // segment boundaries (element granularity)
+  std::vector<int64_t> seg_off(n + 1);
+  for (int i = 0; i <= n; ++i) seg_off[i] = count * i / n;
+
+  const int next = (rank_ + 1) % n;
+  const int prev = (rank_ + n - 1) % n;
+  int64_t max_seg = 0;
+  for (int i = 0; i < n; ++i)
+    max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
+  scratch_.resize(static_cast<size_t>(max_seg) * el);
+
+  // reduce-scatter: after N-1 steps, rank r owns fully-reduced segment
+  // (r+1) % n
+  for (int step = 0; step < n - 1; ++step) {
+    int send_seg = (rank_ - step + n) % n;
+    int recv_seg = (rank_ - step - 1 + n) % n;
+    int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
+    int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
+    // full-duplex: send to next, recv from prev (rank parity ordering
+    // avoids head-of-line deadlock on blocking sockets for small frames)
+    if (rank_ % 2 == 0) {
+      peer(next).SendAll(bytes + seg_off[send_seg] * el,
+                         static_cast<size_t>(send_n) * el);
+      peer(prev).RecvAll(scratch_.data(), static_cast<size_t>(recv_n) * el);
+    } else {
+      peer(prev).RecvAll(scratch_.data(), static_cast<size_t>(recv_n) * el);
+      peer(next).SendAll(bytes + seg_off[send_seg] * el,
+                         static_cast<size_t>(send_n) * el);
+    }
+    ReduceInto(bytes + seg_off[recv_seg] * el, scratch_.data(), recv_n,
+               dtype, red);
+  }
+  // allgather ring: rotate owned segments
+  for (int step = 0; step < n - 1; ++step) {
+    int send_seg = (rank_ + 1 - step + n) % n;
+    int recv_seg = (rank_ - step + n) % n;
+    int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
+    int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
+    if (rank_ % 2 == 0) {
+      peer(next).SendAll(bytes + seg_off[send_seg] * el,
+                         static_cast<size_t>(send_n) * el);
+      peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
+                         static_cast<size_t>(recv_n) * el);
+    } else {
+      peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
+                         static_cast<size_t>(recv_n) * el);
+      peer(next).SendAll(bytes + seg_off[send_seg] * el,
+                         static_cast<size_t>(send_n) * el);
+    }
+  }
+}
+
+void DataPlane::Allgatherv(const void* in, int64_t my_rows,
+                           const std::vector<int64_t>& rows,
+                           int64_t row_bytes, void* out) {
+  auto* dst = static_cast<uint8_t*>(out);
+  std::vector<int64_t> offs(size_ + 1, 0);
+  for (int i = 0; i < size_; ++i) offs[i + 1] = offs[i] + rows[i];
+  // place own rows
+  memcpy(dst + offs[rank_] * row_bytes, in,
+         static_cast<size_t>(my_rows) * row_bytes);
+  if (size_ == 1) return;
+  const int next = (rank_ + 1) % size_;
+  const int prev = (rank_ + size_ - 1) % size_;
+  // ring rotation: at step s, send the block originally from
+  // (rank - s) % n, receive the block from (rank - s - 1) % n
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_blk = (rank_ - step + size_) % size_;
+    int recv_blk = (rank_ - step - 1 + size_) % size_;
+    size_t send_bytes = static_cast<size_t>(rows[send_blk]) * row_bytes;
+    size_t recv_bytes = static_cast<size_t>(rows[recv_blk]) * row_bytes;
+    if (rank_ % 2 == 0) {
+      peer(next).SendAll(dst + offs[send_blk] * row_bytes, send_bytes);
+      peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
+    } else {
+      peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
+      peer(next).SendAll(dst + offs[send_blk] * row_bytes, send_bytes);
+    }
+  }
+}
+
+void DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
+  if (size_ == 1 || bytes == 0) return;
+  if (rank_ == root) {
+    for (int r = 0; r < size_; ++r) {
+      if (r == root) continue;
+      peer(r).SendAll(buf, static_cast<size_t>(bytes));
+    }
+  } else {
+    peer(root).RecvAll(buf, static_cast<size_t>(bytes));
+  }
+}
+
+void DataPlane::Alltoallv(const void* in,
+                          const std::vector<int64_t>& send_rows,
+                          int64_t row_bytes, void* out,
+                          const std::vector<int64_t>& recv_rows) {
+  auto* src = static_cast<const uint8_t*>(in);
+  auto* dst = static_cast<uint8_t*>(out);
+  std::vector<int64_t> soff(size_ + 1, 0), roff(size_ + 1, 0);
+  for (int i = 0; i < size_; ++i) {
+    soff[i + 1] = soff[i] + send_rows[i];
+    roff[i + 1] = roff[i] + recv_rows[i];
+  }
+  // self block
+  memcpy(dst + roff[rank_] * row_bytes, src + soff[rank_] * row_bytes,
+         static_cast<size_t>(send_rows[rank_]) * row_bytes);
+  // pairwise exchange, lower rank sends first
+  for (int other = 0; other < size_; ++other) {
+    if (other == rank_) continue;
+    size_t sb = static_cast<size_t>(send_rows[other]) * row_bytes;
+    size_t rb = static_cast<size_t>(recv_rows[other]) * row_bytes;
+    if (rank_ < other) {
+      if (sb) peer(other).SendAll(src + soff[other] * row_bytes, sb);
+      if (rb) peer(other).RecvAll(dst + roff[other] * row_bytes, rb);
+    } else {
+      if (rb) peer(other).RecvAll(dst + roff[other] * row_bytes, rb);
+      if (sb) peer(other).SendAll(src + soff[other] * row_bytes, sb);
+    }
+  }
+}
+
+}  // namespace hvt

@@ -1,0 +1,60 @@
+// CPU data plane: collectives over a TCP full mesh.
+//
+// This is the Gloo-equivalent CPU backend (reference
+// horovod/common/ops/gloo_operations.cc — ring/halving-doubling allreduce,
+// allgatherv, broadcast, alltoallv), rebuilt without the gloo dependency:
+//
+// - allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
+//   2(N-1)/N * bytes on the wire per rank).
+// - allgatherv: ring rotation, N-1 steps.
+// - broadcast: star from root (N is small on the eager path; the TPU data
+//   plane handles the large-N case in XLA).
+// - alltoallv: pairwise exchange, rank-ordered to avoid deadlock.
+//
+// fp16/bf16 are accumulated in fp32 (reference half.{h,cc} + the fused
+// scale kernels do the same widening).
+#pragma once
+
+#include <cstdint>
+#include <vector>
+
+#include "common.h"
+#include "net.h"
+
+namespace hvt {
+
+class DataPlane {
+ public:
+  // peers: socket per rank (peers[self] unused/invalid).
+  DataPlane(int rank, int size, std::vector<Sock> peers)
+      : rank_(rank), size_(size), peers_(std::move(peers)) {}
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red);
+  // rows per rank along dim 0; row_bytes = bytes of one row.
+  void Allgatherv(const void* in, int64_t my_rows,
+                  const std::vector<int64_t>& rows, int64_t row_bytes,
+                  void* out);
+  void Broadcast(void* buf, int64_t bytes, int root);
+  // send_rows[r] rows go to rank r; returns recv rows from each rank in
+  // recv_rows; out must hold sum(recv_rows)*row_bytes.
+  void Alltoallv(const void* in, const std::vector<int64_t>& send_rows,
+                 int64_t row_bytes, void* out,
+                 const std::vector<int64_t>& recv_rows);
+
+ private:
+  Sock& peer(int r) { return peers_[static_cast<size_t>(r)]; }
+  int rank_, size_;
+  std::vector<Sock> peers_;
+  std::vector<uint8_t> scratch_;
+};
+
+// Elementwise accumulate: dst = dst (op) src, for count elements.
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
+                ReduceKind red);
+// dst *= factor (no-op for factor 1.0); used for pre/postscale + Average.
+void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor);
+
+}  // namespace hvt

@@ -1,0 +1,185 @@
+// Control-plane wire format — replaces the reference's FlatBuffers schema
+// (horovod/common/wire/message.fbs, message.cc) with a dependency-free
+// length-prefixed binary encoding. Requests announce per-rank tensor
+// readiness; Responses carry the coordinator's fused execution order
+// (reference message.h: Request:50, Response:152).
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvt {
+
+struct Request {
+  int32_t rank = 0;
+  OpType op = OpType::ALLREDUCE;
+  ReduceKind reduce = ReduceKind::SUM;
+  std::string name;
+  DataType dtype = DataType::FLOAT32;
+  TensorShape shape;
+  int32_t root_rank = 0;
+  double prescale = 1.0, postscale = 1.0;
+  std::vector<int64_t> splits;
+};
+
+struct Response {
+  enum class Kind : uint8_t { TENSOR = 0, ERROR = 1, JOIN = 2, BARRIER = 3 };
+  Kind kind = Kind::TENSOR;
+  OpType op = OpType::ALLREDUCE;
+  std::vector<std::string> names;   // >1 → fused unit
+  std::string error;
+  // Execution params, carried so ranks without a local entry (joined
+  // ranks) can build zero stand-ins (reference JoinOp,
+  // collective_operations.h:259):
+  DataType dtype = DataType::FLOAT32;
+  ReduceKind reduce = ReduceKind::SUM;
+  int32_t root = 0;                 // bcast root / last-joined rank (JOIN)
+  double prescale = 1.0, postscale = 1.0;
+  std::vector<int64_t> numels;      // per name
+  // allgatherv: rows per (name, rank), flattened names-major;
+  // alltoallv: full size x size split matrix, sender-major.
+  std::vector<int64_t> rows_flat;
+};
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void i32(int32_t v) { append(&v, 4); }
+  void i64(int64_t v) { append(&v, 8); }
+  void f64(double v) { append(&v, 8); }
+  void str(const std::string& s) {
+    i32(static_cast<int32_t>(s.size()));
+    append(s.data(), s.size());
+  }
+  void i64vec(const std::vector<int64_t>& v) {
+    i32(static_cast<int32_t>(v.size()));
+    for (auto x : v) i64(x);
+  }
+
+ private:
+  void append(const void* p, size_t n) {
+    auto* b = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), b, b + n);
+  }
+};
+
+class Reader {
+ public:
+  explicit Reader(const std::vector<uint8_t>& b) : buf_(b) {}
+  uint8_t u8() { return buf_[pos_++]; }
+  int32_t i32() { int32_t v; copy(&v, 4); return v; }
+  int64_t i64() { int64_t v; copy(&v, 8); return v; }
+  double f64() { double v; copy(&v, 8); return v; }
+  std::string str() {
+    int32_t n = i32();
+    std::string s(reinterpret_cast<const char*>(buf_.data() + pos_), n);
+    pos_ += n;
+    return s;
+  }
+  std::vector<int64_t> i64vec() {
+    int32_t n = i32();
+    std::vector<int64_t> v(n);
+    for (auto& x : v) x = i64();
+    return v;
+  }
+  bool done() const { return pos_ >= buf_.size(); }
+
+ private:
+  void copy(void* p, size_t n) {
+    memcpy(p, buf_.data() + pos_, n);
+    pos_ += n;
+  }
+  const std::vector<uint8_t>& buf_;
+  size_t pos_ = 0;
+};
+
+inline void EncodeRequest(Writer& w, const Request& r) {
+  w.i32(r.rank);
+  w.u8(static_cast<uint8_t>(r.op));
+  w.u8(static_cast<uint8_t>(r.reduce));
+  w.str(r.name);
+  w.u8(static_cast<uint8_t>(r.dtype));
+  w.i64vec(r.shape.dims);
+  w.i32(r.root_rank);
+  w.f64(r.prescale);
+  w.f64(r.postscale);
+  w.i64vec(r.splits);
+}
+
+inline Request DecodeRequest(Reader& rd) {
+  Request r;
+  r.rank = rd.i32();
+  r.op = static_cast<OpType>(rd.u8());
+  r.reduce = static_cast<ReduceKind>(rd.u8());
+  r.name = rd.str();
+  r.dtype = static_cast<DataType>(rd.u8());
+  r.shape.dims = rd.i64vec();
+  r.root_rank = rd.i32();
+  r.prescale = rd.f64();
+  r.postscale = rd.f64();
+  r.splits = rd.i64vec();
+  return r;
+}
+
+inline void EncodeRequestList(Writer& w, const std::vector<Request>& rs) {
+  w.i32(static_cast<int32_t>(rs.size()));
+  for (auto& r : rs) EncodeRequest(w, r);
+}
+
+inline std::vector<Request> DecodeRequestList(Reader& rd) {
+  int32_t n = rd.i32();
+  std::vector<Request> rs(n);
+  for (auto& r : rs) r = DecodeRequest(rd);
+  return rs;
+}
+
+inline void EncodeResponse(Writer& w, const Response& r) {
+  w.u8(static_cast<uint8_t>(r.kind));
+  w.u8(static_cast<uint8_t>(r.op));
+  w.i32(static_cast<int32_t>(r.names.size()));
+  for (auto& n : r.names) w.str(n);
+  w.str(r.error);
+  w.u8(static_cast<uint8_t>(r.dtype));
+  w.u8(static_cast<uint8_t>(r.reduce));
+  w.i32(r.root);
+  w.f64(r.prescale);
+  w.f64(r.postscale);
+  w.i64vec(r.numels);
+  w.i64vec(r.rows_flat);
+}
+
+inline Response DecodeResponse(Reader& rd) {
+  Response r;
+  r.kind = static_cast<Response::Kind>(rd.u8());
+  r.op = static_cast<OpType>(rd.u8());
+  int32_t n = rd.i32();
+  r.names.resize(n);
+  for (auto& s : r.names) s = rd.str();
+  r.error = rd.str();
+  r.dtype = static_cast<DataType>(rd.u8());
+  r.reduce = static_cast<ReduceKind>(rd.u8());
+  r.root = rd.i32();
+  r.prescale = rd.f64();
+  r.postscale = rd.f64();
+  r.numels = rd.i64vec();
+  r.rows_flat = rd.i64vec();
+  return r;
+}
+
+inline void EncodeResponseList(Writer& w, const std::vector<Response>& rs) {
+  w.i32(static_cast<int32_t>(rs.size()));
+  for (auto& r : rs) EncodeResponse(w, r);
+}
+
+inline std::vector<Response> DecodeResponseList(Reader& rd) {
+  int32_t n = rd.i32();
+  std::vector<Response> rs(n);
+  for (auto& r : rs) r = DecodeResponse(rd);
+  return rs;
+}
+
+}  // namespace hvt

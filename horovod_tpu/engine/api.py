@@ -64,7 +64,26 @@ def _immediate(value) -> Handle:
     return h
 
 
+_name_seq = 0
+
+
+def _auto_name(op, name):
+    """Anonymous tensors get a deterministic per-process sequence name; all
+    processes issue eager collectives in the same program order (SPMD), so
+    the names line up across ranks — the reference requires explicit names
+    for the same reason (tensor_queue dedup by name)."""
+    global _name_seq
+    if name is not None:
+        return name
+    _name_seq += 1
+    return f"hvt.{op}.{_name_seq}"
+
+
 def _nprocs() -> int:
+    from horovod_tpu.engine import native
+
+    if native.engine_running():
+        return native.engine_size()
     n = os.environ.get("HVT_NUM_PROCESSES")
     if n is not None:
         return int(n)
@@ -91,12 +110,27 @@ def shutdown_if_running():
 def _require_multiproc_engine():
     from horovod_tpu.engine import native
 
-    if not native.available():
+    if not native.engine_running():
         raise HorovodInternalError(
             "multi-process eager collectives require the C++ engine "
-            "(horovod_tpu/csrc); build it with `python setup.py build_ext` "
-            "or run single-process")
+            "(build with `make -C horovod_tpu/csrc` and launch via hvtrun)")
     return native
+
+
+class _ConvertingHandle(Handle):
+    """Wraps a NativeHandle, converting the numpy result back to the
+    caller's framework (jax / torch / numpy)."""
+
+    def __init__(self, inner, convert):
+        super().__init__()
+        self._inner = inner
+        self._convert = convert
+
+    def done(self):
+        return self._inner.done()
+
+    def wait(self, timeout=None):
+        return self._convert(self._inner.wait())
 
 
 def _to_numpy(tensor):
@@ -152,9 +186,11 @@ def allreduce(tensor, op, name=None, prescale_factor=1.0,
     native = _require_multiproc_engine()
     opname = {Average: "avg", Sum: "sum", Adasum: "adasum", Min: "min",
               Max: "max", Product: "prod"}[op]
-    return native.submit("allreduce", arr, kind, name=name, op=opname,
-                         prescale=prescale_factor, postscale=postscale_factor,
-                         process_set=process_set)
+    h = native.submit("allreduce", arr, kind,
+                      name=_auto_name("allreduce", name), op_kind=opname,
+                      prescale=prescale_factor, postscale=postscale_factor,
+                      process_set=process_set)
+    return _ConvertingHandle(h, lambda r: _from_numpy(r, kind))
 
 
 def grouped_allreduce(tensors, op, name=None, prescale_factor=1.0,
@@ -185,8 +221,10 @@ def allgather(tensor, name=None, process_set=global_process_set) -> Handle:
     if _nprocs() == 1:
         return _immediate(_from_numpy(arr.copy(), kind))
     native = _require_multiproc_engine()
-    return native.submit("allgather", arr, kind, name=name,
-                         process_set=process_set)
+    h = native.submit("allgather", arr, kind,
+                      name=_auto_name("allgather", name),
+                      process_set=process_set)
+    return _ConvertingHandle(h, lambda r: _from_numpy(r, kind))
 
 
 def grouped_allgather(tensors, name=None,
@@ -203,8 +241,10 @@ def broadcast(tensor, root_rank=0, name=None,
     if _nprocs() == 1:
         return _immediate(_from_numpy(arr.copy(), kind))
     native = _require_multiproc_engine()
-    return native.submit("broadcast", arr, kind, name=name,
-                         root_rank=root_rank, process_set=process_set)
+    h = native.submit("broadcast", arr, kind,
+                      name=_auto_name("broadcast", name),
+                      root_rank=root_rank, process_set=process_set)
+    return _ConvertingHandle(h, lambda r: _from_numpy(r, kind))
 
 
 def alltoall(tensor, splits=None, name=None,
@@ -217,28 +257,46 @@ def alltoall(tensor, splits=None, name=None,
                        else np.asarray([arr.shape[0]]))
         return _immediate((out, recv_splits))
     native = _require_multiproc_engine()
-    return native.submit("alltoall", arr, kind, name=name, splits=splits,
-                         process_set=process_set)
+    if splits is None:
+        n = _nprocs()
+        if arr.shape[0] % n != 0:
+            raise ValueError(
+                f"alltoall without splits requires dim 0 ({arr.shape[0]}) "
+                f"divisible by the number of processes ({n})")
+        splits = [arr.shape[0] // n] * n
+    h = native.submit("alltoall", arr, kind,
+                      name=_auto_name("alltoall", name), splits=splits,
+                      process_set=process_set)
+    return _ConvertingHandle(
+        h, lambda r: (_from_numpy(r[0], kind), r[1]))
 
 
-def reducescatter(tensor, op, name=None,
+def reducescatter(tensor, op, name=None, prescale_factor=1.0,
+                  postscale_factor=1.0,
                   process_set=global_process_set) -> Handle:
     arr, kind = _to_numpy(tensor)
     if _nprocs() == 1:
-        return _immediate(_from_numpy(arr.copy(), kind))
+        out = _scale(_scale(arr.copy(), prescale_factor), postscale_factor)
+        return _immediate(_from_numpy(out, kind))
     native = _require_multiproc_engine()
-    from horovod_tpu.ops.collective_ops import Average
+    from horovod_tpu.ops.collective_ops import (Average, Max, Min, Product,
+                                                Sum)
 
-    return native.submit("reducescatter", arr, kind, name=name,
-                         op="avg" if op is Average else "sum",
-                         process_set=process_set)
+    opname = {Average: "avg", Sum: "sum", Min: "min", Max: "max",
+              Product: "prod"}[op]
+    h = native.submit("reducescatter", arr, kind,
+                      name=_auto_name("reducescatter", name),
+                      op_kind=opname, prescale=prescale_factor,
+                      postscale=postscale_factor, process_set=process_set)
+    return _ConvertingHandle(h, lambda r: _from_numpy(r, kind))
 
 
 def join() -> int:
     if _nprocs() == 1:
         return 0
     native = _require_multiproc_engine()
-    return native.submit("join", None, "numpy").wait()
+    return native.submit("join", None, "numpy",
+                         name=_auto_name("join", None)).wait()
 
 
 def barrier(process_set=global_process_set):
@@ -246,4 +304,5 @@ def barrier(process_set=global_process_set):
         return
     native = _require_multiproc_engine()
     native.submit("barrier", None, "numpy",
+                  name=_auto_name("barrier", None),
                   process_set=process_set).wait()

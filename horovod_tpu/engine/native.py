@@ -2,25 +2,40 @@
 
 Analog of the reference's ctypes bridge (``horovod/common/basics.py:22-65``
 loading ``libhorovod``). The C++ core provides, per SURVEY.md §2.1-2.2:
-background engine thread, rank-0 coordinator protocol, tensor queue,
-fusion buffers, response cache with cross-rank bit sync, stall inspector,
-and TCP ring collectives with HTTP-store rendezvous (the Gloo-equivalent
-CPU data plane).
+background engine thread, rank-0 coordinator protocol with per-tensor
+consistency checks, response cache with cross-rank eviction sync, tensor
+fusion, stall inspector, and TCP ring collectives (the Gloo-equivalent CPU
+data plane). Build: ``make -C horovod_tpu/csrc``.
 
-This module degrades gracefully: when the shared library is absent (not yet
-built on this machine), ``available()`` is False and single-process eager
-semantics still work through ``engine/api.py``.
+Thread-safety note: ``hvt_wait`` stores its result in C thread-locals, so
+``Handle.wait`` performs wait + reads on the calling thread in one critical
+sequence (the ctypes FFI releases the GIL during the blocking wait, so the
+engine thread keeps running).
 """
 
 from __future__ import annotations
 
+import ctypes
 import os
 import threading
+
+import numpy as np
+
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+# wire ids must match csrc/common.h DataType / OpType / ReduceKind
+_DT = {
+    "uint8": 0, "int8": 1, "int32": 4, "int64": 5, "float16": 6,
+    "float32": 7, "float64": 8, "bool": 9, "bfloat16": 10,
+}
+_OP = {"allreduce": 0, "allgather": 1, "broadcast": 2, "alltoall": 3,
+       "reducescatter": 4, "join": 5, "barrier": 6}
+_RED = {"sum": 0, "avg": 1, "min": 2, "max": 3, "prod": 4, "adasum": 5}
 
 _lock = threading.Lock()
 _lib = None
 _load_attempted = False
-_running = False
+_engine_inited = False
 
 
 def _lib_path():
@@ -38,12 +53,26 @@ def _load():
         path = _lib_path()
         if not os.path.exists(path):
             return None
-        import ctypes
-
         try:
-            _lib = ctypes.CDLL(path)
+            lib = ctypes.CDLL(path)
         except OSError:
-            _lib = None
+            return None
+        lib.hvt_init.argtypes = [ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_char_p, ctypes.c_int,
+                                 ctypes.c_int]
+        lib.hvt_submit.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_longlong)]
+        lib.hvt_result_bytes.restype = ctypes.c_longlong
+        lib.hvt_result_read.argtypes = [ctypes.c_int, ctypes.c_void_p,
+                                        ctypes.c_longlong]
+        lib.hvt_result_recv_splits.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        lib.hvt_error_message.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        _lib = lib
         return _lib
 
 
@@ -51,20 +80,188 @@ def available() -> bool:
     return _load() is not None
 
 
+def engine_running() -> bool:
+    lib = _load()
+    return bool(lib and lib.hvt_initialized())
+
+
+def init_engine(rank: int, size: int, master_addr: str, master_port: int,
+                cycle_ms: int = 2) -> bool:
+    """Bring up the engine (control star + data mesh + background thread).
+    Called from hvt.init() in multi-process CPU mode."""
+    global _engine_inited
+    lib = _load()
+    if lib is None:
+        return False
+    rc = lib.hvt_init(rank, size, master_addr.encode(), master_port,
+                      cycle_ms)
+    if rc != 0:
+        raise HorovodInternalError(
+            f"hvt engine init failed (rank {rank}/{size} via "
+            f"{master_addr}:{master_port})")
+    _engine_inited = True
+    return True
+
+
 def shutdown_if_running():
-    global _running
-    with _lock:
-        if not _running:
-            return
+    global _engine_inited
+    lib = _lib
+    if lib is not None and _engine_inited:
+        lib.hvt_shutdown()
+        _engine_inited = False
+
+
+def engine_rank() -> int:
+    return _lib.hvt_rank() if engine_running() else 0
+
+
+def engine_size() -> int:
+    return _lib.hvt_size() if engine_running() else 1
+
+
+def _np_dtype_id(arr: np.ndarray) -> int:
+    name = arr.dtype.name
+    if name not in _DT:
+        raise ValueError(f"hvt engine: unsupported dtype {name}")
+    return _DT[name]
+
+
+class NativeHandle:
+    """Async handle over the C++ engine (reference handle_manager.h)."""
+
+    def __init__(self, handle, op, arr, kind, trailing_shape, dtype,
+                 orig_shape=None):
+        self._h = handle
+        self._op = op
+        self._kind = kind
+        self._trailing = trailing_shape
+        self._dtype = dtype
+        self._shape = arr.shape if arr is not None else ()
+        # 0-d inputs are sent as (1,); restore the caller's shape on output
+        # so np=1 and np>1 agree
+        self._orig_shape = orig_shape
+        self._result = None
+        self._error = None
+        self._finished = False
+
+    def done(self) -> bool:
+        if self._finished:
+            return True
+        return bool(_lib.hvt_poll(self._h))
+
+    def wait(self, timeout=None):
+        if self._finished:
+            if self._error:
+                raise self._error
+            return self._result
         lib = _lib
-        if lib is not None:
-            lib.hvt_shutdown()
-        _running = False
+        if timeout is not None:
+            import time
+
+            deadline = time.monotonic() + timeout
+            while not lib.hvt_poll(self._h):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        "collective did not complete in time")
+                time.sleep(0.001)
+        rc = lib.hvt_wait(self._h)
+        if rc != 0:
+            buf = ctypes.create_string_buffer(4096)
+            lib.hvt_error_message(buf, 4096)
+            msg = buf.value.decode(errors="replace")
+            lib.hvt_release(self._h)
+            self._finished = True
+            # ABORTED (engine/peer failure) → HorovodInternalError so the
+            # elastic wrapper can catch and recover; PRECONDITION (cross-
+            # rank mismatch) → ValueError matching the reference's
+            # per-tensor error delivery
+            if rc == -3:
+                self._error = HorovodInternalError(msg)
+            else:
+                self._error = ValueError(msg)
+            raise self._error
+
+        if self._op == "join":
+            self._result = int(lib.hvt_join_result(self._h))
+        elif self._op == "barrier":
+            self._result = None
+        else:
+            nbytes = lib.hvt_result_bytes(self._h)
+            flat = np.empty((int(nbytes),), dtype=np.uint8)
+            if nbytes:
+                lib.hvt_result_read(
+                    self._h, flat.ctypes.data_as(ctypes.c_void_p),
+                    ctypes.c_longlong(int(nbytes)))
+            out = flat.view(self._dtype)
+            splits = None
+            if self._op in ("allgather", "alltoall"):
+                cap = max(engine_size(), 1)
+                sbuf = (ctypes.c_longlong * cap)()
+                n = lib.hvt_result_recv_splits(self._h, sbuf, cap)
+                splits = np.asarray([int(sbuf[i]) for i in range(min(n, cap))],
+                                    dtype=np.int64)
+            if self._op in ("allgather", "alltoall"):
+                rows = int(splits.sum()) if splits is not None else 0
+                out = out.reshape((rows,) + tuple(self._trailing))
+            elif self._op == "reducescatter":
+                rows = self._shape[0] // engine_size()
+                out = out.reshape((rows,) + tuple(self._trailing))
+            else:
+                out = out.reshape(
+                    self._orig_shape if self._orig_shape is not None
+                    else self._shape)
+            self._result = (out, splits) if self._op == "alltoall" else out
+        lib.hvt_release(self._h)
+        self._finished = True
+        return self._result
 
 
-def submit(op, arr, kind, **kwargs):
-    """Submit an eager collective to the C++ engine. Wired up when the
-    native extension lands (phase B); see ``horovod_tpu/csrc``."""
-    raise NotImplementedError(
-        "C++ engine submission not yet wired; multi-process eager "
-        "collectives arrive with horovod_tpu/csrc")
+def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
+           prescale=1.0, postscale=1.0, splits=None, process_set=None,
+           **_ignored):
+    """Submit an eager collective; returns a handle whose wait() yields the
+    framework-converted result (conversion handled by engine/api.py)."""
+    if not engine_running():
+        raise HorovodInternalError(
+            "hvt engine is not running; multi-process eager collectives "
+            "require hvt.init() under the hvtrun launcher")
+    if process_set is not None and getattr(process_set, "ranks",
+                                           None) is not None:
+        raise NotImplementedError(
+            "engine-path process sets beyond the global set are not yet "
+            "supported; use the traced path")
+    orig_shape = None
+    if arr is None:
+        arr = np.zeros((0,), np.uint8)
+        dims = []
+        dtype = np.uint8
+    else:
+        orig_shape = arr.shape
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        dims = list(arr.shape)
+        dtype = arr.dtype
+    if name is None:
+        raise ValueError(
+            "engine submissions require a name (callers auto-name via "
+            "engine.api._auto_name; matching names across ranks is how the "
+            "coordinator pairs tensors)")
+
+    dims_arr = (ctypes.c_longlong * max(len(dims), 1))(*dims)
+    splits_list = [] if splits is None else [int(s) for s in splits]
+    splits_arr = (ctypes.c_longlong * max(len(splits_list), 1))(
+        *splits_list)
+    h = _lib.hvt_submit(
+        name.encode(), _OP[op], _RED[op_kind],
+        _np_dtype_id(arr) if arr.size or op not in ("join", "barrier")
+        else 0,
+        len(dims), dims_arr,
+        arr.ctypes.data_as(ctypes.c_void_p) if arr.size else None,
+        ctypes.c_longlong(arr.nbytes), root_rank, prescale, postscale,
+        len(splits_list), splits_arr)
+    if h < 0:
+        raise HorovodInternalError("hvt engine rejected submission "
+                                   "(not initialized)")
+    return NativeHandle(h, op, arr, kind, tuple(arr.shape[1:]), dtype,
+                        orig_shape=orig_shape)

@@ -419,8 +419,9 @@ def reducescatter(tensor, op=None, name=None,
         return jax.tree.map(_rs, tensor)
     from horovod_tpu.engine import api as engine
 
-    return synchronize(engine.reducescatter(tensor, op=rop, name=name,
-                                            process_set=process_set))
+    return synchronize(engine.reducescatter(
+        tensor, op=rop, name=name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set))
 
 
 def grouped_reducescatter(tensors, op=None, name=None,

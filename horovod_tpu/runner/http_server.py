@@ -1,0 +1,143 @@
+"""HTTP KV store + rendezvous server (reference
+``horovod/runner/http/http_server.py``: KVStoreHandler PUT/GET with scoped
+keys ``global`` / ``local_<host>`` / ``cross_<rank>``, RendezvousServer with
+re-``init()`` for elastic re-rendezvous).
+
+Used by the elastic driver: workers PUT their endpoints/state under scoped
+keys and GET peers'; each elastic restart calls ``init`` with the new host
+allocation, resetting the store. Static engine jobs rendezvous over the TCP
+control star instead (csrc/engine.cc), so this server is the *driver-side*
+coordination surface.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class _Store:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.scopes = {}
+
+    def put(self, scope, key, value: bytes):
+        with self.lock:
+            self.scopes.setdefault(scope, {})[key] = value
+
+    def get(self, scope, key):
+        with self.lock:
+            return self.scopes.get(scope, {}).get(key)
+
+    def keys(self, scope):
+        with self.lock:
+            return list(self.scopes.get(scope, {}).keys())
+
+    def clear(self):
+        with self.lock:
+            self.scopes.clear()
+
+
+class RendezvousServer:
+    """KV + slot-info rendezvous.
+
+    Paths:
+      PUT/GET /kv/<scope>/<key>         — raw bytes KV
+      GET     /keys/<scope>             — JSON list of keys
+      GET     /rendezvous/<host>/<local_rank> — JSON SlotInfo
+      GET     /world                    — JSON {size, hosts}
+      DELETE  /rendezvous               — finalize round (elastic)
+    """
+
+    def __init__(self, verbose=False):
+        self._store = _Store()
+        self._slots = {}
+        self._world = {}
+        self._server = None
+        self._verbose = verbose
+
+    def init(self, slots):
+        """(Re)initialize with a host allocation plan — one call per
+        elastic rendezvous round (reference http_server.py:195)."""
+        self._store.clear()
+        self._slots = {
+            f"{s.hostname}/{s.local_rank}": {
+                "hostname": s.hostname, "rank": s.rank,
+                "local_rank": s.local_rank, "cross_rank": s.cross_rank,
+                "size": s.size, "local_size": s.local_size,
+                "cross_size": s.cross_size,
+            } for s in slots
+        }
+        self._world = {"size": len(slots),
+                       "hosts": sorted({s.hostname for s in slots})}
+
+    def start(self, port=0) -> int:
+        store, slots_ref, world_ref = self._store, self, self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code, body=b"", ctype="application/octet-stream"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_PUT(self):
+                parts = self.path.strip("/").split("/")
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if len(parts) >= 3 and parts[0] == "kv":
+                    store.put(parts[1], "/".join(parts[2:]), body)
+                    self._send(200)
+                else:
+                    self._send(404)
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) >= 3 and parts[0] == "kv":
+                    v = store.get(parts[1], "/".join(parts[2:]))
+                    if v is None:
+                        self._send(404)
+                    else:
+                        self._send(200, v)
+                elif len(parts) == 2 and parts[0] == "keys":
+                    self._send(200, json.dumps(
+                        store.keys(parts[1])).encode(), "application/json")
+                elif len(parts) == 3 and parts[0] == "rendezvous":
+                    info = slots_ref._slots.get(f"{parts[1]}/{parts[2]}")
+                    if info is None:
+                        self._send(404)
+                    else:
+                        self._send(200, json.dumps(info).encode(),
+                                   "application/json")
+                elif parts == ["world"]:
+                    self._send(200, json.dumps(world_ref._world).encode(),
+                               "application/json")
+                else:
+                    self._send(404)
+
+            def do_DELETE(self):
+                if self.path.strip("/") == "rendezvous":
+                    store.clear()
+                    self._send(200)
+                else:
+                    self._send(404)
+
+            def log_message(self, *a):
+                pass
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self._server.server_address[1]
+
+    @property
+    def port(self):
+        return self._server.server_address[1] if self._server else None
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server = None

@@ -1,0 +1,146 @@
+"""``hvtrun`` — the launcher CLI (reference ``horovod/runner/launch.py``:
+parse_args:242, _run_static:527, run_controller:675).
+
+Usage:
+    python -m horovod_tpu.runner.launch -np 4 python train.py
+    hvtrun -np 8 -H host1:4,host2:4 python train.py
+
+Local slots run as direct subprocesses; remote hosts are reached over ssh
+with the slot env inlined (reference gloo_run.py:65-145 builds the same
+per-slot env + ssh command). The engine rendezvous is a TCP control star on
+``--master-port`` of the first host, replacing the reference's HTTP-store
+rendezvous for static jobs; elastic jobs use the HTTP rendezvous server
+(``runner/http_server.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import sys
+
+from horovod_tpu.runner import safe_exec
+from horovod_tpu.runner.hosts import (get_host_assignments, parse_hostfile,
+                                      parse_hosts)
+
+_LOCAL_NAMES = ("localhost", "127.0.0.1")
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="hvtrun",
+        description="Launch a horovod_tpu job (CPU engine processes or one "
+                    "process per TPU host).")
+    p.add_argument("-np", "--num-proc", type=int, required=True,
+                   help="total number of processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="host1:slots,host2:slots (default: localhost:np)")
+    p.add_argument("--hostfile", default=None,
+                   help="hostfile with 'host slots=N' lines")
+    p.add_argument("--master-port", type=int, default=29510,
+                   help="engine control-plane port on the first host")
+    p.add_argument("--ssh-port", type=int, default=22)
+    p.add_argument("--cycle-time-ms", type=int, default=2,
+                   help="engine cycle time (reference HOROVOD_CYCLE_TIME)")
+    p.add_argument("--fusion-threshold-mb", type=int, default=64,
+                   help="tensor fusion buffer threshold "
+                        "(reference HOROVOD_FUSION_THRESHOLD)")
+    p.add_argument("--timeline", default=None,
+                   help="chrome-trace timeline output path "
+                        "(reference HOROVOD_TIMELINE)")
+    p.add_argument("--stall-warning-sec", type=int, default=60,
+                   help="stall inspector warning threshold")
+    p.add_argument("--backend", choices=["engine", "jax"], default="engine",
+                   help="engine: C++ TCP collectives (CPU/eager); jax: "
+                        "jax.distributed bring-up (one process per TPU "
+                        "host)")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no training command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    return args
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in _LOCAL_NAMES or hostname == socket.gethostname()
+
+
+def slot_env(base_env, slot, args, master_addr):
+    """Per-slot environment (reference gloo_run.py:65-99
+    create_slot_env_vars: HOROVOD_RANK/SIZE/LOCAL_RANK/..._ADDR)."""
+    env = dict(base_env)
+    env.update({
+        "HVT_PROCESS_ID": str(slot.rank),
+        "HVT_NUM_PROCESSES": str(slot.size),
+        "HVT_LOCAL_PROCESS_ID": str(slot.local_rank),
+        "HVT_LOCAL_SIZE": str(slot.local_size),
+        "HVT_CROSS_RANK": str(slot.cross_rank),
+        "HVT_CROSS_SIZE": str(slot.cross_size),
+        "HVT_HOSTNAME": slot.hostname,
+        "HVT_CYCLE_TIME_MS": str(args.cycle_time_ms),
+        "HVT_FUSION_THRESHOLD": str(args.fusion_threshold_mb << 20),
+        "HVT_STALL_WARN_SEC": str(args.stall_warning_sec),
+    })
+    if args.backend == "engine":
+        env["HVT_MASTER_ADDR"] = master_addr
+        env["HVT_MASTER_PORT"] = str(args.master_port)
+    else:
+        env["HVT_COORDINATOR_ADDR"] = f"{master_addr}:{args.master_port}"
+    if args.timeline:
+        env["HVT_TIMELINE"] = args.timeline
+    return env
+
+
+def build_commands(args, slots, master_addr, base_env=None):
+    base_env = dict(os.environ if base_env is None else base_env)
+    cmds = []
+    for slot in slots:
+        env = slot_env(base_env, slot, args, master_addr)
+        if _is_local(slot.hostname):
+            cmds.append((list(args.command), env, slot.rank))
+        else:
+            # ssh with inline env (reference gloo_run.py:114-145)
+            inline = " ".join(
+                f"{k}={shlex.quote(v)}" for k, v in env.items()
+                if k.startswith("HVT_") or k in ("PATH", "PYTHONPATH"))
+            remote = f"cd {shlex.quote(os.getcwd())} && env {inline} " + \
+                " ".join(shlex.quote(c) for c in args.command)
+            cmds.append((["ssh", "-o", "StrictHostKeyChecking=no", "-p",
+                          str(args.ssh_port), slot.hostname, remote],
+                         dict(os.environ), slot.rank))
+    return cmds
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.hostfile:
+        hosts = parse_hostfile(args.hostfile)
+    elif args.hosts:
+        hosts = parse_hosts(args.hosts)
+    else:
+        hosts = parse_hosts(f"localhost:{args.num_proc}")
+    slots = get_host_assignments(hosts, args.num_proc)
+    master_addr = ("127.0.0.1" if _is_local(slots[0].hostname)
+                   else slots[0].hostname)
+    if args.verbose:
+        for s in slots:
+            print(f"[hvtrun] rank {s.rank} → {s.hostname} "
+                  f"(local {s.local_rank}/{s.local_size}, "
+                  f"cross {s.cross_rank}/{s.cross_size})", file=sys.stderr)
+    cmds = build_commands(args, slots, master_addr)
+    exit_codes = safe_exec.run_all(cmds)
+    bad = [(i, rc) for i, rc in enumerate(exit_codes) if rc != 0]
+    if bad:
+        print(f"[hvtrun] ranks failed: {bad}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Launcher unit tests (reference ``test/single/test_run.py``: CLI parsing,
+command construction, env plumbing — 58 tests there; the same concerns
+covered here without mocks where possible)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from horovod_tpu.runner.hosts import (HostInfo, get_host_assignments,
+                                      parse_hosts)
+from horovod_tpu.runner.launch import build_commands, parse_args, slot_env
+
+
+def test_parse_hosts():
+    hs = parse_hosts("a:2, b:4,c")
+    assert [(h.hostname, h.slots) for h in hs] == [("a", 2), ("b", 4),
+                                                   ("c", 1)]
+
+
+def test_host_assignments_single_host():
+    slots = get_host_assignments([HostInfo("localhost", 4)], 4)
+    assert [s.rank for s in slots] == [0, 1, 2, 3]
+    assert [s.local_rank for s in slots] == [0, 1, 2, 3]
+    assert all(s.cross_size == 1 and s.cross_rank == 0 for s in slots)
+
+
+def test_host_assignments_two_hosts():
+    slots = get_host_assignments(
+        [HostInfo("h1", 2), HostInfo("h2", 2)], 4)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank)
+            for s in slots] == [("h1", 0, 0, 0), ("h1", 1, 1, 0),
+                                ("h2", 2, 0, 1), ("h2", 3, 1, 1)]
+    assert all(s.local_size == 2 and s.cross_size == 2 for s in slots)
+
+
+def test_host_assignments_uneven():
+    slots = get_host_assignments([HostInfo("h1", 3), HostInfo("h2", 1)], 4)
+    # h2 has no slot at local_rank 1,2 → cross_size differs per local_rank
+    by_rank = {s.rank: s for s in slots}
+    assert by_rank[0].cross_size == 2  # local_rank 0 exists on both
+    assert by_rank[1].cross_size == 1  # local_rank 1 only on h1
+
+
+def test_oversubscription_rejected():
+    with pytest.raises(ValueError, match="exceeds available slots"):
+        get_host_assignments([HostInfo("h1", 2)], 3)
+
+
+def test_parse_args_basic():
+    args = parse_args(["-np", "4", "python", "train.py", "--lr", "0.1"])
+    assert args.num_proc == 4
+    assert args.command == ["python", "train.py", "--lr", "0.1"]
+    assert args.backend == "engine"
+
+
+def test_slot_env_plumbing():
+    args = parse_args(["-np", "2", "--timeline", "/tmp/t.json", "python",
+                       "x.py"])
+    slots = get_host_assignments([HostInfo("localhost", 2)], 2)
+    env = slot_env({}, slots[1], args, "127.0.0.1")
+    assert env["HVT_PROCESS_ID"] == "1"
+    assert env["HVT_NUM_PROCESSES"] == "2"
+    assert env["HVT_MASTER_ADDR"] == "127.0.0.1"
+    assert env["HVT_TIMELINE"] == "/tmp/t.json"
+    assert env["HVT_FUSION_THRESHOLD"] == str(64 << 20)
+
+
+def test_build_commands_local_vs_ssh():
+    args = parse_args(["-np", "2", "python", "x.py"])
+    slots = get_host_assignments(
+        [HostInfo("localhost", 1), HostInfo("farhost", 1)], 2)
+    cmds = build_commands(args, slots, "localhost")
+    assert cmds[0][0] == ["python", "x.py"]
+    assert cmds[1][0][0] == "ssh"
+    assert "farhost" in cmds[1][0]
+    joined = " ".join(cmds[1][0])
+    assert "HVT_PROCESS_ID=1" in joined
+
+
+def test_jax_backend_env():
+    args = parse_args(["-np", "2", "--backend", "jax", "python", "x.py"])
+    slots = get_host_assignments([HostInfo("localhost", 2)], 2)
+    env = slot_env({}, slots[0], args, "127.0.0.1")
+    assert "HVT_COORDINATOR_ADDR" in env
+    assert "HVT_MASTER_ADDR" not in env
+
+
+def test_rendezvous_server_roundtrip():
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    slots = get_host_assignments([HostInfo("h1", 2)], 2)
+    srv = RendezvousServer()
+    srv.init(slots)
+    port = srv.start(0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # slot info
+        with urllib.request.urlopen(f"{base}/rendezvous/h1/1") as r:
+            info = json.loads(r.read())
+        assert info["rank"] == 1 and info["local_size"] == 2
+        # world
+        with urllib.request.urlopen(f"{base}/world") as r:
+            world = json.loads(r.read())
+        assert world["size"] == 2 and world["hosts"] == ["h1"]
+        # scoped KV
+        req = urllib.request.Request(f"{base}/kv/global/addr", data=b"x:1",
+                                     method="PUT")
+        urllib.request.urlopen(req)
+        with urllib.request.urlopen(f"{base}/kv/global/addr") as r:
+            assert r.read() == b"x:1"
+        with urllib.request.urlopen(f"{base}/keys/global") as r:
+            assert json.loads(r.read()) == ["addr"]
+        # missing key → 404
+        try:
+            urllib.request.urlopen(f"{base}/kv/global/nope")
+            assert False
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.stop()
