@@ -499,6 +499,13 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
       (size_ & (size_ - 1)) != 0)
     return fail("Adasum requires a power-of-two world size");
 
+  if (a.op == OpType::ALLGATHER || a.op == OpType::ALLTOALL) {
+    // trailing dims were validated equal across ranks above; carry the
+    // per-row element count so joined ranks use the same transfer sizes
+    resp.trailing = 1;
+    for (size_t d = 1; d < a.shape.dims.size(); ++d)
+      resp.trailing *= a.shape.dims[d];
+  }
   if (a.op == OpType::ALLGATHER) {
     resp.rows_flat.assign(size_, 0);
     for (auto& q : reqs)
@@ -752,20 +759,9 @@ void Engine::ExecuteResponse(const Response& resp,
       auto e = take(resp.names[0]);
       std::vector<int64_t> rows(resp.rows_flat.begin(),
                                 resp.rows_flat.begin() + size_);
-      int64_t trailing = 1;  // elements per row
-      if (e) {
-        for (size_t d = 1; d < e->shape.dims.size(); ++d)
-          trailing *= e->shape.dims[d];
-      } else {
-        int64_t rows0 = 0;
-        for (int r = 0; r < size_; ++r)
-          if (rows[r] > 0) {
-            rows0 = rows[r];
-            break;
-          }
-        trailing = rows0 > 0 ? resp.numels[0] / rows0 : 1;
-      }
-      int64_t row_bytes = trailing * static_cast<int64_t>(el);
+      // per-row element count from the coordinator (identical on every
+      // rank, including joined ranks with no local entry)
+      int64_t row_bytes = resp.trailing * static_cast<int64_t>(el);
       int64_t my_rows =
           (e && !e->shape.dims.empty()) ? e->shape.dims[0] : 0;
       int64_t total_rows = 0;
@@ -807,11 +803,7 @@ void Engine::ExecuteResponse(const Response& resp,
             resp.rows_flat[static_cast<size_t>(s) * size_ + rank_];
       int64_t my_rows = 0;
       for (auto r : send_rows) my_rows += r;
-      int64_t row_bytes = static_cast<int64_t>(el);
-      if (e && !e->shape.dims.empty() && e->shape.dims[0] > 0)
-        row_bytes =
-            (e->shape.num_elements() / e->shape.dims[0]) *
-            static_cast<int64_t>(el);
+      int64_t row_bytes = resp.trailing * static_cast<int64_t>(el);
       int64_t total_recv = 0;
       for (auto r : recv_rows) total_recv += r;
       std::vector<uint8_t> out(static_cast<size_t>(total_recv) * row_bytes);

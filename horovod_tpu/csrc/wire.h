@@ -42,6 +42,10 @@ struct Response {
   // allgatherv: rows per (name, rank), flattened names-major;
   // alltoallv: full size x size split matrix, sender-major.
   std::vector<int64_t> rows_flat;
+  // elements per row (product of trailing dims), set by the coordinator
+  // for allgather/alltoall so joined ranks — which have no local entry to
+  // read a shape from — still use the same transfer sizes as their peers.
+  int64_t trailing = 1;
 };
 
 class Writer {
@@ -150,6 +154,7 @@ inline void EncodeResponse(Writer& w, const Response& r) {
   w.f64(r.postscale);
   w.i64vec(r.numels);
   w.i64vec(r.rows_flat);
+  w.i64(r.trailing);
 }
 
 inline Response DecodeResponse(Reader& rd) {
@@ -167,6 +172,7 @@ inline Response DecodeResponse(Reader& rd) {
   r.postscale = rd.f64();
   r.numels = rd.i64vec();
   r.rows_flat = rd.i64vec();
+  r.trailing = rd.i64();
   return r;
 }
 

@@ -130,7 +130,7 @@ class _ConvertingHandle(Handle):
         return self._inner.done()
 
     def wait(self, timeout=None):
-        return self._convert(self._inner.wait())
+        return self._convert(self._inner.wait(timeout))
 
 
 def _to_numpy(tensor):
@@ -157,7 +157,9 @@ def _from_numpy(arr: np.ndarray, kind: str):
     if kind == "torch":
         import torch
 
-        return torch.from_numpy(np.ascontiguousarray(arr))
+        # ascontiguousarray promotes 0-d to 1-d; restore the true shape
+        return torch.from_numpy(
+            np.ascontiguousarray(arr)).reshape(arr.shape)
     return arr
 
 
@@ -193,14 +195,9 @@ def allreduce(tensor, op, name=None, prescale_factor=1.0,
     return _ConvertingHandle(h, lambda r: _from_numpy(r, kind))
 
 
-def grouped_allreduce(tensors, op, name=None, prescale_factor=1.0,
-                      postscale_factor=1.0,
-                      process_set=global_process_set) -> Handle:
-    handles = [allreduce(t, op, name=f"{name}.{i}" if name else None,
-                         prescale_factor=prescale_factor,
-                         postscale_factor=postscale_factor,
-                         process_set=process_set)
-               for i, t in enumerate(tensors)]
+def _combine_handles(handles) -> Handle:
+    """One handle resolving to the list of all results; waits off-thread so
+    the submitting thread keeps overlapping communication with compute."""
     h = Handle()
 
     def _gather():
@@ -216,6 +213,17 @@ def grouped_allreduce(tensors, op, name=None, prescale_factor=1.0,
     return h
 
 
+def grouped_allreduce(tensors, op, name=None, prescale_factor=1.0,
+                      postscale_factor=1.0,
+                      process_set=global_process_set) -> Handle:
+    return _combine_handles(
+        [allreduce(t, op, name=f"{name}.{i}" if name else None,
+                   prescale_factor=prescale_factor,
+                   postscale_factor=postscale_factor,
+                   process_set=process_set)
+         for i, t in enumerate(tensors)])
+
+
 def allgather(tensor, name=None, process_set=global_process_set) -> Handle:
     arr, kind = _to_numpy(tensor)
     if _nprocs() == 1:
@@ -229,10 +237,10 @@ def allgather(tensor, name=None, process_set=global_process_set) -> Handle:
 
 def grouped_allgather(tensors, name=None,
                       process_set=global_process_set) -> Handle:
-    handles = [allgather(t, name=f"{name}.{i}" if name else None,
-                         process_set=process_set)
-               for i, t in enumerate(tensors)]
-    return _immediate([h.wait() for h in handles])
+    return _combine_handles(
+        [allgather(t, name=f"{name}.{i}" if name else None,
+                   process_set=process_set)
+         for i, t in enumerate(tensors)])
 
 
 def broadcast(tensor, root_rank=0, name=None,

@@ -1,0 +1,62 @@
+"""PyTorch binding — ``import horovod_tpu.torch as hvd``
+(reference ``horovod/torch/__init__.py``).
+
+PyTorch here is the host-side *eager* framework: its collectives go through
+the C++ core engine (coordinator + TCP ring data plane,
+``horovod_tpu/csrc``) exactly like the reference's torch binding goes
+through ``operations.cc``. The TPU SPMD hot path is the JAX binding; this
+module exists so reference users porting torch scripts keep their whole
+API surface: hook-based ``DistributedOptimizer``, async handle ops,
+elastic ``TorchState``/``ElasticSampler``, SyncBatchNorm, compression.
+"""
+
+from horovod_tpu.common.basics import (cross_rank, cross_size, init,
+                                       is_initialized, local_rank,
+                                       local_size, shutdown)
+from horovod_tpu.common.basics import process_rank as rank
+from horovod_tpu.common.basics import process_size as size
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+from horovod_tpu.common.process_sets import (ProcessSet, add_process_set,
+                                             global_process_set,
+                                             remove_process_set)
+from horovod_tpu.torch import elastic
+from horovod_tpu.torch.compression import Compression
+from horovod_tpu.torch.functions import (allgather_object,
+                                         broadcast_object,
+                                         broadcast_optimizer_state,
+                                         broadcast_parameters)
+from horovod_tpu.torch.mpi_ops import (Adasum, Average, Max, Min, Product,
+                                       ReduceOp, Sum, allgather,
+                                       allgather_async, allreduce,
+                                       allreduce_, allreduce_async,
+                                       allreduce_async_, alltoall,
+                                       alltoall_async, barrier, broadcast,
+                                       broadcast_, broadcast_async,
+                                       broadcast_async_, grouped_allgather,
+                                       grouped_allgather_async,
+                                       grouped_allreduce,
+                                       grouped_allreduce_async, join, poll,
+                                       reducescatter, reducescatter_async,
+                                       synchronize)
+from horovod_tpu.torch.optimizer import DistributedOptimizer
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "size", "local_rank",
+    "local_size", "cross_rank", "cross_size",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async", "grouped_allgather",
+    "grouped_allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async", "reducescatter", "reducescatter_async",
+    "join", "poll", "synchronize", "barrier",
+    "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp",
+    "DistributedOptimizer", "Compression", "SyncBatchNorm",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object",
+    "ProcessSet", "global_process_set", "add_process_set",
+    "remove_process_set",
+    "HorovodInternalError", "HostsUpdatedInterrupt", "elastic",
+]

@@ -1,0 +1,74 @@
+"""Gradient compression for the PyTorch binding
+(reference ``horovod/torch/compression.py:1-74``)."""
+
+from __future__ import annotations
+
+import torch
+
+
+class Compressor:
+    """Interface: compress a tensor before allreduce, decompress after
+    (reference ``torch/compression.py:23``)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast float tensors to fp16 on the wire
+    (reference ``torch/compression.py:46``)."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating_point:
+            tensor = tensor.to(torch.float16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and ctx.is_floating_point:
+            tensor = tensor.to(ctx)
+        return tensor
+
+
+class BF16Compressor(Compressor):
+    """TPU-native addition: bfloat16 wire format — same exponent range as
+    fp32, so no loss-scale gymnastics, and it is the MXU-native dtype."""
+
+    @staticmethod
+    def compress(tensor):
+        ctx = tensor.dtype
+        if tensor.dtype.is_floating_point:
+            tensor = tensor.to(torch.bfloat16)
+        return tensor, ctx
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None and ctx.is_floating_point:
+            tensor = tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    """Namespace of available compressors
+    (reference ``torch/compression.py:74``)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

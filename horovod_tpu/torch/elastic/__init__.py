@@ -1,0 +1,11 @@
+"""Elastic training state for PyTorch
+(reference ``horovod/torch/elastic/state.py`` + ``sampler.py``)."""
+
+from horovod_tpu.torch.elastic.sampler import ElasticSampler
+from horovod_tpu.torch.elastic.state import (ModelStateHandler,
+                                             OptimizerStateHandler,
+                                             SamplerStateHandler, TorchState)
+from horovod_tpu.elastic.run import run
+
+__all__ = ["TorchState", "ElasticSampler", "ModelStateHandler",
+           "OptimizerStateHandler", "SamplerStateHandler", "run"]

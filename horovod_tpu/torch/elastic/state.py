@@ -1,0 +1,151 @@
+"""TorchState: commit/restore/sync for model, optimizer, sampler
+(reference ``horovod/torch/elastic/state.py:27-140``)."""
+
+from __future__ import annotations
+
+import copy
+
+import torch
+
+from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.torch.functions import (broadcast_object,
+                                         broadcast_optimizer_state,
+                                         broadcast_parameters)
+
+
+class StateHandler:
+    """Save/restore/sync for one tracked value
+    (reference ``torch/elastic/state.py:71``)."""
+
+    def __init__(self, value):
+        self.value = value
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def set_value(self, value):
+        self.value = value
+        self.save()
+
+
+class ModelStateHandler(StateHandler):
+    def __init__(self, model):
+        super().__init__(model)
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(self._saved_state)
+
+    def sync(self):
+        broadcast_parameters(self.value.state_dict(), root_rank=0)
+        self.save()
+
+
+class OptimizerStateHandler(StateHandler):
+    def __init__(self, optimizer):
+        super().__init__(optimizer)
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(copy.deepcopy(self._saved_state))
+
+    def sync(self):
+        broadcast_optimizer_state(self.value, root_rank=0)
+        self.save()
+
+
+class SamplerStateHandler(StateHandler):
+    def __init__(self, sampler):
+        super().__init__(sampler)
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(copy.deepcopy(self._saved_state))
+
+    def sync(self):
+        # merge processed indices across the (possibly changed) world, then
+        # reshard the remainder (reference torch/elastic/state.py:116-140)
+        state = self.value.state_dict()
+        synced = broadcast_object(state, root_rank=0,
+                                  name="elastic.sampler.state")
+        self.value.load_state_dict(synced)
+        self.save()
+
+
+def _make_handler(v):
+    if isinstance(v, torch.nn.Module):
+        return ModelStateHandler(v)
+    if isinstance(v, torch.optim.Optimizer):
+        return OptimizerStateHandler(v)
+    from horovod_tpu.torch.elastic.sampler import ElasticSampler
+
+    if isinstance(v, ElasticSampler):
+        return SamplerStateHandler(v)
+    return None
+
+
+class TorchState(ObjectState):
+    """Elastic state wrapping torch objects + plain attributes
+    (reference ``torch/elastic/state.py:27``)::
+
+        state = TorchState(model=model, optimizer=optimizer, epoch=0)
+        state.sync()       # broadcast from new rank 0
+        state.commit()     # snapshot + host-update check
+        state.restore()    # roll back after HorovodInternalError
+    """
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self._handlers = {}
+        if model is not None:
+            kwargs["model"] = model
+        if optimizer is not None:
+            kwargs["optimizer"] = optimizer
+        scalars = {}
+        for k, v in kwargs.items():
+            h = _make_handler(v)
+            if h is not None:
+                self._handlers[k] = h
+                object.__setattr__(self, k, v)
+            else:
+                scalars[k] = v
+        super().__init__(**scalars)
+
+    def save(self):
+        for h in self._handlers.values():
+            h.save()
+        super().save()
+
+    def restore(self):
+        for h in self._handlers.values():
+            h.restore()
+        super().restore()
+
+    def sync(self):
+        for h in self._handlers.values():
+            h.sync()
+        super().sync()
+
+    def _tracked(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_") and k not in self._handlers}
+
+    def __setattr__(self, name, value):
+        if not name.startswith("_") and hasattr(self, "_handlers") \
+                and name in self._handlers:
+            self._handlers[name].set_value(value)
+        object.__setattr__(self, name, value)

@@ -1,0 +1,146 @@
+"""State broadcast / object collectives for PyTorch
+(reference ``horovod/torch/functions.py``, 262 LoC)."""
+
+from __future__ import annotations
+
+import torch
+
+from horovod_tpu.common.process_sets import global_process_set
+from horovod_tpu.torch.mpi_ops import (allgather_async, broadcast_,
+                                       broadcast_async_, synchronize)
+
+
+def broadcast_parameters(params, root_rank=0,
+                         process_set=global_process_set):
+    """Broadcast model parameters from ``root_rank`` in place (reference
+    ``torch/functions.py`` broadcast_parameters). Accepts a ``state_dict()``
+    or ``model.named_parameters()``."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    else:
+        params = list(params)
+    handles = []
+    for name, p in params:
+        if p is None:
+            continue
+        if not isinstance(p, torch.Tensor):
+            raise ValueError(
+                f"invalid params of type {type(p)} for key {name}; expected "
+                f"a state_dict or an iterable of (name, Tensor)")
+        handles.append(broadcast_async_(p, root_rank,
+                                        name=f"broadcast.param.{name}",
+                                        process_set=process_set))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank=0,
+                              process_set=global_process_set):
+    """Broadcast an optimizer's full state from ``root_rank`` (reference
+    ``torch/functions.py`` broadcast_optimizer_state).
+
+    The collective *sequence* is derived from the root's state structure
+    (shipped first as one pickled metadata broadcast), so ranks whose local
+    state is empty — e.g. fresh workers joining an elastic job while the
+    root has stepped — allocate matching tensors and participate in exactly
+    the same broadcasts instead of deadlocking the coordinator. Scalar
+    entries (step counters, hyperparams) ride inside the metadata; tensor
+    payloads go through per-tensor engine broadcasts.
+    """
+    from horovod_tpu.common.basics import process_rank
+
+    state_dict = optimizer.state_dict()
+    meta = None
+    if process_rank() == root_rank:
+        meta = {
+            "param_groups": [
+                {k: v for k, v in g.items() if k != "params"}
+                for g in state_dict["param_groups"]],
+            "state": {
+                pid: {key: (("t", list(v.shape), str(v.dtype))
+                            if isinstance(v, torch.Tensor) else ("s", v))
+                      for key, v in pstate.items()}
+                for pid, pstate in state_dict["state"].items()},
+        }
+    meta = broadcast_object(meta, root_rank, name="optimizer.state.meta",
+                            process_set=process_set)
+    if not meta["state"] and not meta["param_groups"]:
+        return
+
+    handles = []
+    for pid, pspec in meta["state"].items():
+        pstate = state_dict["state"].setdefault(pid, {})
+        for key, desc in pspec.items():
+            if desc[0] == "t":
+                _, shape, dtype_str = desc
+                dtype = getattr(torch, dtype_str.split(".")[-1])
+                t = pstate.get(key)
+                if not (isinstance(t, torch.Tensor)
+                        and list(t.shape) == shape and t.dtype == dtype):
+                    t = torch.zeros(shape, dtype=dtype)
+                    pstate[key] = t
+                handles.append(broadcast_async_(
+                    t, root_rank, name=f"optimizer.state.{pid}.{key}",
+                    process_set=process_set))
+            else:
+                pstate[key] = desc[1]
+    for h in handles:
+        synchronize(h)
+    for g, new_g in zip(state_dict["param_groups"], meta["param_groups"]):
+        g.update(new_g)
+    optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj=None, root_rank=0, name=None,
+                     process_set=global_process_set):
+    """Pickle → byte tensor → size bcast → payload bcast → unpickle
+    (reference ``torch/functions.py`` broadcast_object)."""
+    return _broadcast_object_impl(obj, root_rank, name, process_set)
+
+
+def allgather_object(obj, name=None, process_set=global_process_set):
+    """Gather arbitrary picklable objects from all ranks
+    (reference ``torch/functions.py`` allgather_object)."""
+    import pickle
+
+    payload = torch.from_numpy(
+        __import__("numpy").frombuffer(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            dtype="uint8").copy())
+    gathered = synchronize(allgather_async(
+        payload, name=name or "allgather_object",
+        process_set=process_set))
+    sizes = synchronize(allgather_async(
+        torch.tensor([payload.numel()]),
+        name=(name or "allgather_object") + ".sizes",
+        process_set=process_set))
+    out, offset = [], 0
+    for s in sizes.tolist():
+        out.append(pickle.loads(gathered[offset:offset + s].numpy()
+                                .tobytes()))
+        offset += s
+    return out
+
+
+def _broadcast_object_impl(obj, root_rank, name, process_set):
+    import pickle
+
+    import numpy as np
+
+    from horovod_tpu.common.basics import process_rank
+
+    if process_rank() == root_rank:
+        payload = np.frombuffer(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+            dtype=np.uint8).copy()
+    else:
+        payload = np.zeros(1, np.uint8)
+    sz = torch.tensor([len(payload)])
+    broadcast_(sz, root_rank, name=(name or "broadcast_object") + ".size",
+               process_set=process_set)
+    buf = torch.from_numpy(payload)
+    if process_rank() != root_rank:
+        buf = torch.zeros(int(sz.item()), dtype=torch.uint8)
+    broadcast_(buf, root_rank, name=name or "broadcast_object",
+               process_set=process_set)
+    return pickle.loads(buf.numpy().tobytes())

@@ -1,0 +1,400 @@
+"""PyTorch collective ops over the horovod_tpu eager engine
+(reference ``horovod/torch/mpi_ops.py``, 861 LoC).
+
+The reference binds torch to the C++ core through a pybind11 module
+(``torch/mpi_ops_v2.cc``) returning integer handles resolved by a
+HandleManager. Here torch tensors route through the same eager engine that
+serves JAX host-side collectives (``horovod_tpu/engine``): single-process
+jobs complete immediately; multi-process jobs go through the C++ core's
+coordinator + TCP ring data plane (``horovod_tpu/csrc``). Handles are
+:class:`~horovod_tpu.engine.api.Handle` objects rather than ints — ``poll``
+/ ``synchronize`` keep the reference semantics
+(``torch/mpi_ops.py:807,823``).
+
+Autograd: ``allreduce`` / ``allgather`` / ``broadcast`` / ``alltoall`` /
+``reducescatter`` are differentiable, with the same backward rules the
+reference registers (``torch/mpi_ops.py:163-806``): the gradient of an
+allreduce is an allreduce, of an allgather is the caller's slice of the
+reduced gradient, of a broadcast is the summed gradient delivered to the
+root.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import torch
+
+from horovod_tpu.common.basics import process_rank, process_size
+from horovod_tpu.common.process_sets import global_process_set
+from horovod_tpu.engine import api as _engine
+from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min,
+                                            Product, ReduceOp, Sum,
+                                            _resolve_op)
+
+__all__ = [
+    "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async", "grouped_allgather",
+    "grouped_allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async",
+    "join", "poll", "synchronize", "barrier",
+]
+
+
+def _prepare(tensor: torch.Tensor):
+    """numpy cannot represent bfloat16; ship it as float32 and restore."""
+    if tensor.dtype == torch.bfloat16:
+        return tensor.to(torch.float32), torch.bfloat16
+    return tensor, None
+
+
+def _restore(tensor: torch.Tensor, wire_dtype):
+    if wire_dtype is not None and isinstance(tensor, torch.Tensor):
+        return tensor.to(wire_dtype)
+    return tensor
+
+
+class _MappedHandle(_engine.Handle):
+    """Applies a post-processing fn to the inner handle's result."""
+
+    def __init__(self, inner, fn):
+        super().__init__()
+        self._inner = inner
+        self._fn = fn
+
+    def done(self):
+        return self._inner.done()
+
+    def wait(self, timeout=None):
+        return self._fn(self._inner.wait(timeout))
+
+
+# --------------------------------------------------------------------------
+# allreduce
+# --------------------------------------------------------------------------
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=global_process_set):
+    """Asynchronously sum/average ``tensor`` across processes
+    (reference ``torch/mpi_ops.py:130``)."""
+    op = _resolve_op(op, average)
+    t, wire = _prepare(tensor)
+    h = _engine.allreduce(t, op, name=name, prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set)
+    if wire is None:
+        return h
+    return _MappedHandle(h, lambda r: _restore(r, wire))
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=global_process_set):
+    """In-place async allreduce (reference ``torch/mpi_ops.py:210``)."""
+    h = allreduce_async(tensor, average=average, name=name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
+
+    def _copy_back(result):
+        tensor.copy_(result)
+        return tensor
+
+    return _MappedHandle(h, _copy_back)
+
+
+class _HorovodAllreduce(torch.autograd.Function):
+    """Differentiable allreduce (reference ``torch/mpi_ops.py:163``)."""
+
+    @staticmethod
+    def forward(ctx, tensor, average, name, op, prescale_factor,
+                postscale_factor, process_set):
+        ctx.average = average
+        ctx.op = op
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        ctx.process_set = process_set
+        return synchronize(allreduce_async(
+            tensor, average=average, name=name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        return (synchronize(allreduce_async(
+            grad_output, average=ctx.average, op=ctx.op,
+            prescale_factor=ctx.prescale_factor,
+            postscale_factor=ctx.postscale_factor,
+            process_set=ctx.process_set)),
+            None, None, None, None, None, None)
+
+
+def allreduce(tensor, average=None, name=None, op=None, prescale_factor=1.0,
+              postscale_factor=1.0, process_set=global_process_set):
+    """Synchronous, differentiable allreduce
+    (reference ``torch/mpi_ops.py:180-208``)."""
+    return _HorovodAllreduce.apply(tensor, average, name, op,
+                                   prescale_factor, postscale_factor,
+                                   process_set)
+
+
+def allreduce_(tensor, average=None, name=None, op=None, prescale_factor=1.0,
+               postscale_factor=1.0, process_set=global_process_set):
+    """Synchronous in-place allreduce (reference ``torch/mpi_ops.py:251``)."""
+    return synchronize(allreduce_async_(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=global_process_set):
+    """Allreduce a list of tensors as one fused negotiation unit
+    (reference ``torch/mpi_ops.py:287-360``)."""
+    op = _resolve_op(op, average)
+    prepared = [_prepare(t) for t in tensors]
+    h = _engine.grouped_allreduce(
+        [t for t, _ in prepared], op, name=name,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+    wires = [w for _, w in prepared]
+    return _MappedHandle(
+        h, lambda rs: [_restore(r, w) for r, w in zip(rs, wires)])
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=global_process_set):
+    return synchronize(grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+
+
+# --------------------------------------------------------------------------
+# allgather
+# --------------------------------------------------------------------------
+
+def allgather_async(tensor, name=None, process_set=global_process_set):
+    """Concatenate tensors from all processes along dim 0
+    (reference ``torch/mpi_ops.py:502``); first dims may differ."""
+    t, wire = _prepare(tensor)
+    h = _engine.allgather(t, name=name, process_set=process_set)
+    if wire is None:
+        return h
+    return _MappedHandle(h, lambda r: _restore(r, wire))
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    """Differentiable allgather: backward reduces the gathered gradient and
+    narrows to this rank's slice (reference ``torch/mpi_ops.py:521-560``)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name, process_set):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
+        ctx.process_set = process_set
+        return synchronize(allgather_async(tensor, name=name,
+                                           process_set=process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = synchronize(allreduce_async(
+            grad_output, op=Sum, process_set=ctx.process_set))
+        # offset of this rank's slice = sum of dim0 over lower ranks
+        dims = synchronize(allgather_async(
+            torch.tensor([ctx.dim0]), process_set=ctx.process_set))
+        r = process_rank()
+        offset = int(dims[:r].sum()) if r > 0 else 0
+        return grad_reduced.narrow(0, offset, ctx.dim0), None, None
+
+
+def allgather(tensor, name=None, process_set=global_process_set):
+    return _HorovodAllgather.apply(tensor, name, process_set)
+
+
+def grouped_allgather_async(tensors, name=None,
+                            process_set=global_process_set):
+    prepared = [_prepare(t) for t in tensors]
+    h = _engine.grouped_allgather([t for t, _ in prepared], name=name,
+                                  process_set=process_set)
+    wires = [w for _, w in prepared]
+    return _MappedHandle(
+        h, lambda rs: [_restore(r, w) for r, w in zip(rs, wires)])
+
+
+def grouped_allgather(tensors, name=None, process_set=global_process_set):
+    return synchronize(grouped_allgather_async(tensors, name=name,
+                                               process_set=process_set))
+
+
+# --------------------------------------------------------------------------
+# broadcast
+# --------------------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank, name=None,
+                    process_set=global_process_set):
+    """Asynchronously copy ``tensor`` from ``root_rank`` to all processes
+    (reference ``torch/mpi_ops.py:585``)."""
+    t, wire = _prepare(tensor)
+    h = _engine.broadcast(t, root_rank=root_rank, name=name,
+                          process_set=process_set)
+    if wire is None:
+        return h
+    return _MappedHandle(h, lambda r: _restore(r, wire))
+
+
+def broadcast_async_(tensor, root_rank, name=None,
+                     process_set=global_process_set):
+    h = broadcast_async(tensor, root_rank, name=name,
+                        process_set=process_set)
+
+    def _copy_back(result):
+        tensor.copy_(result)
+        return tensor
+
+    return _MappedHandle(h, _copy_back)
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    """Differentiable broadcast: backward delivers the summed gradient to
+    the root, zeros elsewhere (reference ``torch/mpi_ops.py:633-668``)."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name, process_set):
+        ctx.root_rank = root_rank
+        ctx.process_set = process_set
+        return synchronize(broadcast_async(tensor, root_rank, name=name,
+                                           process_set=process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad_reduced = synchronize(allreduce_async(
+            grad_output, op=Sum, process_set=ctx.process_set))
+        if process_rank() != ctx.root_rank:
+            grad_reduced = grad_reduced * 0
+        return grad_reduced, None, None, None
+
+
+def broadcast(tensor, root_rank, name=None, process_set=global_process_set):
+    return _HorovodBroadcast.apply(tensor, root_rank, name, process_set)
+
+
+def broadcast_(tensor, root_rank, name=None,
+               process_set=global_process_set):
+    return synchronize(broadcast_async_(tensor, root_rank, name=name,
+                                        process_set=process_set))
+
+
+# --------------------------------------------------------------------------
+# alltoall / reducescatter
+# --------------------------------------------------------------------------
+
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=global_process_set):
+    """Scatter slices of ``tensor`` to every process and gather theirs
+    (reference ``torch/mpi_ops.py:710``). Returns (output, recv_splits)."""
+    t, wire = _prepare(tensor)
+    if splits is not None and isinstance(splits, torch.Tensor):
+        splits = splits.tolist()
+    h = _engine.alltoall(t, splits=splits, name=name,
+                         process_set=process_set)
+    return _MappedHandle(
+        h, lambda r: (_restore(r[0], wire),
+                      torch.as_tensor(r[1], dtype=torch.int32)))
+
+
+class _HorovodAlltoall(torch.autograd.Function):
+    """Differentiable alltoall: backward = alltoall with recv splits
+    (reference ``torch/mpi_ops.py:748-790``)."""
+
+    @staticmethod
+    def forward(ctx, tensor, splits, name, process_set):
+        output, recv_splits = synchronize(alltoall_async(
+            tensor, splits=splits, name=name, process_set=process_set))
+        ctx.recv_splits = recv_splits
+        ctx.process_set = process_set
+        ctx.mark_non_differentiable(recv_splits)
+        return output, recv_splits
+
+    @staticmethod
+    def backward(ctx, grad_output, _grad_splits):
+        grad_in, _ = synchronize(alltoall_async(
+            grad_output, splits=ctx.recv_splits,
+            process_set=ctx.process_set))
+        return grad_in, None, None, None
+
+
+def alltoall(tensor, splits=None, name=None,
+             process_set=global_process_set):
+    output, recv_splits = _HorovodAlltoall.apply(tensor, splits, name,
+                                                 process_set)
+    if splits is None:
+        return output
+    return output, recv_splits
+
+
+def reducescatter_async(tensor, op=None, name=None,
+                        process_set=global_process_set):
+    """Reduce across processes, scatter slices of the result
+    (dim 0 split; this rank keeps slice ``process_rank()``)."""
+    op = _resolve_op(op, None)
+    t, wire = _prepare(tensor)
+    h = _engine.reducescatter(t, op, name=name, process_set=process_set)
+    if wire is None:
+        return h
+    return _MappedHandle(h, lambda r: _restore(r, wire))
+
+
+class _HorovodReducescatter(torch.autograd.Function):
+    """Backward of reduce-scatter is allgather (+ scale for Average)."""
+
+    @staticmethod
+    def forward(ctx, tensor, op, name, process_set):
+        ctx.op = op
+        ctx.process_set = process_set
+        return synchronize(reducescatter_async(tensor, op=op, name=name,
+                                               process_set=process_set))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = synchronize(allgather_async(grad_output,
+                                           process_set=ctx.process_set))
+        if ctx.op in (None, Average):
+            grad = grad / process_size()
+        return grad, None, None, None
+
+
+def reducescatter(tensor, op=None, name=None,
+                  process_set=global_process_set):
+    return _HorovodReducescatter.apply(tensor, op, name, process_set)
+
+
+# --------------------------------------------------------------------------
+# control
+# --------------------------------------------------------------------------
+
+def join(device=None) -> int:
+    """Reference ``torch/mpi_ops.py:846`` — see
+    :func:`horovod_tpu.ops.collective_ops.join`."""
+    return _engine.join()
+
+
+def barrier(process_set=global_process_set):
+    return _engine.barrier(process_set=process_set)
+
+
+def poll(handle) -> bool:
+    """True once the async op completed (``torch/mpi_ops.py:807``)."""
+    return handle.done()
+
+
+def synchronize(handle):
+    """Wait for an async handle and return its output
+    (``torch/mpi_ops.py:823``)."""
+    return handle.wait()

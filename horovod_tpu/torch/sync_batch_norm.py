@@ -1,0 +1,74 @@
+"""Cross-process synchronized batch normalization for PyTorch
+(reference ``horovod/torch/sync_batch_norm.py``, 199 LoC).
+
+The reference allgathers per-rank sum/square-sum/count and hand-writes the
+backward. Here the statistics are combined with the *differentiable*
+allreduce from :mod:`horovod_tpu.torch.mpi_ops` — the gradient of a sum
+allreduce is a sum allreduce, so autograd derives exactly the reference's
+backward (reduced mean/var gradients) without a custom Function.
+"""
+
+from __future__ import annotations
+
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from horovod_tpu.common.basics import is_initialized, process_size
+from horovod_tpu.torch.mpi_ops import Sum, allreduce, allreduce_async, \
+    synchronize
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm that computes batch statistics over the global
+    batch across all processes (reference ``torch/sync_batch_norm.py:22``).
+    Falls back to plain BatchNorm in eval mode or single-process jobs."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D input)")
+
+    def forward(self, input):
+        if (not self.training
+                or not is_initialized()
+                or process_size() == 1):
+            return super().forward(input)
+        self._check_input_dim(input)
+        return self._sync_forward(input)
+
+    def _sync_forward(self, input):
+        dims = [0] + list(range(2, input.dim()))
+        local_count = input.numel() // input.size(1)
+
+        count = torch.tensor([float(local_count)])
+        total_count = synchronize(allreduce_async(count, op=Sum)).item()
+        # differentiable cross-rank sums (weights ranks by their counts,
+        # matching the reference's count-aware mean, sync_batch_norm.py:119)
+        mean = allreduce(input.sum(dims), op=Sum) / total_count
+        sqmean = allreduce((input * input).sum(dims), op=Sum) / total_count
+        var = sqmean - mean * mean
+
+        if self.track_running_stats:
+            with torch.no_grad():
+                self.num_batches_tracked += 1
+                # momentum=None means cumulative moving average, matching
+                # torch._BatchNorm's exponential_average_factor
+                m = (1.0 / float(self.num_batches_tracked)
+                     if self.momentum is None else self.momentum)
+                unbiased = var * (total_count / max(total_count - 1, 1))
+                self.running_mean.mul_(1 - m).add_(mean.detach(), alpha=m)
+                self.running_var.mul_(1 - m).add_(unbiased.detach(),
+                                                  alpha=m)
+
+        shape = [1, -1] + [1] * (input.dim() - 2)
+        out = ((input - mean.reshape(shape))
+               / torch.sqrt(var.reshape(shape) + self.eps))
+        if self.affine:
+            out = out * self.weight.reshape(shape) \
+                + self.bias.reshape(shape)
+        return out

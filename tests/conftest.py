@@ -19,6 +19,26 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Build artifacts are not committed; (re)build the C++ engine once per test
+# session so the multi-process suites run.
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "horovod_tpu", "csrc")
+
+
+def _ensure_engine_built():
+    import subprocess
+
+    lib = os.path.join(_CSRC, "build", "libhvt_core.so")
+    stamp = os.path.getmtime(lib) if os.path.exists(lib) else 0
+    sources = [os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
+               if f.endswith((".cc", ".h")) or f == "Makefile"]
+    if sources and stamp < max(os.path.getmtime(s) for s in sources):
+        subprocess.run(["make", "-C", _CSRC, "-j"], check=False,
+                       capture_output=True)
+
+
+_ensure_engine_built()
+
 
 @pytest.fixture(scope="session", autouse=True)
 def _hvt_init():

@@ -1,0 +1,339 @@
+"""PyTorch binding tests — single-process semantics + autograd + optimizer
+(the analog of reference ``test/parallel/test_torch.py``'s np=1 coverage;
+multi-process coverage lives in ``test_torch_parallel.py``)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+
+def test_rank_size_single_process():
+    assert hvd.size() == 1
+    assert hvd.rank() == 0
+
+
+def test_allreduce_identity():
+    x = torch.arange(6, dtype=torch.float32).reshape(2, 3)
+    y = hvd.allreduce(x, name="t0")
+    assert torch.allclose(y, x)
+
+
+def test_allreduce_bf16():
+    x = torch.ones(4, dtype=torch.bfloat16)
+    y = hvd.allreduce(x, name="t_bf16")
+    assert y.dtype == torch.bfloat16
+    assert torch.allclose(y.float(), x.float())
+
+
+def test_allreduce_inplace():
+    x = torch.ones(3)
+    out = hvd.allreduce_(x, name="t1")
+    assert out is x
+
+
+def test_allreduce_autograd():
+    x = torch.ones(3, requires_grad=True)
+    y = hvd.allreduce(x * 2, name="t2", op=hvd.Sum)
+    y.sum().backward()
+    assert torch.allclose(x.grad, torch.full((3,), 2.0))
+
+
+def test_allgather_single():
+    x = torch.arange(4).reshape(2, 2).float()
+    y = hvd.allgather(x, name="g0")
+    assert torch.allclose(y, x)
+
+
+def test_allgather_autograd():
+    x = torch.ones(2, 2, requires_grad=True)
+    y = hvd.allgather(x * 3, name="g1")
+    y.sum().backward()
+    assert torch.allclose(x.grad, torch.full((2, 2), 3.0))
+
+
+def test_broadcast_single():
+    x = torch.randn(5)
+    y = hvd.broadcast(x, root_rank=0, name="b0")
+    assert torch.allclose(y, x)
+
+
+def test_broadcast_autograd_root():
+    x = torch.ones(3, requires_grad=True)
+    y = hvd.broadcast(x * 2, root_rank=0, name="b1")
+    y.sum().backward()
+    # single process is the root: gradient flows through
+    assert torch.allclose(x.grad, torch.full((3,), 2.0))
+
+
+def test_alltoall_single():
+    x = torch.arange(6).float()
+    y = hvd.alltoall(x, name="a0")
+    assert torch.allclose(y, x)
+
+
+def test_alltoall_with_splits():
+    x = torch.arange(4).float()
+    y, recv = hvd.alltoall(x, splits=[4], name="a1")
+    assert torch.allclose(y, x)
+    assert recv.tolist() == [4]
+
+
+def test_reducescatter_single():
+    x = torch.randn(4, 2)
+    y = hvd.reducescatter(x, op=hvd.Sum, name="rs0")
+    assert torch.allclose(y, x)
+
+
+def test_grouped_allreduce():
+    xs = [torch.ones(2), torch.full((3,), 2.0)]
+    ys = hvd.grouped_allreduce(xs, name="ga0")
+    assert torch.allclose(ys[0], xs[0])
+    assert torch.allclose(ys[1], xs[1])
+
+
+def test_poll_synchronize():
+    h = hvd.allreduce_async(torch.ones(2), name="p0")
+    assert hvd.poll(h)
+    out = hvd.synchronize(h)
+    assert torch.allclose(out, torch.ones(2))
+
+
+def test_join_and_barrier():
+    assert hvd.join() == 0
+    hvd.barrier()
+
+
+# -- compression -------------------------------------------------------------
+
+def test_fp16_compression_roundtrip():
+    t = torch.randn(8)
+    c, ctx = hvd.Compression.fp16.compress(t)
+    assert c.dtype == torch.float16
+    d = hvd.Compression.fp16.decompress(c, ctx)
+    assert d.dtype == torch.float32
+    assert torch.allclose(d, t, atol=1e-3)
+
+
+def test_bf16_compression_roundtrip():
+    t = torch.randn(8)
+    c, ctx = hvd.Compression.bf16.compress(t)
+    assert c.dtype == torch.bfloat16
+    d = hvd.Compression.bf16.decompress(c, ctx)
+    assert d.dtype == torch.float32
+
+
+def test_compression_passes_ints():
+    t = torch.arange(4)
+    c, ctx = hvd.Compression.fp16.compress(t)
+    assert c.dtype == t.dtype
+
+
+# -- optimizer ---------------------------------------------------------------
+
+def _tiny_model():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                               torch.nn.Linear(8, 2))
+
+
+def test_distributed_optimizer_step(monkeypatch):
+    monkeypatch.setenv("HVT_FORCE_DISTRIBUTED_HOOKS", "1")
+    model = _tiny_model()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    x = torch.randn(16, 4)
+    before = [p.detach().clone() for p in model.parameters()]
+    loss = model(x).pow(2).mean()
+    loss.backward()
+    opt.step()
+    after = list(model.parameters())
+    assert any(not torch.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_distributed_optimizer_matches_local(monkeypatch):
+    """With one process the distributed step must equal a plain step."""
+    monkeypatch.setenv("HVT_FORCE_DISTRIBUTED_HOOKS", "1")
+    x = torch.randn(8, 4)
+
+    def train(dist):
+        model = _tiny_model()
+        base = torch.optim.SGD(model.parameters(), lr=0.05)
+        opt = hvd.DistributedOptimizer(
+            base, named_parameters=model.named_parameters()) if dist \
+            else base
+        for _ in range(3):
+            opt.zero_grad()
+            model(x).pow(2).mean().backward()
+            opt.step()
+        return [p.detach().clone() for p in model.parameters()]
+
+    for pd, pl in zip(train(True), train(False)):
+        assert torch.allclose(pd, pl, atol=1e-6)
+
+
+def test_backward_passes_per_step(monkeypatch):
+    monkeypatch.setenv("HVT_FORCE_DISTRIBUTED_HOOKS", "1")
+    model = _tiny_model()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2)
+    x = torch.randn(4, 4)
+    model(x).pow(2).mean().backward()
+    model(x).pow(2).mean().backward()  # second pass completes the delay
+    opt.step()
+    opt.zero_grad()
+
+
+def test_num_groups(monkeypatch):
+    monkeypatch.setenv("HVT_FORCE_DISTRIBUTED_HOOKS", "1")
+    model = _tiny_model()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(), num_groups=2)
+    model(torch.randn(4, 4)).pow(2).mean().backward()
+    opt.step()
+
+
+def test_duplicate_parameter_names_rejected():
+    model = _tiny_model()
+    params = list(model.named_parameters())
+    dup = [("x", params[0][1]), ("x", params[1][1])]
+    with pytest.raises(ValueError, match="unique"):
+        hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=dup)
+
+
+def test_zero_grad_guard(monkeypatch):
+    monkeypatch.setenv("HVT_FORCE_DISTRIBUTED_HOOKS", "1")
+    model = _tiny_model()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters())
+    model(torch.randn(2, 4)).pow(2).mean().backward()
+    with pytest.raises(AssertionError, match="zero_grad"):
+        opt.zero_grad()
+    opt.step()  # drain handles
+
+
+def test_adasum_optimizer_single():
+    model = _tiny_model()
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1), op=hvd.Adasum)
+    model(torch.randn(4, 4)).pow(2).mean().backward()
+    opt.step()
+
+
+# -- functions ---------------------------------------------------------------
+
+def test_broadcast_parameters_state_dict():
+    model = _tiny_model()
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+
+def test_broadcast_optimizer_state():
+    model = _tiny_model()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    model(torch.randn(2, 4)).sum().backward()
+    opt.step()
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+
+def test_broadcast_optimizer_state_empty():
+    model = _tiny_model()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    hvd.broadcast_optimizer_state(opt, root_rank=0)
+
+
+def test_broadcast_object():
+    obj = {"a": 1, "b": [2, 3]}
+    assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_allgather_object():
+    assert hvd.allgather_object({"r": 0}) == [{"r": 0}]
+
+
+# -- sync batch norm ---------------------------------------------------------
+
+def test_sync_batch_norm_matches_bn_single_process():
+    torch.manual_seed(0)
+    x = torch.randn(8, 3, 4, 4)
+    sbn = hvd.SyncBatchNorm(3)
+    bn = torch.nn.BatchNorm2d(3)
+    bn.load_state_dict(sbn.state_dict())
+    sbn.train()
+    bn.train()
+    assert torch.allclose(sbn(x), bn(x), atol=1e-5)
+    assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
+
+
+def test_sync_batch_norm_eval():
+    sbn = hvd.SyncBatchNorm(3)
+    sbn.eval()
+    x = torch.randn(2, 3, 4)
+    assert sbn(x).shape == x.shape
+
+
+# -- elastic -----------------------------------------------------------------
+
+def test_torch_state_commit_restore():
+    model = _tiny_model()
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=3)
+    state.commit()
+    with torch.no_grad():
+        for p in model.parameters():
+            p.add_(1.0)
+    state.epoch = 7
+    state.restore()
+    assert state.epoch == 3
+    fresh = _tiny_model()
+    for p, q in zip(model.parameters(), fresh.parameters()):
+        assert torch.allclose(p, q)
+
+
+def test_torch_state_sync_single():
+    model = _tiny_model()
+    state = hvd.elastic.TorchState(model=model, epoch=1)
+    state.sync()
+    assert state.epoch == 1
+
+
+def test_elastic_sampler_covers_dataset():
+    data = list(range(10))
+    sampler = hvd.elastic.ElasticSampler(data, shuffle=False)
+    assert sorted(iter(sampler)) == data
+    assert len(sampler) == 10
+
+
+def test_elastic_sampler_record_and_reset():
+    data = list(range(10))
+    sampler = hvd.elastic.ElasticSampler(data, shuffle=False)
+    sampler.record_batch(0, 4)  # first 4 indices processed
+    sampler.reset()
+    remaining = list(iter(sampler))
+    assert len(remaining) == 6
+    assert set(remaining).isdisjoint(set(range(4)) & set(remaining) - set(remaining))
+    assert set(remaining) == set(range(4, 10))
+
+
+def test_elastic_sampler_state_roundtrip():
+    sampler = hvd.elastic.ElasticSampler(list(range(8)), shuffle=False)
+    sampler.record_batch(0, 3)
+    sd = sampler.state_dict()
+    other = hvd.elastic.ElasticSampler(list(range(8)), shuffle=False)
+    other.load_state_dict(sd)
+    assert set(iter(other)) == set(range(3, 8))
+
+
+def test_elastic_sampler_epoch_clears():
+    sampler = hvd.elastic.ElasticSampler(list(range(6)), shuffle=True)
+    sampler.record_batch(0, 6)
+    sampler.set_epoch(1)
+    assert len(list(iter(sampler))) == 6
