@@ -1,0 +1,271 @@
+"""Fused flash attention as a Pallas TPU kernel, with custom VJP.
+
+The attention score matrix is the one intermediate XLA cannot fuse away on
+its own; materializing it is O(S²) HBM traffic, which caps MXU utilization
+at long context. This kernel keeps the [block_q × block_k] score tile in
+VMEM, maintains online-softmax running (max, sum) statistics, and writes
+only the O(S·D) output — the standard FlashAttention-2 decomposition, laid
+out for the MXU (128×128 tiles, fp32 accumulation, bf16 operands).
+
+Backward pass recomputes score tiles (FLOPs-for-HBM trade, the same choice
+``jax.checkpoint`` makes) in two kernels: one gridded over Q blocks (dQ),
+one over K/V blocks (dK, dV), using the saved logsumexp.
+
+No reference-framework counterpart (Horovod ships gradients, not kernels);
+this is part of the TPU framework's compute path. Falls back to Pallas
+interpret mode off-TPU so the CPU test mesh exercises the same code.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k):
+    q = q_ref[0, 0]                                   # [block_q, D]
+    block_q, d = q.shape
+    s = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            k_pos = j * block_k + jax.lax.iota(jnp.int32, block_k)
+            mask = k_pos[None, :] <= q_pos[:, None]
+            sc = jnp.where(mask, sc, _NEG_INF)
+        m_blk = jnp.max(sc, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(sc - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    n_k = s // block_k
+    if causal:
+        # Blocks strictly above the diagonal are fully masked; skip them.
+        n_k_eff = jnp.minimum(n_k, (qi + 1) * block_q // block_k
+                              + (1 if block_q % block_k else 0))
+        n_k_eff = jnp.maximum(n_k_eff, 1)
+    else:
+        n_k_eff = n_k
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_k_eff, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, :, 0] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_k):
+    q = q_ref[0, 0]
+    block_q, d = q.shape
+    s = k_ref.shape[2]
+    qi = pl.program_id(2)
+    q_pos = qi * block_q + jax.lax.iota(jnp.int32, block_q)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]
+    delta = delta_ref[0, 0, :, 0]
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = j * block_k + jax.lax.iota(jnp.int32, block_k)
+            mask = k_pos[None, :] <= q_pos[:, None]
+            sc = jnp.where(mask, sc, _NEG_INF)
+        p = jnp.exp(sc - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    n_k = s // block_k
+    if causal:
+        n_k_eff = jnp.minimum(n_k, (qi + 1) * block_q // block_k
+                              + (1 if block_q % block_k else 0))
+        n_k_eff = jnp.maximum(n_k_eff, 1)
+    else:
+        n_k_eff = n_k
+    dq = jax.lax.fori_loop(
+        0, n_k_eff, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q):
+    k = k_ref[0, 0]                                   # [block_k, D]
+    block_k, d = k.shape
+    s = q_ref.shape[2]
+    ki = pl.program_id(2)
+    k_pos = ki * block_k + jax.lax.iota(jnp.int32, block_k)
+    v = v_ref[0, 0]
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q), 0]
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + jax.lax.iota(jnp.int32, block_q)
+            mask = k_pos[None, :] <= q_pos[:, None]
+            sc = jnp.where(mask, sc, _NEG_INF)
+        p = jnp.exp(sc - lse[:, None])             # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        return dk_new, dv_new
+
+    n_q = s // block_q
+    if causal:
+        # Q blocks strictly before this K block see nothing of it.
+        start = ki * block_k // block_q
+    else:
+        start = 0
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, n_q, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _blocks(s, requested):
+    b = min(requested, s)
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k):
+    b, h, s, d = q.shape
+    grid = (b, h, s // block_q)
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    kvspec = pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=[qspec,
+                   pl.BlockSpec((1, 1, block_q, 1),
+                                lambda bi, hi, qi: (bi, hi, qi, 0))],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    b, h, s, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)        # [B, H, S, 1]
+
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    vec_q = pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi: (bi, hi, qi, 0))
+    vec_full = pl.BlockSpec((1, 1, s, 1), lambda bi, hi, i: (bi, hi, 0, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_k=block_k),
+        grid=(b, h, s // block_q),
+        in_specs=[qspec, full, full, qspec, vec_q, vec_q],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q),
+        grid=(b, h, s // block_k),
+        in_specs=[kspec, kspec, full, full, vec_full, vec_full],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=_interpret(),
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None,
+                    block_q=128, block_k=128):
+    """Fused multi-head attention.
+
+    Args:
+      q, k, v: [batch, seq, heads, head_dim] (BSHD, matching
+        :mod:`horovod_tpu.models.transformer`).
+      causal: apply causal masking.
+      scale: softmax scale, default ``head_dim ** -0.5``.
+      block_q / block_k: MXU tile sizes; clipped to divide seq.
+
+    Returns [batch, seq, heads, head_dim] in q.dtype. Differentiable
+    (custom VJP with recompute-based backward kernels).
+    """
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    block_q = _blocks(s, block_q)
+    block_k = _blocks(s, block_k)
+    # Kernels are gridded (batch, head, block): BHSD layout.
+    to_bhsd = lambda x: jnp.transpose(x, (0, 2, 1, 3))
+    o = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+               float(scale), bool(causal), block_q, block_k)
+    return jnp.transpose(o, (0, 2, 1, 3))

@@ -8,7 +8,8 @@ import copy
 import torch
 
 from horovod_tpu.elastic.state import ObjectState
-from horovod_tpu.torch.functions import (broadcast_object,
+from horovod_tpu.torch.functions import (allgather_object,
+                                         broadcast_object,
                                          broadcast_optimizer_state,
                                          broadcast_parameters)
 
@@ -79,11 +80,20 @@ class SamplerStateHandler(StateHandler):
 
     def sync(self):
         # merge processed indices across the (possibly changed) world, then
-        # reshard the remainder (reference torch/elastic/state.py:116-140)
+        # reshard the remainder (reference torch/elastic/state.py:116-140).
+        # Each surviving rank consumed a disjoint set; the union — not rank
+        # 0's view — is what must not be repeated this epoch.
         state = self.value.state_dict()
-        synced = broadcast_object(state, root_rank=0,
-                                  name="elastic.sampler.state")
-        self.value.load_state_dict(synced)
+        all_states = allgather_object(state, name="elastic.sampler.state")
+        processed = set()
+        for s in all_states:
+            processed.update(s["processed_indices"])
+        epoch = broadcast_object(state["epoch"], root_rank=0,
+                                 name="elastic.sampler.epoch")
+        self.value.load_state_dict({
+            "epoch": epoch,
+            "processed_indices": sorted(processed),
+        })
         self.save()
 
 

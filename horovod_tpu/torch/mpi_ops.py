@@ -199,6 +199,10 @@ class _HorovodAllgather(torch.autograd.Function):
     def forward(ctx, tensor, name, process_set):
         ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
         ctx.process_set = process_set
+        # Save every rank's dim0 now so backward needs no extra collective
+        # (reference saves dims via ctx, torch/mpi_ops.py:529-541).
+        ctx.dims = synchronize(allgather_async(
+            torch.tensor([ctx.dim0]), process_set=process_set))
         return synchronize(allgather_async(tensor, name=name,
                                            process_set=process_set))
 
@@ -206,11 +210,9 @@ class _HorovodAllgather(torch.autograd.Function):
     def backward(ctx, grad_output):
         grad_reduced = synchronize(allreduce_async(
             grad_output, op=Sum, process_set=ctx.process_set))
-        # offset of this rank's slice = sum of dim0 over lower ranks
-        dims = synchronize(allgather_async(
-            torch.tensor([ctx.dim0]), process_set=ctx.process_set))
-        r = process_rank()
-        offset = int(dims[:r].sum()) if r > 0 else 0
+        # offset of this rank's slice = sum of dim0 over lower in-set ranks
+        r = ctx.process_set.rank_in_set(process_rank())
+        offset = int(ctx.dims[:r].sum()) if r > 0 else 0
         return grad_reduced.narrow(0, offset, ctx.dim0), None, None
 
 
@@ -366,7 +368,7 @@ class _HorovodReducescatter(torch.autograd.Function):
         grad = synchronize(allgather_async(grad_output,
                                            process_set=ctx.process_set))
         if ctx.op in (None, Average):
-            grad = grad / process_size()
+            grad = grad / ctx.process_set.size()
         return grad, None, None, None
 
 

@@ -216,6 +216,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             if ctx is not None:
                 p.grad.copy_(self._compression.decompress(output, ctx))
         self._handles.clear()
+        if self._groups is not None:
+            # Fallback paths above (missing hooks / individual reduces)
+            # bypass group counting; any leftover count is stale and would
+            # fire a premature grouped allreduce next step.
+            for g in self._group_counts:
+                self._group_counts[g] = 0
         self._synchronized = True
 
     @contextlib.contextmanager

@@ -14,8 +14,7 @@ import torch
 from torch.nn.modules.batchnorm import _BatchNorm
 
 from horovod_tpu.common.basics import is_initialized, process_size
-from horovod_tpu.torch.mpi_ops import Sum, allreduce, allreduce_async, \
-    synchronize
+from horovod_tpu.torch.mpi_ops import Sum, allreduce
 
 
 class SyncBatchNorm(_BatchNorm):
@@ -45,12 +44,18 @@ class SyncBatchNorm(_BatchNorm):
         dims = [0] + list(range(2, input.dim()))
         local_count = input.numel() // input.size(1)
 
-        count = torch.tensor([float(local_count)])
-        total_count = synchronize(allreduce_async(count, op=Sum)).item()
-        # differentiable cross-rank sums (weights ranks by their counts,
-        # matching the reference's count-aware mean, sync_batch_norm.py:119)
-        mean = allreduce(input.sum(dims), op=Sum) / total_count
-        sqmean = allreduce((input * input).sum(dims), op=Sum) / total_count
+        # One fused allreduce of [count, sum, sqsum] — a single coordinator
+        # round-trip per BN layer (the reference likewise combines stats
+        # into one collective, sync_batch_norm.py:119). count is constant
+        # wrt input, so carrying it through the differentiable allreduce is
+        # gradient-neutral.
+        num_feats = input.size(1)
+        count = input.new_tensor([float(local_count)])
+        stats = torch.cat([count, input.sum(dims), (input * input).sum(dims)])
+        stats = allreduce(stats, op=Sum)
+        total_count = stats[0].item()
+        mean = stats[1:1 + num_feats] / total_count
+        sqmean = stats[1 + num_feats:1 + 2 * num_feats] / total_count
         var = sqmean - mean * mean
 
         if self.track_running_stats:

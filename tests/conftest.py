@@ -33,8 +33,11 @@ def _ensure_engine_built():
     sources = [os.path.join(_CSRC, f) for f in os.listdir(_CSRC)
                if f.endswith((".cc", ".h")) or f == "Makefile"]
     if sources and stamp < max(os.path.getmtime(s) for s in sources):
-        subprocess.run(["make", "-C", _CSRC, "-j"], check=False,
-                       capture_output=True)
+        result = subprocess.run(["make", "-C", _CSRC, "-j"],
+                                capture_output=True, text=True)
+        if result.returncode != 0:
+            raise RuntimeError(
+                f"C++ engine build failed:\n{result.stdout}\n{result.stderr}")
 
 
 _ensure_engine_built()

@@ -1,0 +1,97 @@
+"""Ring attention and Ulysses sequence parallelism vs. dense reference.
+
+Runs on the 8-device virtual CPU mesh (conftest). Reference behavior:
+the reference framework has no SP/CP (SURVEY.md §5.7); these tests define
+the TPU framework's own correctness bar: sharded attention must match the
+single-device dense computation to fp32 tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.parallel.mesh import make_parallel_mesh
+from horovod_tpu.parallel.sequence import (
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def _dense_reference(q, k, v, causal, scale=None):
+    b, s, h, d = q.shape
+    scale = scale or d ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        pos = jnp.arange(s)
+        mask = (pos[None, :] <= pos[:, None])[None, None]
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _qkv(b=2, s=64, h=4, d=16, dtype=jnp.float32):
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, s, h, d)), dtype)
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    q, k, v = _qkv()
+    mesh = make_parallel_mesh(sp=8)
+    spec = P(None, "sp", None, None)
+    qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                  for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh=mesh, causal=causal)
+    ref = _dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(causal):
+    q, k, v = _qkv(h=8)
+    mesh = make_parallel_mesh(sp=8)
+    spec = P(None, "sp", None, None)
+    qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                  for x in (q, k, v))
+    out = ulysses_attention(qs, ks, vs, mesh=mesh, causal=causal)
+    ref = _dense_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_2d_mesh_dp_sp():
+    """Ring attention composed with data parallelism on a dp×sp mesh."""
+    q, k, v = _qkv(b=4, s=32)
+    mesh = make_parallel_mesh(dp=2, sp=4)
+    spec = P("dp", "sp", None, None)
+    qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                  for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh=mesh, seq_specs=spec, causal=True)
+    ref = _dense_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_jit_under_mesh():
+    """ring attention shard fn embedded in a jitted program compiles once
+    and matches; exercises the collective-inside-fori_loop path."""
+    q, k, v = _qkv(s=32)
+    mesh = make_parallel_mesh(sp=8)
+    spec = P(None, "sp", None, None)
+    qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                  for x in (q, k, v))
+
+    @jax.jit
+    def step(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh, causal=True) * 2.0
+
+    out = step(qs, ks, vs)
+    ref = _dense_reference(q, k, v, True) * 2.0
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
