@@ -1,11 +1,20 @@
-"""The elastic run wrapper (reference ``horovod/common/elastic.py:147``)."""
+"""The elastic run wrapper (reference ``horovod/common/elastic.py:147``
+``run_fn`` + the worker side of the re-rendezvous protocol,
+``runner/elastic/worker.py``)."""
 
 from __future__ import annotations
 
 import functools
+import json
+import os
+import socket
+import time
+import urllib.error
 
 from horovod_tpu.common.exceptions import (HorovodInternalError,
                                            HostsUpdatedInterrupt)
+
+_LOCAL_NAMES = ("localhost", "127.0.0.1")
 
 
 def run(func):
@@ -17,6 +26,11 @@ def run(func):
       restore() to the last commit, then re-initialize and retry.
     - HostsUpdatedInterrupt (driver notified a host change at commit()):
       keep current state, re-initialize and retry (sync unless skip_sync).
+
+    Under an elastic launch (``HVT_RENDEZVOUS_ADDR`` set), each
+    re-initialization reports READY to the driver and blocks on the
+    rendezvous for the next round's slot assignment (new rank/size/master)
+    before re-joining; a worker whose slot was dropped exits cleanly.
     """
 
     @functools.wraps(func)
@@ -24,11 +38,12 @@ def run(func):
         from horovod_tpu.runner.elastic import notification
 
         notification.init_worker_notification(state)
+        round_ = _sync_slot_from_rendezvous(0)
         reset_required = False
         skip_sync = False
         while True:
             if reset_required:
-                _reset()
+                round_ = _reset(round_)
                 state.on_reset()
             try:
                 if not skip_sync:
@@ -44,11 +59,111 @@ def run(func):
     return wrapper
 
 
-def _reset():
-    """Re-initialize the runtime after a world change: shutdown + init gives
-    a fresh rendezvous and a fresh mesh (the analog of the reference's
+def _reset(last_round: int) -> int:
+    """Re-initialize the runtime after a world change: report READY, wait
+    for the new round's slot assignment, then shutdown + init gives a
+    fresh rendezvous and a fresh mesh (the analog of the reference's
     shutdown/init cycle inside reset, ``common/elastic.py:95-109``)."""
     from horovod_tpu.common import basics
 
+    _report_state("READY", last_round)
     basics.shutdown()
+    new_round = _sync_slot_from_rendezvous(last_round)
     basics.init()
+    return new_round
+
+
+def _elastic_addr():
+    return os.environ.get("HVT_RENDEZVOUS_ADDR")
+
+
+_identity = None
+
+
+def _my_identity():
+    """Spawn-time (host, local_rank) — cached, because it is this
+    process's stable identity toward the driver even after
+    ``_apply_slot_env`` rewrites the env for a new round."""
+    global _identity
+    if _identity is None:
+        _identity = (os.environ.get("HVT_HOSTNAME") or socket.gethostname(),
+                     os.environ.get("HVT_LOCAL_PROCESS_ID", "0"))
+    return _identity
+
+
+def _report_state(state_name: str, round_: int):
+    addr = _elastic_addr()
+    if not addr:
+        return
+    from horovod_tpu.runner.http_client import put_json
+
+    host, slot = _my_identity()
+    try:
+        put_json(addr, f"/kv/state/{host}/{slot}",
+                 {"state": state_name, "round": round_}, timeout=5)
+    except OSError:
+        pass
+
+
+def _sync_slot_from_rendezvous(last_round: int,
+                               timeout: float = 600.0) -> int:
+    """Block until the rendezvous publishes a round newer than
+    ``last_round`` containing our (host, local_rank) slot, then update the
+    process env (rank/size/cross/master) for ``basics.init``.
+
+    Returns the new round number. No-op (returns ``last_round``) outside
+    an elastic launch. Exits the process cleanly when our slot was
+    dropped from the new assignment.
+    """
+    addr = _elastic_addr()
+    if not addr:
+        return last_round
+    from horovod_tpu.runner.http_client import get_json
+
+    host, slot = _my_identity()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        info = world = None
+        try:
+            world = get_json(addr, "/world")
+            info = get_json(addr, f"/rendezvous/{host}/{slot}")
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+        except OSError:
+            pass
+        if world and world.get("round", 0) > last_round:
+            if info is None or info.get("round", 0) != world["round"]:
+                if info is None:
+                    # new round exists and we are not in it → retire
+                    raise SystemExit(0)
+            else:
+                _apply_slot_env(info, world)
+                return world["round"]
+        time.sleep(0.25)
+    raise TimeoutError(
+        f"elastic worker {host}/{slot} timed out waiting for round "
+        f"> {last_round} from rendezvous {addr}")
+
+
+def _apply_slot_env(info: dict, world: dict):
+    env = os.environ
+    env["HVT_PROCESS_ID"] = str(info["rank"])
+    env["HVT_NUM_PROCESSES"] = str(info["size"])
+    env["HVT_LOCAL_PROCESS_ID"] = str(info["local_rank"])
+    env["HVT_LOCAL_SIZE"] = str(info["local_size"])
+    env["HVT_CROSS_RANK"] = str(info["cross_rank"])
+    env["HVT_CROSS_SIZE"] = str(info["cross_size"])
+    master_host = world.get("master_host")
+    if master_host and env.get("HVT_MASTER_ADDR"):
+        if master_host in _LOCAL_NAMES or \
+                master_host == socket.gethostname():
+            env["HVT_MASTER_ADDR"] = "127.0.0.1"
+        else:
+            env["HVT_MASTER_ADDR"] = master_host
+        # rotate the engine control port per round so a lingering listener
+        # from the previous round can't collide with the new master
+        base = int(env.get("HVT_MASTER_PORT_BASE",
+                           env.get("HVT_MASTER_PORT", "29510")))
+        env.setdefault("HVT_MASTER_PORT_BASE", str(base))
+        env["HVT_MASTER_PORT"] = str(base + world["round"] % 64)

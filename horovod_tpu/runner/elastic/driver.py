@@ -1,0 +1,316 @@
+"""Elastic driver (reference ``horovod/runner/elastic/driver.py``:
+``ElasticDriver:68`` — discovery thread ``_discover_hosts:177`` (1 s
+poll), ``_update_host_assignments:228`` (stable ranks, requires ≥1
+surviving host), ``_start_worker_process:277``,
+``_handle_worker_exit:292``).
+
+Orchestrates a fault-tolerant job:
+
+- polls a HostDiscovery source; on a host-set change notifies workers so
+  their next ``state.commit()`` raises HostsUpdatedInterrupt;
+- assigns ranks to (host, slot) pairs, keeping surviving workers' ranks
+  stable across rounds;
+- spawns one worker per slot via a pluggable ``create_worker_fn`` (the
+  launcher passes an ssh/subprocess spawner; tests pass fakes);
+- feeds worker exits into the WorkerStateRegistry, whose barrier calls
+  back into ``resume()`` (new round) or ``stop()``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from horovod_tpu.runner.elastic.discovery import HostManager
+from horovod_tpu.runner.elastic.registration import WorkerStateRegistry
+from horovod_tpu.runner.hosts import HostInfo, SlotInfo, \
+    get_host_assignments
+from horovod_tpu.runner.elastic.settings import ElasticSettings
+
+_NOTIFY_SCOPE = "workers"
+
+
+class ElasticDriver:
+    def __init__(self, rendezvous, discovery, settings: ElasticSettings,
+                 create_worker_fn: Optional[Callable] = None,
+                 on_stop: Optional[Callable] = None):
+        self._on_stop = on_stop
+        self._rendezvous = rendezvous
+        self._settings = settings
+        self._host_manager = HostManager(
+            discovery, cooldown_range=settings.cooldown_range)
+        self._registry = WorkerStateRegistry(
+            self, self._host_manager, reset_limit=settings.reset_limit,
+            verbose=settings.verbose)
+        self._create_worker_fn = create_worker_fn
+        self._lock = threading.Lock()
+        self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
+        self._workers: Dict[Tuple[str, int], threading.Thread] = {}
+        self._results: Dict[int, int] = {}     # rank → exit code
+        self._shutdown = threading.Event()
+        self._finished = threading.Event()
+        self._error: Optional[str] = None
+        self._discovery_thread = threading.Thread(
+            target=self._discover_hosts, daemon=True)
+        if hasattr(rendezvous, "set_put_hook"):
+            rendezvous.set_put_hook(self._on_kv_put)
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def registry(self) -> WorkerStateRegistry:
+        return self._registry
+
+    @property
+    def host_manager(self) -> HostManager:
+        return self._host_manager
+
+    def start(self, np: int, create_worker_fn: Optional[Callable] = None):
+        """Wait for min_np slots, assign ranks, spawn workers, start the
+        discovery poll. ``np`` is the preferred initial world size."""
+        if create_worker_fn is not None:
+            self._create_worker_fn = create_worker_fn
+        self._host_manager.update_available_hosts()
+        self.wait_for_available_slots(self._settings.min_np)
+        self._activate_round(np)
+        self._discovery_thread.start()
+
+    def resume(self):
+        """Start a new rendezvous round after a failure or host update."""
+        if self._shutdown.is_set():
+            return
+        # take a fresh discovery snapshot so the new assignment reflects
+        # hosts that died/joined since the last poll
+        try:
+            self._host_manager.update_available_hosts()
+        except Exception:
+            pass
+        try:
+            self._activate_round(self._preferred_np())
+        except RuntimeError:
+            # stop(error=True) was already called with the reason
+            pass
+
+    def stop(self, error: bool = False, reason: Optional[str] = None):
+        if error:
+            self._error = reason or "elastic job failed"
+        self._shutdown.set()
+        self._finished.set()
+        if self._on_stop is not None:
+            try:
+                self._on_stop()
+            except Exception:
+                pass
+
+    def finished(self) -> bool:
+        return self._finished.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._finished.wait(timeout)
+
+    @property
+    def error(self) -> Optional[str]:
+        return self._error
+
+    def get_results(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._results)
+
+    def world_size(self) -> int:
+        with self._lock:
+            return len(self._assignments)
+
+    def get_slot_info(self, host: str, slot: int) -> Optional[SlotInfo]:
+        with self._lock:
+            return self._assignments.get((host, slot))
+
+    def has_rank_assignment(self, host: str, slot: int) -> bool:
+        return self.get_slot_info(host, slot) is not None
+
+    def wait_for_available_slots(self, min_np: int,
+                                 timeout: Optional[float] = None):
+        """Block until discovery shows ≥ min_np usable slots (reference
+        ``driver.py`` wait_for_available_slots with elastic_timeout)."""
+        deadline = time.time() + (timeout if timeout is not None
+                                  else self._settings.elastic_timeout)
+        while True:
+            hosts = self._host_manager.current_hosts
+            if hosts.count_available_slots() >= min_np:
+                return hosts
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {min_np} slots; discovered "
+                    f"{hosts.count_available_slots()} "
+                    f"({hosts.host_slots})")
+            self._host_manager.update_available_hosts()
+            time.sleep(self._settings.discovery_interval)
+
+    # -------------------------------------------------- worker-facing hooks
+
+    def record_ready(self, host: str, slot: int):
+        self._registry.record_ready(host, slot)
+
+    def _on_kv_put(self, scope: str, key: str, value: bytes):
+        """Rendezvous PUT hook: live workers report READY when they hit a
+        reset without exiting (reference workers PUT state to the
+        rendezvous the same way, ``registration.py:28``). Reports carry
+        the worker's round; stale-round reports are dropped so a slow
+        READY can't leak into the next round's barrier."""
+        if scope != "state":
+            return
+        try:
+            host, slot = key.rsplit("/", 1)
+            body = json.loads(value)
+            state = str(body.get("state", "")).upper()
+            rnd = int(body.get("round", -1))
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return
+        if rnd >= 0 and rnd != self._rendezvous_round():
+            return
+        if state == "READY":
+            self._registry.record_ready(host, int(slot))
+
+    def _rendezvous_round(self) -> int:
+        return getattr(self._rendezvous, "round", -1)
+
+    def _handle_worker_exit(self, host: str, slot: int, exit_code: int):
+        """A worker process exited. Count it toward the current round's
+        barrier iff its (host, slot) is still assigned — workers are
+        long-lived across rounds, so exits are always 'current' unless the
+        host was dropped from the assignment."""
+        slot_info = self.get_slot_info(host, slot)
+        with self._lock:
+            self._workers.pop((host, slot), None)
+            if slot_info is not None:
+                self._results[slot_info.rank] = exit_code
+        if slot_info is None:
+            if exit_code != 0 and not self._shutdown.is_set():
+                self._host_manager.blacklist(host)
+            return
+        if exit_code == 0:
+            self._registry.record_success(host, slot)
+        else:
+            self._registry.record_failure(host, slot)
+
+    # ------------------------------------------------------------ internals
+
+    def _preferred_np(self) -> int:
+        avail = self._host_manager.current_hosts.count_available_slots()
+        if self._settings.max_np is not None:
+            avail = min(avail, self._settings.max_np)
+        return max(avail, self._settings.min_np)
+
+    def _activate_round(self, np: int):
+        slots = self._update_host_assignments(np)
+        self._rendezvous.init(slots)
+        self._registry.reset(len(slots))
+        with self._lock:
+            # results are per-round: a rank that failed in a superseded
+            # round must not make a successfully recovered job exit 1
+            self._results = {}
+        if self._create_worker_fn is not None:
+            self._start_missing_workers()
+
+    def _update_host_assignments(self, np: int):
+        """Recompute rank assignments over the current hosts, keeping
+        surviving (host, slot) pairs on their previous ranks where
+        possible. Raises if no host survived — elastic recovery needs at
+        least one live copy of the state (reference ``driver.py:228``)."""
+        hosts_snapshot = self._host_manager.current_hosts
+        host_list = [HostInfo(h, hosts_snapshot.host_slots[h])
+                     for h in hosts_snapshot.host_assignment_order]
+        avail = sum(h.slots for h in host_list)
+        np = min(np, avail)
+        if self._settings.max_np is not None:
+            np = min(np, self._settings.max_np)
+        if np < self._settings.min_np:
+            self.stop(error=True,
+                      reason=f"available slots ({avail}) fell below "
+                             f"min_np ({self._settings.min_np})")
+            raise RuntimeError(self._error)
+        with self._lock:
+            had_assignments = bool(self._assignments)
+            surviving = [k for k in self._assignments
+                         if k[0] in hosts_snapshot.host_slots
+                         and k[1] < hosts_snapshot.host_slots[k[0]]]
+            if had_assignments and not surviving:
+                self.stop(error=True,
+                          reason="no hosts from the previous round "
+                                 "survived; training state is lost")
+                raise RuntimeError(self._error)
+            slots = get_host_assignments(host_list, np)
+            self._assignments = {(s.hostname, s.local_rank): s
+                                 for s in slots}
+        return slots
+
+    def _start_missing_workers(self):
+        started = []
+        with self._lock:
+            to_start = [key for key in self._assignments
+                        if key not in self._workers]
+            for key in to_start:
+                slot_info = self._assignments[key]
+                t = threading.Thread(
+                    target=self._run_worker,
+                    args=(key[0], key[1], slot_info), daemon=True)
+                self._workers[key] = t
+                started.append(t)
+        for t in started:
+            t.start()
+
+    def _run_worker(self, host: str, slot: int, slot_info: SlotInfo):
+        try:
+            exit_code = self._create_worker_fn(slot_info)
+        except Exception:
+            exit_code = 1
+        self._handle_worker_exit(host, slot, exit_code)
+
+    def _discover_hosts(self):
+        while not self._shutdown.is_set():
+            try:
+                changed = self._host_manager.update_available_hosts()
+            except Exception:
+                changed = False
+            if changed:
+                self._notify_workers_host_changes()
+                self._start_missing_workers_if_growing()
+            self._shutdown.wait(self._settings.discovery_interval)
+
+    def _start_missing_workers_if_growing(self):
+        # New hosts don't get workers until the next round — workers join
+        # at rendezvous boundaries, exactly like the reference (spawn
+        # happens in _activate_round via resume()).
+        pass
+
+    def _notify_workers_host_changes(self):
+        """PUT a host-update to every registered worker notification
+        server (reference ``driver.py:198-226`` notifies the coordinator;
+        we notify all registered workers — same observable effect: the
+        next commit raises HostsUpdatedInterrupt)."""
+        addrs = self._worker_notify_addrs()
+        if not addrs:
+            return
+        from horovod_tpu.runner.http_client import put_json
+
+        payload = {"timestamp": time.time(), "res": 1}
+        for addr in addrs:
+            try:
+                put_json(addr, "/notify", payload, timeout=2)
+            except OSError:
+                continue
+
+    def _worker_notify_addrs(self):
+        store = getattr(self._rendezvous, "store", None)
+        if store is None:
+            return []
+        addrs = []
+        for key in store.keys(_NOTIFY_SCOPE):
+            raw = store.get(_NOTIFY_SCOPE, key)
+            try:
+                info = json.loads(raw)
+                addrs.append(f"{info['host']}:{info['port']}")
+            except (ValueError, KeyError, TypeError):
+                continue
+        return addrs

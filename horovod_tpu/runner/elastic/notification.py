@@ -70,9 +70,18 @@ class WorkerNotificationManager:
         if addr:
             from horovod_tpu.runner.http_client import put_json
 
-            rank = os.environ.get("HVT_PROCESS_ID", "0")
-            put_json(addr, f"/worker/{rank}/notify",
-                     {"host": "127.0.0.1", "port": self._port})
+            # key by stable spawn identity (host, local_rank), not rank —
+            # ranks reshuffle across rounds and a rank-keyed registration
+            # would let a new worker overwrite a live survivor's entry
+            import socket as _socket
+
+            host = os.environ.get("HVT_HOSTNAME") or _socket.gethostname()
+            slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
+            try:
+                put_json(addr, f"/kv/workers/{host}/{slot}",
+                         {"host": "127.0.0.1", "port": self._port})
+            except OSError:
+                pass
 
 
 def init_worker_notification(state):

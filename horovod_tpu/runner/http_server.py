@@ -34,9 +34,11 @@ class _Store:
         with self.lock:
             return list(self.scopes.get(scope, {}).keys())
 
-    def clear(self):
+    def clear(self, keep_scopes=()):
         with self.lock:
-            self.scopes.clear()
+            kept = {s: v for s, v in self.scopes.items()
+                    if s in keep_scopes}
+            self.scopes = kept
 
 
 class RendezvousServer:
@@ -50,30 +52,53 @@ class RendezvousServer:
       DELETE  /rendezvous               — finalize round (elastic)
     """
 
-    def __init__(self, verbose=False):
+    def __init__(self, verbose=False, on_put=None):
         self._store = _Store()
         self._slots = {}
         self._world = {}
         self._server = None
         self._verbose = verbose
+        self._round = 0
+        self._on_put = on_put
+
+    def set_put_hook(self, fn):
+        """``fn(scope, key, value_bytes)`` called on every /kv PUT — the
+        elastic driver uses this to receive worker state reports."""
+        self._on_put = fn
 
     def init(self, slots):
         """(Re)initialize with a host allocation plan — one call per
-        elastic rendezvous round (reference http_server.py:195)."""
-        self._store.clear()
+        elastic rendezvous round (reference http_server.py:195). Worker
+        notification registrations survive the reset — workers register
+        once, at first state init. Each init bumps ``round`` so workers
+        re-rendezvousing can tell fresh slot info from the previous
+        round's."""
+        self._store.clear(keep_scopes=("workers",))
+        self._round += 1
         self._slots = {
             f"{s.hostname}/{s.local_rank}": {
                 "hostname": s.hostname, "rank": s.rank,
                 "local_rank": s.local_rank, "cross_rank": s.cross_rank,
                 "size": s.size, "local_size": s.local_size,
-                "cross_size": s.cross_size,
+                "cross_size": s.cross_size, "round": self._round,
             } for s in slots
         }
         self._world = {"size": len(slots),
-                       "hosts": sorted({s.hostname for s in slots})}
+                       "hosts": sorted({s.hostname for s in slots}),
+                       "master_host": slots[0].hostname if slots else None,
+                       "round": self._round}
+
+    @property
+    def round(self):
+        return self._round
+
+    @property
+    def world(self):
+        return dict(self._world)
 
     def start(self, port=0) -> int:
         store, slots_ref, world_ref = self._store, self, self
+        server_ref = self
 
         class Handler(BaseHTTPRequestHandler):
             def _send(self, code, body=b"", ctype="application/octet-stream"):
@@ -90,6 +115,12 @@ class RendezvousServer:
                 body = self.rfile.read(n)
                 if len(parts) >= 3 and parts[0] == "kv":
                     store.put(parts[1], "/".join(parts[2:]), body)
+                    hook = server_ref._on_put
+                    if hook is not None:
+                        try:
+                            hook(parts[1], "/".join(parts[2:]), body)
+                        except Exception:
+                            pass
                     self._send(200)
                 else:
                     self._send(404)
@@ -120,7 +151,7 @@ class RendezvousServer:
 
             def do_DELETE(self):
                 if self.path.strip("/") == "rendezvous":
-                    store.clear()
+                    store.clear(keep_scopes=("workers",))
                     self._send(200)
                 else:
                     self._send(404)
@@ -136,6 +167,10 @@ class RendezvousServer:
     @property
     def port(self):
         return self._server.server_address[1] if self._server else None
+
+    @property
+    def store(self):
+        return self._store
 
     def stop(self):
         if self._server:

@@ -56,6 +56,22 @@ def parse_args(argv=None):
                    help="engine: C++ TCP collectives (CPU/eager); jax: "
                         "jax.distributed bring-up (one process per TPU "
                         "host)")
+    elastic = p.add_argument_group(
+        "elastic", "fault-tolerant launch (reference launch.py:392 "
+        "--min-np/--max-np/--host-discovery-script)")
+    elastic.add_argument("--min-np", type=int, default=None,
+                         help="minimum world size; enables elastic mode")
+    elastic.add_argument("--max-np", type=int, default=None,
+                         help="maximum world size (default: -np)")
+    elastic.add_argument("--host-discovery-script", default=None,
+                         help="executable printing one 'host:slots' per "
+                              "line; polled every second")
+    elastic.add_argument("--reset-limit", type=int, default=None,
+                         help="max re-rendezvous rounds before failing")
+    elastic.add_argument("--elastic-timeout", type=float, default=600.0,
+                         help="seconds to wait for min-np slots")
+    elastic.add_argument("--slots", type=int, default=1,
+                         help="default slots per discovered host")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
@@ -69,6 +85,18 @@ def parse_args(argv=None):
 
 def _is_local(hostname: str) -> bool:
     return hostname in _LOCAL_NAMES or hostname == socket.gethostname()
+
+
+def _ssh_command(env, hostname, ssh_port, command):
+    """Build the per-slot ssh command with inline env (reference
+    gloo_run.py:114-145). Shared by the static and elastic paths."""
+    inline = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in env.items()
+        if k.startswith("HVT_") or k in ("PATH", "PYTHONPATH"))
+    remote = f"cd {shlex.quote(os.getcwd())} && env {inline} " + \
+        " ".join(shlex.quote(c) for c in command)
+    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p",
+            str(ssh_port), hostname, remote]
 
 
 def slot_env(base_env, slot, args, master_addr):
@@ -105,20 +133,108 @@ def build_commands(args, slots, master_addr, base_env=None):
         if _is_local(slot.hostname):
             cmds.append((list(args.command), env, slot.rank))
         else:
-            # ssh with inline env (reference gloo_run.py:114-145)
-            inline = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in env.items()
-                if k.startswith("HVT_") or k in ("PATH", "PYTHONPATH"))
-            remote = f"cd {shlex.quote(os.getcwd())} && env {inline} " + \
-                " ".join(shlex.quote(c) for c in args.command)
-            cmds.append((["ssh", "-o", "StrictHostKeyChecking=no", "-p",
-                          str(args.ssh_port), slot.hostname, remote],
+            cmds.append((_ssh_command(env, slot.hostname, args.ssh_port,
+                                      args.command),
                          dict(os.environ), slot.rank))
     return cmds
 
 
+def _run_elastic(args) -> int:
+    """Elastic launch: start the ElasticDriver + rendezvous server, spawn
+    one training subprocess per assigned slot, restart rounds on host
+    changes / failures (reference ``launch.py:619`` _run_elastic)."""
+    from horovod_tpu.runner.elastic.discovery import (FixedHostDiscovery,
+                                                      HostDiscoveryScript)
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.settings import ElasticSettings
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        default_slots=args.slots)
+    elif args.hosts:
+        discovery = FixedHostDiscovery(args.hosts)
+    else:
+        discovery = FixedHostDiscovery(f"localhost:{args.num_proc}")
+    settings = ElasticSettings(
+        min_np=args.min_np or args.num_proc,
+        max_np=args.max_np or args.num_proc,
+        elastic_timeout=args.elastic_timeout,
+        reset_limit=args.reset_limit, verbose=args.verbose)
+    rendezvous = RendezvousServer(verbose=args.verbose)
+    rendezvous_port = rendezvous.start()
+
+    def driver_addr_for(slot_hostname):
+        # a remote worker must reach the rendezvous on the *launcher*
+        # host, not on itself
+        return ("127.0.0.1" if _is_local(slot_hostname)
+                else socket.gethostname())
+
+    children = set()
+    children_lock = __import__("threading").Lock()
+
+    def create_worker(slot):
+        drv_addr = driver_addr_for(slot.hostname)
+        mh = (rendezvous.world or {}).get("master_host") or slot.hostname
+        master = "127.0.0.1" if _is_local(slot.hostname) and \
+            _is_local(mh) else mh
+        env = slot_env(dict(os.environ), slot, args, master)
+        env["HVT_ELASTIC"] = "1"
+        env["HVT_ELASTIC_NOTIFY_ADDR"] = f"{drv_addr}:{rendezvous_port}"
+        env["HVT_RENDEZVOUS_ADDR"] = f"{drv_addr}:{rendezvous_port}"
+        # per-round engine port, so a worker spawned into round N joins the
+        # same control star as survivors re-initializing into round N (see
+        # elastic/run.py _apply_slot_env)
+        env["HVT_MASTER_PORT_BASE"] = str(args.master_port)
+        env["HVT_MASTER_PORT"] = str(
+            args.master_port + rendezvous.round % 64)
+        if _is_local(slot.hostname):
+            cmd = list(args.command)
+        else:
+            cmd = _ssh_command(env, slot.hostname, args.ssh_port,
+                               args.command)
+            env = dict(os.environ)
+        child = safe_exec.Child(cmd, env, tag=slot.rank)
+        with children_lock:
+            children.add(child)
+        try:
+            return child.wait()
+        finally:
+            with children_lock:
+                children.discard(child)
+
+    def terminate_children():
+        with children_lock:
+            live = list(children)
+        for c in live:
+            c.terminate()
+
+    driver = ElasticDriver(rendezvous, discovery, settings,
+                           create_worker_fn=create_worker,
+                           on_stop=terminate_children)
+    try:
+        driver.start(args.num_proc)
+        driver.wait()
+    finally:
+        terminate_children()
+        rendezvous.stop()
+    if driver.error:
+        print(f"[hvtrun] elastic job failed: {driver.error}",
+              file=sys.stderr)
+        return 1
+    results = driver.get_results()
+    bad = {r: rc for r, rc in results.items() if rc != 0}
+    if bad:
+        print(f"[hvtrun] ranks failed: {sorted(bad.items())}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.min_np is not None or args.host_discovery_script:
+        return _run_elastic(args)
     if args.hostfile:
         hosts = parse_hostfile(args.hostfile)
     elif args.hosts:

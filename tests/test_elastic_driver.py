@@ -1,0 +1,320 @@
+"""Elastic driver unit tests — fake discovery + simulated worker exits,
+no real processes (the reference's ``test/single/test_elastic_driver.py``
+strategy)."""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import (DiscoveredHosts,
+                                                  FixedHostDiscovery,
+                                                  HostDiscovery,
+                                                  HostDiscoveryScript,
+                                                  HostManager)
+from horovod_tpu.runner.elastic.driver import ElasticDriver
+from horovod_tpu.runner.elastic.registration import (FAILURE, READY, SUCCESS,
+                                                     WorkerStateRegistry)
+from horovod_tpu.runner.elastic.settings import ElasticSettings
+from horovod_tpu.runner.http_server import RendezvousServer
+
+
+class SequenceDiscovery(HostDiscovery):
+    """Yields scripted host sets; the last entry repeats forever."""
+
+    def __init__(self, *host_sets):
+        self._sets = list(host_sets)
+        self._i = 0
+
+    def find_available_hosts_and_slots(self):
+        hosts = self._sets[min(self._i, len(self._sets) - 1)]
+        self._i += 1
+        return dict(hosts)
+
+
+def make_driver(discovery, min_np=1, max_np=None, reset_limit=None,
+                interval=0.01, worker_fn=None):
+    settings = ElasticSettings(min_np=min_np, max_np=max_np,
+                               elastic_timeout=5.0,
+                               reset_limit=reset_limit,
+                               discovery_interval=interval)
+    rendezvous = RendezvousServer()
+    driver = ElasticDriver(rendezvous, discovery, settings,
+                           create_worker_fn=worker_fn)
+    return driver, rendezvous
+
+
+def wait_until(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# --------------------------------------------------------------- discovery
+
+def test_discovery_script_parses_host_slots(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\necho host-1:2\necho host-2\necho '  '\n")
+    script.chmod(0o755)
+    d = HostDiscoveryScript(str(script), default_slots=4)
+    assert d.find_available_hosts_and_slots() == {"host-1": 2, "host-2": 4}
+
+
+def test_discovery_script_failure_yields_empty(tmp_path):
+    script = tmp_path / "discover.sh"
+    script.write_text("#!/bin/sh\nexit 1\n")
+    script.chmod(0o755)
+    d = HostDiscoveryScript(str(script))
+    assert d.find_available_hosts_and_slots() == {}
+
+
+def test_host_manager_stable_order():
+    mgr = HostManager(SequenceDiscovery({"a": 2, "b": 2},
+                                        {"b": 2, "c": 2, "a": 2}))
+    assert mgr.update_available_hosts()
+    first = mgr.current_hosts.host_assignment_order
+    assert mgr.update_available_hosts()
+    second = mgr.current_hosts.host_assignment_order
+    # surviving hosts keep relative order; new hosts append
+    assert second[:2] == first
+    assert second[-1] == "c"
+
+
+def test_host_manager_blacklist():
+    mgr = HostManager(FixedHostDiscovery({"a": 2, "b": 2}))
+    mgr.update_available_hosts()
+    mgr.blacklist("b")
+    assert mgr.is_blacklisted("b")
+    assert mgr.current_hosts.host_slots == {"a": 2}
+    # blacklisted hosts do not come back on re-poll
+    mgr.update_available_hosts()
+    assert "b" not in mgr.current_hosts.host_slots
+
+
+def test_host_manager_blacklist_cooldown():
+    mgr = HostManager(FixedHostDiscovery({"a": 1}),
+                      cooldown_range=(0.01, 0.02))
+    mgr.update_available_hosts()
+    mgr.blacklist("a")
+    assert mgr.is_blacklisted("a")
+    time.sleep(0.05)
+    assert not mgr.is_blacklisted("a")
+    mgr.update_available_hosts()
+    assert mgr.current_hosts.host_slots == {"a": 1}
+
+
+def test_discovered_hosts_count():
+    h = DiscoveredHosts({"a": 2, "b": 3}, ["a", "b"])
+    assert h.count_available_slots() == 5
+
+
+# ---------------------------------------------------------------- registry
+
+class FakeDriver:
+    def __init__(self):
+        self.resumed = 0
+        self.stopped = None
+
+    def resume(self):
+        self.resumed += 1
+
+    def stop(self, error=False, reason=None):
+        self.stopped = (error, reason)
+
+
+def test_registry_all_success_stops_cleanly():
+    drv = FakeDriver()
+    mgr = HostManager(FixedHostDiscovery({"a": 2}))
+    reg = WorkerStateRegistry(drv, mgr)
+    reg.reset(2)
+    reg.record_success("a", 0)
+    assert drv.stopped is None
+    reg.record_success("a", 1)
+    assert drv.stopped == (False, None)
+    assert drv.resumed == 0
+
+
+def test_registry_failure_triggers_resume_and_blacklist():
+    drv = FakeDriver()
+    mgr = HostManager(FixedHostDiscovery({"a": 1, "b": 1}))
+    mgr.update_available_hosts()
+    reg = WorkerStateRegistry(drv, mgr)
+    reg.reset(2)
+    reg.record_failure("b", 0)
+    reg.record_success("a", 0)
+    assert drv.resumed == 1
+    assert mgr.is_blacklisted("b")
+    assert not mgr.is_blacklisted("a")
+
+
+def test_registry_ready_counts_toward_barrier():
+    drv = FakeDriver()
+    mgr = HostManager(FixedHostDiscovery({"a": 2}))
+    reg = WorkerStateRegistry(drv, mgr)
+    reg.reset(2)
+    reg.record_ready("a", 0)
+    reg.record_ready("a", 1)
+    # READY workers want a new round, not shutdown
+    assert drv.resumed == 1
+    assert drv.stopped is None
+    # host with READY (not all-FAILURE) slots must not be blacklisted
+    assert not mgr.is_blacklisted("a")
+
+
+def test_registry_reset_limit():
+    drv = FakeDriver()
+    mgr = HostManager(FixedHostDiscovery({"a": 1}))
+    reg = WorkerStateRegistry(drv, mgr, reset_limit=1)
+    reg.reset(1)
+    reg.record_failure("a", 0)
+    assert drv.resumed == 1           # first reset allowed
+    reg.reset(1)
+    reg.record_failure("a", 0)
+    assert drv.stopped is not None and drv.stopped[0] is True
+    assert "reset count" in drv.stopped[1]
+
+
+def test_registry_first_terminal_state_wins():
+    drv = FakeDriver()
+    mgr = HostManager(FixedHostDiscovery({"a": 2}))
+    reg = WorkerStateRegistry(drv, mgr)
+    reg.reset(2)
+    reg.record_failure("a", 0)
+    reg.record_success("a", 0)        # must not overwrite FAILURE
+    assert reg.count(FAILURE) == 1
+    assert reg.count(SUCCESS) == 0
+
+
+# ------------------------------------------------------------------ driver
+
+def test_driver_initial_assignment_and_success():
+    done = threading.Event()
+
+    def worker(slot):
+        done.wait(2)
+        return 0
+
+    driver, _ = make_driver(FixedHostDiscovery({"host-1": 2, "host-2": 2}),
+                            min_np=4, worker_fn=worker)
+    driver.start(4)
+    assert driver.world_size() == 4
+    for host in ("host-1", "host-2"):
+        for slot in range(2):
+            assert driver.has_rank_assignment(host, slot)
+    info = driver.get_slot_info("host-1", 0)
+    assert info.rank == 0 and info.size == 4 and info.cross_size == 2
+    done.set()
+    assert driver.wait(5)
+    assert driver.error is None
+    assert set(driver.get_results().values()) == {0}
+
+
+def test_driver_rank_stability_across_rounds():
+    """When a host dies mid-job, surviving (host, slot) pairs keep their
+    ranks in the next round (reference driver.py:228 stable ranks)."""
+    rounds = []
+    fail_first = threading.Event()
+    fail_first.set()
+
+    def worker(slot):
+        rounds.append((slot.hostname, slot.local_rank, slot.rank,
+                       slot.size))
+        if slot.hostname == "host-2" and fail_first.is_set():
+            fail_first.clear()
+            return 1          # host-2 dies in round 1
+        return 0
+
+    driver, _ = make_driver(
+        SequenceDiscovery({"host-1": 2, "host-2": 2}, {"host-1": 2}),
+        min_np=2, max_np=4, worker_fn=worker)
+    driver.start(4)
+    assert driver.wait(10)
+    assert driver.error is None
+    r1 = {(h, s): r for h, s, r, _ in rounds[:4]}
+    r2 = {(h, s): r for h, s, r, _ in rounds[4:]}
+    assert set(r2) == {("host-1", 0), ("host-1", 1)}
+    for key in r2:
+        assert r2[key] == r1[key]
+
+
+def test_driver_stops_when_below_min_np():
+    def worker(slot):
+        return 1 if slot.hostname == "host-2" else 0
+
+    driver, _ = make_driver(FixedHostDiscovery({"host-1": 1, "host-2": 1}),
+                            min_np=2, worker_fn=worker)
+    driver.start(2)
+    assert driver.wait(10)
+    # host-2 blacklisted → 1 slot < min_np=2 → error stop
+    assert driver.error is not None
+    assert "min_np" in driver.error
+
+
+def test_driver_wait_for_available_slots_timeout():
+    driver, _ = make_driver(FixedHostDiscovery({}), min_np=1)
+    with pytest.raises(TimeoutError):
+        driver.wait_for_available_slots(1, timeout=0.2)
+
+
+def test_driver_discovery_notifies_workers():
+    """A host-set change is PUT to every registered worker notification
+    endpoint (reference driver.py:198-226)."""
+    from horovod_tpu.runner.elastic.notification import \
+        WorkerNotificationManager
+
+    class RecordingState:
+        def __init__(self):
+            self.updates = []
+
+        def on_hosts_updated(self, ts, res):
+            self.updates.append((ts, res))
+
+    hold = threading.Event()
+
+    def worker(slot):
+        hold.wait(5)
+        return 0
+
+    driver, rendezvous = make_driver(
+        SequenceDiscovery({"localhost": 2}, {"localhost": 2},
+                          {"localhost": 2, "host-x": 2}),
+        min_np=2, max_np=4, worker_fn=worker)
+    rendezvous.start()
+    mgr = WorkerNotificationManager()
+    mgr.start_server()
+    state = RecordingState()
+    mgr.register_state(state)
+    rendezvous.store.put(
+        "workers", "0",
+        ('{"host": "127.0.0.1", "port": %d}' % mgr.port).encode())
+    driver.start(2)
+    assert wait_until(lambda: state.updates, timeout=5)
+    hold.set()
+    driver.stop()
+    rendezvous.stop()
+
+
+def test_driver_grow_on_resume():
+    """After a failure round, newly discovered hosts are folded into the
+    next assignment up to max_np."""
+    sizes = []
+    failed_once = threading.Event()
+
+    def worker(slot):
+        sizes.append(slot.size)
+        if not failed_once.is_set():
+            failed_once.set()
+            return 1
+        return 0
+
+    driver, _ = make_driver(
+        SequenceDiscovery({"host-1": 2}, {"host-1": 2, "host-2": 2}),
+        min_np=2, max_np=4, worker_fn=worker)
+    driver.host_manager.update_available_hosts()  # consume first set
+    driver.start(2)
+    assert driver.wait(10)
+    assert driver.error is None
+    assert max(sizes) == 4
