@@ -1,0 +1,267 @@
+#include "autotune.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+
+#include "common.h"  // NowSec, EnvInt
+
+namespace hvt {
+
+// ------------------------------------------------------------------ GP
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+bool GaussianProcess::Fit(const std::vector<std::vector<double>>& X,
+                          const std::vector<double>& y) {
+  const int n = static_cast<int>(X.size());
+  if (n == 0 || y.size() != X.size()) return false;
+  X_ = X;
+  y_mean_ = 0;
+  for (double v : y) y_mean_ += v;
+  y_mean_ /= n;
+  double var = 0;
+  for (double v : y) var += (v - y_mean_) * (v - y_mean_);
+  y_std_ = n > 1 ? std::sqrt(var / (n - 1)) : 1.0;
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+
+  // K + noise I, then Cholesky (n is small: <= max_samples)
+  std::vector<std::vector<double>> K(n, std::vector<double>(n));
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < n; ++j)
+      K[i][j] = Kernel(X[i], X[j]) + (i == j ? noise_ : 0.0);
+
+  L_.assign(n, std::vector<double>(n, 0.0));
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j <= i; ++j) {
+      double s = K[i][j];
+      for (int k = 0; k < j; ++k) s -= L_[i][k] * L_[j][k];
+      if (i == j) {
+        if (s <= 0) return false;
+        L_[i][j] = std::sqrt(s);
+      } else {
+        L_[i][j] = s / L_[j][j];
+      }
+    }
+  }
+
+  // alpha = L^-T (L^-1 z), z = standardized y
+  std::vector<double> z(n);
+  for (int i = 0; i < n; ++i) z[i] = (y[i] - y_mean_) / y_std_;
+  // forward solve L v = z
+  std::vector<double> v(n);
+  for (int i = 0; i < n; ++i) {
+    double s = z[i];
+    for (int k = 0; k < i; ++k) s -= L_[i][k] * v[k];
+    v[i] = s / L_[i][i];
+  }
+  // back solve L^T alpha = v
+  alpha_.assign(n, 0.0);
+  for (int i = n - 1; i >= 0; --i) {
+    double s = v[i];
+    for (int k = i + 1; k < n; ++k) s -= L_[k][i] * alpha_[k];
+    alpha_[i] = s / L_[i][i];
+  }
+  fitted_ = true;
+  return true;
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* var) const {
+  const int n = static_cast<int>(X_.size());
+  if (!fitted_ || n == 0) {
+    if (mean) *mean = y_mean_;
+    if (var) *var = 1.0;
+    return;
+  }
+  std::vector<double> ks(n);
+  for (int i = 0; i < n; ++i) ks[i] = Kernel(x, X_[i]);
+  double mu = 0;
+  for (int i = 0; i < n; ++i) mu += ks[i] * alpha_[i];
+  if (mean) *mean = y_mean_ + y_std_ * mu;
+  if (var) {
+    // v = L^-1 ks ; var = k(x,x) - vᵀv
+    std::vector<double> v(n);
+    for (int i = 0; i < n; ++i) {
+      double s = ks[i];
+      for (int k = 0; k < i; ++k) s -= L_[i][k] * v[k];
+      v[i] = s / L_[i][i];
+    }
+    double vv = 0;
+    for (int i = 0; i < n; ++i) vv += v[i] * v[i];
+    double raw = Kernel(x, x) - vv;
+    *var = std::max(raw, 1e-12) * y_std_ * y_std_;
+  }
+}
+
+// ------------------------------------------------------------------ BO
+
+double BayesianOptimizer::NextUniform() {
+  // xorshift64* — deterministic, no global state
+  rng_ ^= rng_ >> 12;
+  rng_ ^= rng_ << 25;
+  rng_ ^= rng_ >> 27;
+  return static_cast<double>((rng_ * 0x2545F4914F6CDD1DULL) >> 11) /
+         9007199254740992.0;
+}
+
+void BayesianOptimizer::AddSample(const std::vector<double>& x, double y) {
+  xs_.push_back(x);
+  ys_.push_back(y);
+  if (y > best_y_) {
+    best_y_ = y;
+    best_x_ = x;
+  }
+}
+
+static double NormCdf(double z) { return 0.5 * std::erfc(-z / M_SQRT2); }
+static double NormPdf(double z) {
+  return std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+}
+
+double BayesianOptimizer::ExpectedImprovement(
+    const GaussianProcess& gp, const std::vector<double>& x) const {
+  double mu, var;
+  gp.Predict(x, &mu, &var);
+  double sigma = std::sqrt(var);
+  if (sigma < 1e-12) return 0.0;
+  const double xi = 0.01 * std::abs(best_y_);  // exploration margin
+  double z = (mu - best_y_ - xi) / sigma;
+  return (mu - best_y_ - xi) * NormCdf(z) + sigma * NormPdf(z);
+}
+
+std::vector<double> BayesianOptimizer::Suggest(int candidates, int min_fit) {
+  if (num_samples() < min_fit) {
+    // space-filling start: jittered grid diagonal per dimension
+    std::vector<double> x(dims_);
+    for (int d = 0; d < dims_; ++d) {
+      double base = (num_samples() + 0.5) / min_fit;
+      x[d] = std::min(1.0, std::max(0.0,
+          (d % 2 == 0 ? base : 1.0 - base) +
+              0.1 * (NextUniform() - 0.5)));
+    }
+    return x;
+  }
+  GaussianProcess gp;
+  if (!gp.Fit(xs_, ys_)) {
+    std::vector<double> x(dims_);
+    for (int d = 0; d < dims_; ++d) x[d] = NextUniform();
+    return x;
+  }
+  std::vector<double> best(dims_, 0.5);
+  double best_ei = -1;
+  for (int c = 0; c < candidates; ++c) {
+    std::vector<double> x(dims_);
+    for (int d = 0; d < dims_; ++d) x[d] = NextUniform();
+    double ei = ExpectedImprovement(gp, x);
+    if (ei > best_ei) {
+      best_ei = ei;
+      best = x;
+    }
+  }
+  return best;
+}
+
+// ---------------------------------------------------- ParameterManager
+
+// tunable box: x0 = log2(fusion_threshold) in [20, 28] (1 MB..256 MB),
+// x1 = cycle_ms in [1, 25]
+static const double kLog2FusionMin = 20.0, kLog2FusionMax = 28.0;
+static const double kCycleMin = 1.0, kCycleMax = 25.0;
+
+ParameterManager::ParameterManager() = default;
+
+void ParameterManager::Initialize(int64_t fusion_threshold, int cycle_ms) {
+  // full reset: Initialize is re-entered on elastic shutdown/re-init and
+  // must not inherit a finished or half-run tuning session
+  done_ = false;
+  samples_ = 0;
+  cycle_count_ = 0;
+  bytes_acc_ = 0;
+  bo_ = BayesianOptimizer(2);
+  fusion_threshold_ = fusion_threshold;
+  cycle_ms_ = cycle_ms;
+  active_ = EnvInt("HVT_AUTOTUNE", 0) != 0;
+  warmup_remaining_ =
+      static_cast<int>(EnvInt("HVT_AUTOTUNE_WARMUP_SAMPLES", 3));
+  cycles_per_sample_ =
+      static_cast<int>(EnvInt("HVT_AUTOTUNE_CYCLES_PER_SAMPLE", 50));
+  max_samples_ = static_cast<int>(EnvInt("HVT_AUTOTUNE_MAX_SAMPLES", 20));
+  const char* log = getenv("HVT_AUTOTUNE_LOG");
+  log_path_ = log ? log : "";
+  window_start_ = NowSec();
+}
+
+std::vector<double> ParameterManager::CurrentPoint() const {
+  double x0 = (std::log2(static_cast<double>(fusion_threshold_)) -
+               kLog2FusionMin) / (kLog2FusionMax - kLog2FusionMin);
+  double x1 = (cycle_ms_ - kCycleMin) / (kCycleMax - kCycleMin);
+  return {std::min(1.0, std::max(0.0, x0)),
+          std::min(1.0, std::max(0.0, x1))};
+}
+
+void ParameterManager::ApplyPoint(const std::vector<double>& x) {
+  double l2 = kLog2FusionMin + x[0] * (kLog2FusionMax - kLog2FusionMin);
+  fusion_threshold_ = static_cast<int64_t>(std::pow(2.0, l2));
+  cycle_ms_ = static_cast<int>(
+      std::lround(kCycleMin + x[1] * (kCycleMax - kCycleMin)));
+  if (cycle_ms_ < 1) cycle_ms_ = 1;
+}
+
+void ParameterManager::Log(double score) {
+  if (log_path_.empty()) return;
+  FILE* f = fopen(log_path_.c_str(), "a");
+  if (!f) return;
+  fprintf(f, "%d,%lld,%d,%.1f\n", samples_.load(),
+          static_cast<long long>(fusion_threshold_), cycle_ms_, score);
+  fclose(f);
+}
+
+bool ParameterManager::Record(int64_t bytes) {
+  if (!active_ || done_) return false;
+  if (bytes <= 0 && cycle_count_ == 0) {
+    // idle engine (no tensor traffic yet): don't open a sample window —
+    // otherwise the whole tuning budget elapses on startup noise and the
+    // tuner freezes on an arbitrary point. The reference ties samples to
+    // actual traffic the same way.
+    window_start_ = NowSec();
+    return false;
+  }
+  bytes_acc_ += bytes;
+  if (++cycle_count_ < cycles_per_sample_) return false;
+  double now = NowSec();
+  double dur = now - window_start_;
+  double score = dur > 0 ? static_cast<double>(bytes_acc_) / dur : 0.0;
+  bool empty_window = bytes_acc_ == 0;
+  cycle_count_ = 0;
+  bytes_acc_ = 0;
+  window_start_ = now;
+  if (empty_window) return false;  // traffic stopped mid-window: discard
+
+  if (warmup_remaining_ > 0) {
+    // discard: engine still filling caches / JIT warm-up on the client
+    --warmup_remaining_;
+    return false;
+  }
+  ++samples_;
+  bo_.AddSample(CurrentPoint(), score);
+  Log(score);
+  if (samples_ >= max_samples_) {
+    // freeze at the best observed point
+    ApplyPoint(bo_.best_x());
+    done_ = true;
+    return true;
+  }
+  ApplyPoint(bo_.Suggest());
+  return true;
+}
+
+}  // namespace hvt

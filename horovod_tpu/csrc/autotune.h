@@ -1,0 +1,126 @@
+// Autotuning of engine knobs — counterpart of the reference's
+// ParameterManager (horovod/common/parameter_manager.h:42-120) +
+// BayesianOptimization / GaussianProcessRegressor
+// (horovod/common/optim/bayesian_optimization.cc, gaussian_process.cc).
+//
+// Rank 0 tunes {fusion threshold, cycle time} by Bayesian optimization
+// (RBF-kernel Gaussian process + expected-improvement acquisition) over the
+// observed data-plane throughput (bytes/sec), discarding warmup samples.
+// The tuned fusion threshold applies coordinator-side only; the tuned cycle
+// time is broadcast to workers piggybacked on the per-cycle response frame
+// (the analog of Controller::SynchronizeParameters, controller.cc:39-53).
+//
+// The reference maximizes EI with LBFGS over a vendored library; we use
+// deterministic random-candidate search, which for a 2-D box is equally
+// effective and dependency-free.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvt {
+
+// Small dense Gaussian process regressor, RBF kernel + observation noise.
+// Inputs must be pre-scaled to ~[0,1]^d; y is standardized internally.
+class GaussianProcess {
+ public:
+  explicit GaussianProcess(double length_scale = 0.25,
+                           double noise = 1e-4)
+      : length_scale_(length_scale), noise_(noise) {}
+
+  // X: n rows of d columns (row-major). Returns false on a singular fit.
+  bool Fit(const std::vector<std::vector<double>>& X,
+           const std::vector<double>& y);
+  // Predict mean and variance (of the standardized process scaled back).
+  void Predict(const std::vector<double>& x, double* mean,
+               double* var) const;
+  bool fitted() const { return fitted_; }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  double length_scale_, noise_;
+  bool fitted_ = false;
+  std::vector<std::vector<double>> X_;
+  std::vector<double> alpha_;            // K^-1 (y - mean)
+  std::vector<std::vector<double>> L_;   // Cholesky factor of K
+  double y_mean_ = 0.0, y_std_ = 1.0;
+};
+
+// Expected-improvement Bayesian optimizer over the unit box [0,1]^d.
+class BayesianOptimizer {
+ public:
+  explicit BayesianOptimizer(int dims, uint64_t seed = 0x5deece66dULL)
+      : dims_(dims), rng_(seed) {}
+
+  void AddSample(const std::vector<double>& x, double y);
+  // Next point to evaluate: quasi-random while under `min_fit` samples,
+  // then argmax of EI over `candidates` random points.
+  std::vector<double> Suggest(int candidates = 512, int min_fit = 3);
+  const std::vector<double>& best_x() const { return best_x_; }
+  double best_y() const { return best_y_; }
+  int num_samples() const { return static_cast<int>(ys_.size()); }
+
+ private:
+  double NextUniform();
+  double ExpectedImprovement(const GaussianProcess& gp,
+                             const std::vector<double>& x) const;
+
+  int dims_;
+  uint64_t rng_;
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;
+  std::vector<double> best_x_;
+  double best_y_ = -1e300;
+};
+
+// Tunes fusion_threshold (log2-scaled, 1 MB..256 MB) and cycle_ms (1..25).
+class ParameterManager {
+ public:
+  ParameterManager();
+
+  // Read env knobs (HVT_AUTOTUNE, HVT_AUTOTUNE_LOG,
+  // HVT_AUTOTUNE_WARMUP_SAMPLES, HVT_AUTOTUNE_CYCLES_PER_SAMPLE,
+  // HVT_AUTOTUNE_MAX_SAMPLES — reference common.h:68-73) and seed the
+  // current point from the configured defaults.
+  void Initialize(int64_t fusion_threshold, int cycle_ms);
+
+  bool active() const { return active_; }
+
+  // Record one engine cycle's executed payload bytes. Returns true when
+  // the tuned parameters changed (caller re-reads the getters).
+  bool Record(int64_t bytes);
+
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+  int cycle_ms() const { return cycle_ms_; }
+  int samples() const { return samples_; }
+  double best_score() const { return bo_.best_y(); }
+
+ private:
+  void ApplyPoint(const std::vector<double>& x);
+  std::vector<double> CurrentPoint() const;
+  void Log(double score);
+
+  // atomics: read by the introspection API from client threads while the
+  // engine thread tunes
+  std::atomic<bool> active_{false};
+  bool done_ = false;
+  int warmup_remaining_ = 3;
+  int cycles_per_sample_ = 50;
+  int max_samples_ = 20;
+  std::string log_path_;
+
+  BayesianOptimizer bo_{2};
+  int64_t fusion_threshold_ = 64 << 20;
+  int cycle_ms_ = 2;
+
+  int cycle_count_ = 0;
+  int64_t bytes_acc_ = 0;
+  double window_start_ = 0.0;
+  std::atomic<int> samples_{0};
+};
+
+}  // namespace hvt

@@ -103,4 +103,48 @@ int hvt_error_message(char* dst, int max_n) {
   return n;
 }
 
+// ---- autotune internals, exported for unit tests (the reference tests
+// ---- GaussianProcessRegressor / BayesianOptimization the same way)
+
+// Fit a GP on n d-dim points (row-major X) and predict nq query points.
+int hvt_gp_fit_predict(const double* X, const double* y, int n, int d,
+                       const double* Xq, int nq, double* mean_out,
+                       double* var_out) {
+  std::vector<std::vector<double>> xs(n, std::vector<double>(d));
+  for (int i = 0; i < n; ++i)
+    for (int j = 0; j < d; ++j) xs[i][j] = X[i * d + j];
+  std::vector<double> ys(y, y + n);
+  hvt::GaussianProcess gp;
+  if (!gp.Fit(xs, ys)) return -1;
+  for (int q = 0; q < nq; ++q) {
+    std::vector<double> xq(Xq + q * d, Xq + (q + 1) * d);
+    gp.Predict(xq, &mean_out[q], &var_out[q]);
+  }
+  return 0;
+}
+
+// Given observed samples, return the optimizer's next suggestion in
+// [0,1]^d. Deterministic for a fixed sample set.
+int hvt_bo_suggest(const double* X, const double* y, int n, int d,
+                   double* out) {
+  hvt::BayesianOptimizer bo(d);
+  for (int i = 0; i < n; ++i) {
+    std::vector<double> x(X + i * d, X + (i + 1) * d);
+    bo.AddSample(x, y[i]);
+  }
+  auto s = bo.Suggest();
+  for (int j = 0; j < d; ++j) out[j] = s[j];
+  return 0;
+}
+
+// Current engine tuning state: [fusion_threshold, cycle_ms, samples,
+// active]. For integration tests and introspection.
+void hvt_autotune_state(long long* out4) {
+  auto& e = Engine::Get();
+  out4[0] = e.fusion_threshold();
+  out4[1] = e.current_cycle_ms();
+  out4[2] = e.autotune().samples();
+  out4[3] = e.autotune().active() ? 1 : 0;
+}
+
 }  // extern "C"

@@ -9,7 +9,9 @@
 // horovod_tpu/ops/collective_ops.py docstring).
 #pragma once
 
+#include <chrono>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <functional>
 #include <memory>
@@ -17,6 +19,17 @@
 #include <vector>
 
 namespace hvt {
+
+inline double NowSec() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+inline int64_t EnvInt(const char* name, int64_t dflt) {
+  const char* v = getenv(name);
+  return v ? atoll(v) : dflt;
+}
 
 enum class StatusType : uint8_t {
   OK = 0,

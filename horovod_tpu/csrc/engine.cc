@@ -8,17 +8,6 @@
 
 namespace hvt {
 
-static double NowSec() {
-  return std::chrono::duration<double>(
-             std::chrono::steady_clock::now().time_since_epoch())
-      .count();
-}
-
-static int64_t EnvInt(const char* name, int64_t dflt) {
-  const char* v = getenv(name);
-  return v ? atoll(v) : dflt;
-}
-
 Engine& Engine::Get() {
   static Engine* engine = new Engine();
   return *engine;
@@ -39,6 +28,7 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
       static_cast<double>(EnvInt("HVT_STALL_WARN_SEC", 60));
   cache_ = ResponseCache(
       static_cast<size_t>(EnvInt("HVT_CACHE_CAPACITY", 1024)));
+  autotune_.Initialize(fusion_threshold_, cycle_ms_);
   try {
     if (size_ > 1) {
       data_listener_.Listen(0);
@@ -314,6 +304,9 @@ bool Engine::RunCycle() {
     // evictions gathered by Coordinate into pending_evictions_
     Writer out;
     out.u8(resp_flags);
+    // broadcast the (possibly autotuned) cycle time — the analog of
+    // Controller::SynchronizeParameters (controller.cc:39-53)
+    out.i32(static_cast<int32_t>(cycle_ms_));
     out.i64vec(pending_evictions_);
     EncodeResponseList(out, responses);
     for (int r = 1; r < size_; ++r) workers_[r].SendFrame(out.buf);
@@ -324,6 +317,8 @@ bool Engine::RunCycle() {
     auto frame = control_.RecvFrame();
     Reader rd(frame);
     resp_flags = rd.u8();
+    int tuned_cycle = rd.i32();
+    if (tuned_cycle > 0) cycle_ms_ = tuned_cycle;
     evictions = rd.i64vec();
     responses = DecodeResponseList(rd);
   }
@@ -338,6 +333,16 @@ bool Engine::RunCycle() {
 
   // 5. execute
   for (auto& resp : responses) ExecuteResponse(resp, pending_);
+
+  // feed the autotuner with this cycle's throughput (rank 0 tunes;
+  // reference operations.cc:610-642 feeds the ParameterManager the same
+  // way); tuned values apply next cycle
+  if (rank_ == 0 && autotune_.active() &&
+      autotune_.Record(cycle_bytes_)) {
+    fusion_threshold_ = autotune_.fusion_threshold();
+    cycle_ms_ = autotune_.cycle_ms();
+  }
+  cycle_bytes_ = 0;
 
   if (rank_ == 0) CheckStalls();
 
@@ -666,6 +671,8 @@ void Engine::ExecuteResponse(const Response& resp,
   }
 
   const size_t el = DataTypeSize(resp.dtype);
+  for (int64_t n : resp.numels)
+    cycle_bytes_ += n * static_cast<int64_t>(el);
   switch (resp.op) {
     case OpType::ALLREDUCE: {
       if (resp.reduce == ReduceKind::ADASUM) {

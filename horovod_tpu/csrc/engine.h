@@ -33,6 +33,7 @@
 #include <unordered_map>
 #include <vector>
 
+#include "autotune.h"
 #include "cache.h"
 #include "common.h"
 #include "net.h"
@@ -59,6 +60,9 @@ class Engine {
   bool initialized() const { return initialized_.load(); }
   int rank() const { return rank_; }
   int size() const { return size_; }
+  const ParameterManager& autotune() const { return autotune_; }
+  int64_t fusion_threshold() const { return fusion_threshold_; }
+  int current_cycle_ms() const { return cycle_ms_; }
 
   // Returns handle (>=0) or -1 when not initialized.
   int32_t Submit(EntryPtr entry);
@@ -96,7 +100,10 @@ class Engine {
   std::unique_ptr<DataPlane> data_;
   Listener data_listener_;
 
-  int rank_ = 0, size_ = 1, cycle_ms_ = 2;
+  int rank_ = 0, size_ = 1;
+  // atomic: mutated by the engine thread, read by the introspection API
+  // (hvt_autotune_state) from client threads
+  std::atomic<int> cycle_ms_{2};
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> fatal_{false};
@@ -124,9 +131,11 @@ class Engine {
   std::vector<std::set<int64_t>> hit_pending_;  // per rank, cache positions
   std::vector<int64_t> pending_evictions_;
   int last_join_rank_ = -1;
-  int64_t fusion_threshold_ = 64 << 20;
+  std::atomic<int64_t> fusion_threshold_{64 << 20};  // see cycle_ms_ note
   double stall_warn_sec_ = 60.0;
   std::map<std::string, bool> stall_warned_;
+  ParameterManager autotune_;     // rank 0 tunes; workers receive cycle_ms
+  int64_t cycle_bytes_ = 0;       // payload bytes executed this cycle
 
   std::vector<uint8_t> fusion_buffer_;
 };

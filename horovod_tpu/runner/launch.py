@@ -52,6 +52,12 @@ def parse_args(argv=None):
                         "(reference HOROVOD_TIMELINE)")
     p.add_argument("--stall-warning-sec", type=int, default=60,
                    help="stall inspector warning threshold")
+    p.add_argument("--autotune", action="store_true",
+                   help="enable Bayesian autotuning of fusion threshold "
+                        "and cycle time (reference --autotune)")
+    p.add_argument("--autotune-log-file", default=None,
+                   help="CSV log of autotune samples "
+                        "(reference --autotune-log-file)")
     p.add_argument("--backend", choices=["engine", "jax"], default="engine",
                    help="engine: C++ TCP collectives (CPU/eager); jax: "
                         "jax.distributed bring-up (one process per TPU "
@@ -122,6 +128,10 @@ def slot_env(base_env, slot, args, master_addr):
         env["HVT_COORDINATOR_ADDR"] = f"{master_addr}:{args.master_port}"
     if args.timeline:
         env["HVT_TIMELINE"] = args.timeline
+    if getattr(args, "autotune", False):
+        env["HVT_AUTOTUNE"] = "1"
+        if args.autotune_log_file:
+            env["HVT_AUTOTUNE_LOG"] = args.autotune_log_file
     return env
 
 
