@@ -72,6 +72,13 @@ def init(comm=None, process_sets=None):
             return
         jax = _jax()
 
+        if os.environ.get("HVT_FROM_MPI"):
+            # mpirun/jsrun placed us: derive slot identity from the MPI
+            # launcher's env (OMPI_COMM_WORLD_RANK etc.)
+            from horovod_tpu.runner.mpi_run import env_from_mpi
+
+            os.environ.update(env_from_mpi())
+
         coordinator = os.environ.get("HVT_COORDINATOR_ADDR")
         nprocs = os.environ.get("HVT_NUM_PROCESSES")
         procid = os.environ.get("HVT_PROCESS_ID")

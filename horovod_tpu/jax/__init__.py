@@ -224,6 +224,22 @@ def DistributedGradientTransformation(
 DistributedOptimizer = DistributedGradientTransformation
 
 
+from horovod_tpu.jax.callbacks import (  # noqa: E402,F401
+    BroadcastGlobalVariablesCallback, Callback, CallbackList,
+    LearningRateScheduleCallback, LearningRateWarmupCallback,
+    MetricAverageCallback, exponential_schedule, warmup_schedule)
+
+
+def __getattr__(name):
+    # lazy: sync_batch_norm imports flax, which must stay an optional
+    # dependency of `import horovod_tpu`
+    if name == "SyncBatchNorm":
+        from horovod_tpu.jax.sync_batch_norm import SyncBatchNorm
+
+        return SyncBatchNorm
+    raise AttributeError(name)
+
+
 def PartialDistributedGradientTransformation(
         optimizer: optax.GradientTransformation,
         local_layers=(),

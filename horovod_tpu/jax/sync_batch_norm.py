@@ -1,0 +1,59 @@
+"""Cross-replica batch normalization — the JAX/flax counterpart of the
+reference's ``SyncBatchNormalization`` (``tensorflow/sync_batch_norm.py:22``
+and ``torch/sync_batch_norm.py``): batch statistics (mean/var) are
+averaged across all workers of the axis before normalizing, so small
+per-chip batches behave like one large global batch.
+
+TPU-natively this is flax's BatchNorm with ``axis_name`` set — XLA lowers
+the moment reduction to an ICI ``psum`` fused into the surrounding
+program (no out-of-graph engine involvement). This wrapper pins the
+default to the framework's world axis and degrades to local statistics
+when no mesh axis is bound (size-1 and plain-jit cases), matching the
+reference's size==1 behavior."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+
+from horovod_tpu.parallel.mesh import WORLD_AXIS
+
+
+def _axis_bound(name: Optional[str]) -> bool:
+    if name is None:
+        return False
+    try:
+        jax.lax.axis_size(name)
+        return True
+    except Exception:
+        return False
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm whose statistics are synchronized over the mesh
+    axis (default: the global world axis) when one is bound.
+
+    Under pure pjit data parallelism (global arrays), plain BatchNorm over
+    the global batch is already globally correct; this module matters for
+    explicit shard_map/pmap loops where the local batch is a shard.
+    """
+
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.99
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+    use_bias: bool = True
+    use_scale: bool = True
+    axis_name: Optional[str] = WORLD_AXIS
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        axis = self.axis_name if _axis_bound(self.axis_name) else None
+        bn = nn.BatchNorm(
+            use_running_average=self.use_running_average,
+            momentum=self.momentum, epsilon=self.epsilon,
+            dtype=self.dtype, use_bias=self.use_bias,
+            use_scale=self.use_scale, axis_name=axis, name="bn")
+        return bn(x, use_running_average=use_running_average)

@@ -1,0 +1,85 @@
+"""Keras compatibility layer.
+
+The reference wraps Keras optimizers and ships standard callbacks
+(``horovod/keras/__init__.py``, ``horovod/_keras/callbacks.py``). The
+TPU-native equivalents live in ``horovod_tpu.jax``:
+
+- ``hvt.jax.DistributedOptimizer`` — optimizer wrapping (optax)
+- ``hvt.jax.callbacks`` — BroadcastGlobalVariables / MetricAverage /
+  LearningRateWarmup / LearningRateSchedule for custom loops
+- ``horovod_tpu.elastic`` — CommitStateCallback-style elastic hooks via
+  ``State.commit()``
+
+When a TF+Keras install is present, the callback classes below adapt the
+JAX-native callback set to the ``keras.callbacks.Callback`` protocol so
+``model.fit`` works unchanged."""
+
+from __future__ import annotations
+
+try:
+    import tensorflow.keras as _keras
+    _KERAS_AVAILABLE = True
+except ImportError:  # pragma: no cover - environment without TF
+    _keras = None
+    _KERAS_AVAILABLE = False
+
+from horovod_tpu.common.basics import (init, local_rank, rank,  # noqa: F401
+                                       shutdown, size)
+
+
+def _require_keras():
+    if not _KERAS_AVAILABLE:
+        raise ImportError(
+            "tf.keras is not installed. Use horovod_tpu.jax for "
+            "TPU-compiled training (hvt.jax.DistributedOptimizer + "
+            "hvt.jax.callbacks cover the Keras callback set).")
+
+
+def _make_callback(jax_cb):
+    """Adapt an hvt.jax Callback to keras.callbacks.Callback."""
+    _require_keras()
+
+    class _Adapter(_keras.callbacks.Callback):
+        def on_train_begin(self, logs=None):
+            weights = self.model.get_weights()
+            self.model.set_weights(jax_cb.on_train_begin(weights))
+
+        def on_epoch_begin(self, epoch, logs=None):
+            jax_cb.on_epoch_begin(epoch)
+
+        def on_epoch_end(self, epoch, logs=None):
+            out = jax_cb.on_epoch_end(epoch, logs)
+            if out and logs is not None:
+                logs.update(out)
+
+    return _Adapter()
+
+
+def BroadcastGlobalVariablesCallback(root_rank=0):
+    from horovod_tpu.jax.callbacks import \
+        BroadcastGlobalVariablesCallback as _B
+
+    return _make_callback(_B(root_rank))
+
+
+def MetricAverageCallback():
+    from horovod_tpu.jax.callbacks import MetricAverageCallback as _M
+
+    return _make_callback(_M())
+
+
+def DistributedOptimizer(*args, **kwargs):
+    _require_keras()
+    raise NotImplementedError(
+        "Keras-graph DistributedOptimizer is not provided; use "
+        "horovod_tpu.jax.DistributedOptimizer for TPU training")
+
+
+def broadcast_global_variables(root_rank=0):
+    """Broadcast all Keras backend variables (reference
+    ``keras/__init__.py:92``)."""
+    _require_keras()
+    from horovod_tpu import tensorflow as hvt_tf
+
+    hvt_tf.broadcast_variables(
+        _keras.backend._get_variables(None), root_rank)

@@ -1,0 +1,21 @@
+"""MXNet compatibility stub.
+
+The reference binds MXNet (``horovod/mxnet``: DistributedOptimizer,
+Gluon DistributedTrainer, broadcast_parameters). MXNet is end-of-life
+(retired from Apache incubation) and is not part of the TPU-native
+target; training paths are ``horovod_tpu.jax`` (compiled) and
+``horovod_tpu.torch`` (eager/hooks). This module exists so
+``import horovod_tpu.mxnet`` fails with guidance rather than
+AttributeError deep in user code."""
+
+from __future__ import annotations
+
+_MSG = ("horovod_tpu does not bind MXNet; use horovod_tpu.jax "
+        "(TPU-compiled) or horovod_tpu.torch (eager). The reference's "
+        "MXNet API maps 1:1: DistributedOptimizer → "
+        "hvt.jax.DistributedOptimizer / hvt.torch.DistributedOptimizer, "
+        "broadcast_parameters → hvt.torch.broadcast_parameters.")
+
+
+def __getattr__(name):
+    raise NotImplementedError(_MSG)

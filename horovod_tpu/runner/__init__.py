@@ -1,0 +1,12 @@
+"""Launcher package. ``run()`` is the programmatic API (reference
+``horovod/runner/__init__.py:91``); the CLI lives in ``launch.py``."""
+
+
+def __getattr__(name):
+    # lazy: keeps cloudpickle (used only by run()) out of the import
+    # path of the CLI and of MPI-placed workers
+    if name == "run":
+        from horovod_tpu.runner.api import run
+
+        return run
+    raise AttributeError(name)

@@ -1,0 +1,18 @@
+"""base64 ⇄ pickled-object codec for passing callables/config through
+environment variables and command lines (reference
+``horovod/runner/common/util/codec.py``). Uses cloudpickle so closures
+and lambdas survive the trip."""
+
+from __future__ import annotations
+
+import base64
+
+import cloudpickle
+
+
+def dumps_base64(obj) -> str:
+    return base64.b64encode(cloudpickle.dumps(obj)).decode("ascii")
+
+
+def loads_base64(encoded: str):
+    return cloudpickle.loads(base64.b64decode(encoded.encode("ascii")))

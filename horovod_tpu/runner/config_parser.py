@@ -1,0 +1,57 @@
+"""YAML config-file support for hvtrun (reference
+``horovod/common/util/config_parser.py`` + ``launch.py:293``
+--config-file): every CLI knob can come from a YAML file; explicit CLI
+flags win over file values."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+# YAML key → argparse dest, mirroring the reference's key set where a
+# TPU-native equivalent exists
+_KEYS = {
+    "verbose": "verbose",
+    "master-port": "master_port",
+    "ssh-port": "ssh_port",
+    "cycle-time-ms": "cycle_time_ms",
+    "fusion-threshold-mb": "fusion_threshold_mb",
+    "timeline": "timeline",
+    "stall-warning-sec": "stall_warning_sec",
+    "autotune": "autotune",
+    "autotune-log-file": "autotune_log_file",
+    "min-np": "min_np",
+    "max-np": "max_np",
+    "host-discovery-script": "host_discovery_script",
+    "reset-limit": "reset_limit",
+    "elastic-timeout": "elastic_timeout",
+    "slots": "slots",
+    "backend": "backend",
+}
+
+
+def load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ValueError(f"config file {path} must be a YAML mapping")
+    unknown = [k for k in data if k not in _KEYS]
+    if unknown:
+        raise ValueError(
+            f"unknown config keys {unknown}; valid: {sorted(_KEYS)}")
+    return {_KEYS[k]: v for k, v in data.items()}
+
+
+def apply_config(args: argparse.Namespace, path: Optional[str],
+                 parser: argparse.ArgumentParser) -> argparse.Namespace:
+    """Fill args from the YAML file, but only where the CLI left the
+    parser default (explicit flags always win — reference override-action
+    semantics, launch.py:158)."""
+    if not path:
+        return args
+    for dest, value in load_config(path).items():
+        if getattr(args, dest, None) == parser.get_default(dest):
+            setattr(args, dest, value)
+    return args

@@ -1,0 +1,31 @@
+"""Host identity hashing (reference
+``horovod/runner/common/util/host_hash.py``): two launcher entries that
+resolve to the same machine (e.g. ``localhost`` and the FQDN) must land in
+the same local-rank group, so hosts are deduplicated by a hash of the
+machine identity rather than by spelling."""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+
+
+def host_hash(salt: str = "") -> str:
+    """Hash identifying *this* machine. Mirrors the reference: hostname
+    (minus any trailing domain) + salt, md5-hexed. The salt lets tests and
+    containerized slots force distinct identities on one machine."""
+    hostname = socket.gethostname()
+    host = hostname.split(".")[0]
+    return hashlib.md5(f"{host}-{salt}".encode()).hexdigest()
+
+
+def hosts_equivalent(a: str, b: str) -> bool:
+    """True when two host strings resolve to the same address set."""
+    if a == b:
+        return True
+    try:
+        ia = {r[4][0] for r in socket.getaddrinfo(a, None)}
+        ib = {r[4][0] for r in socket.getaddrinfo(b, None)}
+    except socket.gaierror:
+        return False
+    return bool(ia & ib)

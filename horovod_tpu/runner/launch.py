@@ -78,6 +78,14 @@ def parse_args(argv=None):
                          help="seconds to wait for min-np slots")
     elastic.add_argument("--slots", type=int, default=1,
                          help="default slots per discovered host")
+    p.add_argument("--use-mpi", action="store_true",
+                   help="launch through a single mpirun command "
+                        "(reference run_controller mpi path)")
+    p.add_argument("--use-jsrun", action="store_true",
+                   help="launch through IBM LSF jsrun")
+    p.add_argument("--config-file", default=None,
+                   help="YAML file supplying any of these flags; "
+                        "explicit CLI flags win (reference --config-file)")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
@@ -86,6 +94,10 @@ def parse_args(argv=None):
         p.error("no training command given")
     if args.command[0] == "--":
         args.command = args.command[1:]
+    if args.config_file:
+        from horovod_tpu.runner.config_parser import apply_config
+
+        args = apply_config(args, args.config_file, p)
     return args
 
 
@@ -254,6 +266,14 @@ def main(argv=None) -> int:
     slots = get_host_assignments(hosts, args.num_proc)
     master_addr = ("127.0.0.1" if _is_local(slots[0].hostname)
                    else slots[0].hostname)
+    if args.use_mpi:
+        from horovod_tpu.runner.mpi_run import mpi_run
+
+        return mpi_run(args, slots, master_addr)
+    if args.use_jsrun:
+        from horovod_tpu.runner.js_run import js_run
+
+        return js_run(args, slots, master_addr)
     if args.verbose:
         for s in slots:
             print(f"[hvtrun] rank {s.rank} → {s.hostname} "

@@ -1,0 +1,60 @@
+"""Network interface enumeration and reachability probing (reference
+``horovod/runner/common/util/network.py`` + the NIC ring check of
+``runner/task_fn.py:23``)."""
+
+from __future__ import annotations
+
+import socket
+from typing import Dict, List, Optional
+
+
+def get_local_interfaces(ipv4_only: bool = True) -> Dict[str, List[str]]:
+    """Map interface name → addresses on this machine."""
+    import psutil
+
+    out: Dict[str, List[str]] = {}
+    for name, addrs in psutil.net_if_addrs().items():
+        ips = [a.address for a in addrs
+               if a.family == socket.AF_INET
+               or (not ipv4_only and a.family == socket.AF_INET6)]
+        if ips:
+            out[name] = ips
+    return out
+
+
+def can_connect(host: str, port: int, timeout: float = 2.0) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def probe_reachable(addresses: List[str], port: int,
+                    timeout: float = 2.0) -> List[str]:
+    """Which of ``addresses`` accept a TCP connection on ``port`` — the
+    ring-probe primitive: each task probes the *next* host's candidate
+    addresses to weed out NAT'ed/one-way NICs."""
+    return [a for a in addresses if can_connect(a, port, timeout)]
+
+
+def local_addresses() -> List[str]:
+    return sorted({ip for ips in get_local_interfaces().values()
+                   for ip in ips})
+
+
+def filter_common_interfaces(per_host_reachable: Dict[str, List[str]]
+                             ) -> List[str]:
+    """Intersect reachable-NIC names/addresses across hosts (reference
+    driver_service.py:218 get_common_interfaces)."""
+    sets = [set(v) for v in per_host_reachable.values()]
+    if not sets:
+        return []
+    common = set.intersection(*sets)
+    return sorted(common)
+
+
+def get_free_port(bind: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((bind, 0))
+        return s.getsockname()[1]

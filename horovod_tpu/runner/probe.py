@@ -1,0 +1,170 @@
+"""Pre-launch driver/task probe (reference
+``horovod/runner/driver/driver_service.py:162`` ``_driver_fn`` +
+``runner/task_fn.py:23``): before the real job starts, a small task
+service runs on every host; each registers its host hash and NIC
+addresses with the driver, then probes the NEXT host's addresses in a
+ring so one-way/NAT'ed interfaces are weeded out; the driver intersects
+the per-link results into the common reachable address set used for the
+rendezvous.
+
+Messages are HMAC-signed with the per-job secret key (reference
+service messages do the same)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner import network, secret
+
+
+class _SignedHandler(BaseHTTPRequestHandler):
+    key: bytes = b""
+
+    def _read_signed(self) -> Optional[dict]:
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        digest = bytes.fromhex(self.headers.get("X-HVT-Digest", ""))
+        if not secret.check_digest(self.key, body, digest):
+            self.send_response(403)
+            self.end_headers()
+            return None
+        return json.loads(body)
+
+    def _send_json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def _signed_request(addr: str, path: str, obj: dict, key: bytes,
+                    timeout: float = 5.0) -> dict:
+    import urllib.request
+
+    body = json.dumps(obj).encode()
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=body, method="PUT",
+        headers={"X-HVT-Digest": secret.compute_digest(key, body).hex()})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        data = resp.read()
+        return json.loads(data) if data else {}
+
+
+class TaskService:
+    """Runs on each candidate host; answers probe requests."""
+
+    def __init__(self, index: int, key: bytes, salt: str = ""):
+        self._index = index
+        self._key = key
+        self._salt = salt
+        self._server = None
+        self.port = None
+
+    def start(self) -> int:
+        svc = self
+
+        class Handler(_SignedHandler):
+            key = svc._key
+
+            def do_PUT(self):
+                msg = self._read_signed()
+                if msg is None:
+                    return
+                if msg.get("cmd") == "info":
+                    from horovod_tpu.runner.host_hash import host_hash
+
+                    self._send_json({
+                        "index": svc._index,
+                        "host_hash": host_hash(svc._salt),
+                        "addresses": network.local_addresses(),
+                        "interfaces": network.get_local_interfaces(),
+                    })
+                elif msg.get("cmd") == "probe":
+                    ok = network.probe_reachable(
+                        msg["addresses"], int(msg["port"]),
+                        timeout=float(msg.get("timeout", 2.0)))
+                    self._send_json({"reachable": ok})
+                else:
+                    self._send_json({"error": "unknown cmd"}, 400)
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
+        self.port = self._server.server_address[1]
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self.port
+
+    def stop(self):
+        if self._server:
+            self._server.shutdown()
+            self._server = None
+
+
+class DriverProbe:
+    """Launcher-side: given the task services' addresses, collect host
+    info and run the ring probe."""
+
+    def __init__(self, key: bytes):
+        self._key = key
+
+    def collect_info(self, task_addrs: List[str]) -> List[dict]:
+        return [_signed_request(a, "/", {"cmd": "info"}, self._key)
+                for a in task_addrs]
+
+    def ring_probe(self, task_addrs: List[str],
+                   infos: List[dict]) -> Dict[str, List[str]]:
+        """Task i probes task (i+1)'s addresses on (i+1)'s service port.
+        Returns per-link reachable addresses keyed by the probed task
+        index."""
+        n = len(task_addrs)
+        out: Dict[str, List[str]] = {}
+        for i in range(n):
+            nxt = (i + 1) % n
+            port = int(task_addrs[nxt].rsplit(":", 1)[1])
+            resp = _signed_request(
+                task_addrs[i], "/",
+                {"cmd": "probe", "addresses": infos[nxt]["addresses"],
+                 "port": port}, self._key)
+            out[str(nxt)] = resp.get("reachable", [])
+        return out
+
+    def common_interfaces(self, task_addrs: List[str]) -> List[str]:
+        """Interface NAMES usable on every host: a NIC counts for a host
+        when at least one of its addresses was reachable from the
+        previous host in the ring. Hosts have different IPs, so the
+        intersection is over names, matching the reference's
+        get_common_interfaces (driver_service.py:218)."""
+        infos = self.collect_info(task_addrs)
+        links = self.ring_probe(task_addrs, infos)
+        per_host_nics = {}
+        for idx_str, reachable in links.items():
+            info = infos[int(idx_str)]
+            nics = {name for name, ips in info["interfaces"].items()
+                    if any(ip in reachable for ip in ips)}
+            per_host_nics[idx_str] = nics
+        sets = list(per_host_nics.values())
+        return sorted(set.intersection(*sets)) if sets else []
+
+    def reachable_addresses(self, task_addrs: List[str]
+                            ) -> Dict[str, List[str]]:
+        """Per-host reachable addresses (keyed by task index) — what the
+        rendezvous should advertise for each host."""
+        infos = self.collect_info(task_addrs)
+        return self.ring_probe(task_addrs, infos)
+
+
+def wait_for_service(addr: str, timeout: float = 30.0) -> bool:
+    host, port = addr.rsplit(":", 1)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if network.can_connect(host, int(port), timeout=1.0):
+            return True
+        time.sleep(0.2)
+    return False

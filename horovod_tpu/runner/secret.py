@@ -1,0 +1,23 @@
+"""HMAC signing of launcher control messages (reference
+``horovod/runner/common/util/secret.py``). The launcher generates one key
+per job; driver/task services reject unsigned or tampered payloads."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+
+DIGEST = hashlib.sha256
+
+
+def make_secret_key() -> bytes:
+    return os.urandom(32)
+
+
+def compute_digest(secret_key: bytes, payload: bytes) -> bytes:
+    return hmac.new(secret_key, payload, DIGEST).digest()
+
+
+def check_digest(secret_key: bytes, payload: bytes, digest: bytes) -> bool:
+    return hmac.compare_digest(compute_digest(secret_key, payload), digest)

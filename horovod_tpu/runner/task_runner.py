@@ -1,0 +1,37 @@
+"""Worker entry for the programmatic ``run()`` API (reference
+``horovod/runner/run_task.py``): loads the pickled function, initializes
+the runtime, runs it, writes the per-rank result."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import cloudpickle
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    fn_path, out_dir = argv[0], argv[1]
+    if os.environ.get("HVT_RUN_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from horovod_tpu.runner.codec import loads_base64
+
+    with open(fn_path) as f:
+        fn, args, kwargs = loads_base64(f.read())
+
+    import horovod_tpu as hvt
+
+    hvt.init()
+    result = fn(*args, **kwargs)
+    with open(os.path.join(out_dir, f"result_{hvt.rank()}.pkl"),
+              "wb") as f:
+        cloudpickle.dump(result, f)
+    hvt.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

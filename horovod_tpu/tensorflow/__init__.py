@@ -1,0 +1,93 @@
+"""TensorFlow compatibility binding.
+
+The reference ships a full TF binding (``horovod/tensorflow``:
+DistributedOptimizer, _DistributedGradientTape, custom ops). This
+framework is TPU-native: the first-class training path is JAX
+(``horovod_tpu.jax``), where XLA compiles the collectives into the step —
+strictly more capable than the out-of-graph TF custom-op design. A torch
+binding (``horovod_tpu.torch``) covers eager-style training.
+
+When TensorFlow is importable, this module exposes the eager-mode subset
+of the reference API (rank/size topology, allreduce/allgather/broadcast
+on ``tf.Tensor`` via zero-copy numpy bridging, and broadcast_variables);
+graph-mode custom ops are not provided — use the JAX binding for compiled
+training on TPU."""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as _tf
+    _TF_AVAILABLE = True
+except ImportError:  # pragma: no cover - environment without TF
+    _tf = None
+    _TF_AVAILABLE = False
+
+from horovod_tpu.common.basics import (cross_rank, cross_size,  # noqa: F401
+                                       init, is_initialized, local_rank,
+                                       local_size, rank, shutdown, size)
+
+
+def _require_tf():
+    if not _TF_AVAILABLE:
+        raise ImportError(
+            "TensorFlow is not installed in this environment. The "
+            "TPU-native training path is horovod_tpu.jax (compiled XLA "
+            "collectives); horovod_tpu.torch provides the eager path.")
+
+
+def allreduce(tensor, name=None, average=True, prescale_factor=1.0,
+              postscale_factor=1.0, process_set=None):
+    """Eager allreduce on a tf.Tensor through the engine data plane."""
+    _require_tf()
+    import numpy as np
+
+    from horovod_tpu.ops import collective_ops as C
+
+    arr = np.asarray(tensor)
+    out = C.allreduce(
+        arr, name=name or "tf.allreduce",
+        op=C.Average if average else C.Sum,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        process_set=process_set or C.global_process_set)
+    return _tf.convert_to_tensor(np.asarray(out))
+
+
+def allgather(tensor, name=None, process_set=None):
+    _require_tf()
+    import numpy as np
+
+    from horovod_tpu.ops import collective_ops as C
+
+    out = C.allgather(np.asarray(tensor), name=name or "tf.allgather",
+                      process_set=process_set or C.global_process_set)
+    return _tf.convert_to_tensor(np.asarray(out))
+
+
+def broadcast(tensor, root_rank=0, name=None, process_set=None):
+    _require_tf()
+    import numpy as np
+
+    from horovod_tpu.ops import collective_ops as C
+
+    out = C.broadcast(np.asarray(tensor), root_rank=root_rank,
+                      name=name or "tf.broadcast",
+                      process_set=process_set or C.global_process_set)
+    return _tf.convert_to_tensor(np.asarray(out))
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign every tf.Variable the root rank's value (reference
+    ``tensorflow/functions.py`` broadcast_variables)."""
+    _require_tf()
+    for i, v in enumerate(variables):
+        v.assign(broadcast(v.value(), root_rank=root_rank,
+                           name=f"bcast_var_{i}"))
+
+
+def DistributedOptimizer(*args, **kwargs):
+    _require_tf()
+    raise NotImplementedError(
+        "graph-mode TF DistributedOptimizer is not provided; TPU-compiled "
+        "training uses horovod_tpu.jax.DistributedOptimizer (the XLA "
+        "collectives replace the TF custom-op engine path)")

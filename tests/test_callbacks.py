@@ -1,0 +1,188 @@
+"""Callback + SyncBatchNorm + compatibility-binding tests (reference
+``test/parallel/test_keras.py`` callback coverage and
+``tensorflow/sync_batch_norm.py`` semantics)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvt
+from horovod_tpu.jax.callbacks import (BroadcastGlobalVariablesCallback,
+                                       CallbackList,
+                                       LearningRateScheduleCallback,
+                                       LearningRateWarmupCallback,
+                                       MetricAverageCallback,
+                                       exponential_schedule,
+                                       warmup_schedule)
+
+
+# ------------------------------------------------------------ LR math
+
+def test_warmup_multiplier_reaches_target():
+    cb = LearningRateWarmupCallback(initial_lr=0.4, warmup_epochs=5,
+                                    steps_per_epoch=10, size=4)
+    cb.on_epoch_begin(0)
+    lr0 = cb.learning_rate(0)
+    assert lr0 == pytest.approx(0.4 / 4)            # starts at lr/size
+    # just before the warmup boundary the lr approaches the target
+    lr_end = cb.learning_rate(49)
+    assert lr_end == pytest.approx(0.4, rel=0.1)
+    # after warmup the callback holds the target lr
+    assert cb.learning_rate(50) == pytest.approx(0.4)
+    assert cb.learning_rate(500) == pytest.approx(0.4)
+
+
+def test_warmup_size1_is_identity():
+    cb = LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=5,
+                                    steps_per_epoch=10, size=1)
+    cb.on_epoch_begin(0)
+    assert cb.learning_rate(0) == pytest.approx(0.1)
+
+
+def test_schedule_staircase_and_range():
+    cb = LearningRateScheduleCallback(
+        initial_lr=1.0, multiplier=lambda e: 0.1 ** e,
+        start_epoch=1, end_epoch=3, staircase=True)
+    cb.on_epoch_begin(0)
+    assert cb.learning_rate(0) is None              # before start
+    cb.on_epoch_begin(1)
+    assert cb.learning_rate(10) == pytest.approx(0.1)
+    cb.on_epoch_begin(2)
+    assert cb.learning_rate(20) == pytest.approx(0.01)
+    cb.on_epoch_begin(3)
+    assert cb.learning_rate(30) is None             # past end
+
+
+def test_optax_schedules():
+    sched = warmup_schedule(0.8, warmup_steps=10, size=4)
+    assert float(sched(0)) == pytest.approx(0.2)
+    assert float(sched(10)) == pytest.approx(0.8)
+    assert float(sched(100)) == pytest.approx(0.8)
+    exp = exponential_schedule(1.0, decay=0.5, steps_per_epoch=10)
+    assert float(exp(0)) == pytest.approx(1.0)
+    assert float(exp(25)) == pytest.approx(0.25)
+
+
+# ---------------------------------------------------------- callbacks
+
+def test_broadcast_and_metric_average_callbacks():
+    cbs = CallbackList([BroadcastGlobalVariablesCallback(0),
+                        MetricAverageCallback()])
+    state = {"w": np.ones((3,), np.float32)}
+    state = cbs.on_train_begin(state)
+    np.testing.assert_allclose(np.asarray(state["w"]), 1.0)
+    metrics = cbs.on_epoch_end(0, {"loss": 2.0, "acc": 0.5})
+    # single process: averaging is identity
+    assert metrics["loss"] == pytest.approx(2.0)
+    assert metrics["acc"] == pytest.approx(0.5)
+
+
+def test_callback_list_lr_priority():
+    class Fixed:
+        def on_train_begin(self, s):
+            return s
+
+        def on_epoch_begin(self, e):
+            pass
+
+        def on_epoch_end(self, e, m=None):
+            return m
+
+        def learning_rate(self, step):
+            return 0.5
+
+    cb = LearningRateScheduleCallback(initial_lr=1.0, multiplier=2.0,
+                                      start_epoch=0)
+    cbs = CallbackList([Fixed(), cb])
+    cbs.on_epoch_begin(0)
+    # later callbacks win when they provide a value
+    assert cbs.learning_rate(0) == pytest.approx(2.0)
+
+
+# ------------------------------------------------------ SyncBatchNorm
+
+def test_sync_batch_norm_syncs_stats(world_mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.jax.sync_batch_norm import SyncBatchNorm
+    from horovod_tpu.parallel.mesh import WORLD_AXIS
+
+    n = len(jax.devices())
+    # per-device batches with very different means
+    x = np.concatenate([np.full((2, 4), float(i), np.float32)
+                        for i in range(n)])
+    model = SyncBatchNorm(use_running_average=False, momentum=0.9)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+
+    def step(xs):
+        out, updated = model.apply(variables, xs, mutable=["batch_stats"])
+        return out, updated["batch_stats"]
+
+    sharded = shard_map(step, mesh=world_mesh,
+                        in_specs=P(WORLD_AXIS),
+                        out_specs=(P(WORLD_AXIS), P()),
+                        check_vma=False)
+    out, stats = sharded(jnp.asarray(x))
+    # synced mean must equal the GLOBAL batch mean on every device
+    global_mean = x.mean(axis=0)
+    got_mean = np.asarray(jax.tree.leaves(stats)[0]).reshape(-1, 4)[0]
+    expect = 0.1 * global_mean       # momentum 0.9, init 0
+    np.testing.assert_allclose(got_mean, expect, rtol=1e-5)
+    # normalized output: per-device output differs from local-only BN
+    # (which would normalize each identical-valued shard to zeros)
+    assert float(np.abs(np.asarray(out)).max()) > 0.5
+
+
+def test_sync_batch_norm_no_axis_fallback():
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.jax.sync_batch_norm import SyncBatchNorm
+
+    x = jnp.asarray(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    model = SyncBatchNorm(use_running_average=False)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out, _ = model.apply(variables, x, mutable=["batch_stats"])
+    np.testing.assert_allclose(np.asarray(out).mean(axis=0), 0.0,
+                               atol=1e-5)
+
+
+# ------------------------------------------- compatibility bindings
+
+def test_tensorflow_binding_gated():
+    import horovod_tpu.tensorflow as hvt_tf
+
+    assert hvt_tf.rank() == hvt.rank()
+    try:
+        import tensorflow  # noqa: F401
+
+        has_tf = True
+    except ImportError:
+        has_tf = False
+    if not has_tf:
+        with pytest.raises(ImportError, match="horovod_tpu.jax"):
+            hvt_tf.allreduce(np.ones(3))
+
+
+def test_mxnet_binding_guidance():
+    import horovod_tpu.mxnet as hvt_mx
+
+    with pytest.raises(NotImplementedError, match="horovod_tpu.jax"):
+        hvt_mx.DistributedOptimizer()
+
+
+def test_keras_binding_gated():
+    import horovod_tpu.keras as hvt_keras
+
+    assert hvt_keras.size() == hvt.size()
+    try:
+        import tensorflow.keras  # noqa: F401
+
+        has = True
+    except ImportError:
+        has = False
+    if not has:
+        with pytest.raises(ImportError, match="horovod_tpu.jax"):
+            hvt_keras.MetricAverageCallback()

@@ -1,0 +1,213 @@
+"""Runner utility tests — mpirun command construction, config file,
+secret/codec/host-hash, probe services, and the programmatic run() API
+(the reference's ``test/single/test_run.py`` — 58 tests of CLI parsing
+and mpirun command construction with mocks — and ``test_service.py``)."""
+
+import os
+import sys
+
+import pytest
+
+from horovod_tpu.runner import codec, host_hash, network, secret
+from horovod_tpu.runner.config_parser import apply_config, load_config
+from horovod_tpu.runner.launch import parse_args
+from horovod_tpu.runner.mpi_run import (MPICH, OPENMPI, build_mpirun_command,
+                                        env_forward_args, env_from_mpi)
+from horovod_tpu.runner.js_run import build_jsrun_command, lsf_hosts
+from horovod_tpu.runner.probe import DriverProbe, TaskService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "horovod_tpu", "csrc", "build", "libhvt_core.so")
+
+
+# -------------------------------------------------------------- secret
+
+def test_secret_roundtrip():
+    key = secret.make_secret_key()
+    payload = b"host-update:1"
+    digest = secret.compute_digest(key, payload)
+    assert secret.check_digest(key, payload, digest)
+    assert not secret.check_digest(key, b"tampered", digest)
+    assert not secret.check_digest(secret.make_secret_key(), payload,
+                                   digest)
+
+
+def test_codec_roundtrip_closure():
+    base = 10
+    fn = lambda x: x + base  # noqa: E731
+    encoded = codec.dumps_base64((fn, (5,)))
+    fn2, args = codec.loads_base64(encoded)
+    assert fn2(*args) == 15
+
+
+def test_host_hash_stable_and_salted():
+    assert host_hash.host_hash() == host_hash.host_hash()
+    assert host_hash.host_hash("a") != host_hash.host_hash("b")
+    assert host_hash.hosts_equivalent("localhost", "127.0.0.1")
+    assert not host_hash.hosts_equivalent("localhost",
+                                          "definitely-not-a-host-xyz")
+
+
+# ------------------------------------------------------------- mpi_run
+
+def test_mpirun_command_openmpi():
+    cmd = build_mpirun_command(
+        4, "h1:2,h2:2", ["python", "train.py"],
+        {"HVT_MASTER_ADDR": "h1", "PATH": "/bin", "SECRET": "x"},
+        impl=OPENMPI, ssh_port=2222)
+    s = " ".join(cmd)
+    assert cmd[0] == "mpirun" and "-np 4" in s
+    assert "-H h1:2,h2:2" in s
+    assert "--tag-output" in s
+    assert "-x HVT_MASTER_ADDR" in s and "-x PATH" in s
+    assert "SECRET" not in s          # only HVT_*/PATH/PYTHONPATH forwarded
+    assert "plm_rsh_args" in s and "-p 2222" in s
+    assert cmd[-2:] == ["python", "train.py"]
+
+
+def test_mpirun_command_mpich():
+    cmd = build_mpirun_command(2, "h1:1,h2:1", ["python", "t.py"],
+                               {"HVT_MASTER_ADDR": "h1"}, impl=MPICH)
+    s = " ".join(cmd)
+    assert "-hosts h1,h2" in s
+    assert "-genvlist" in s and "HVT_MASTER_ADDR" in s
+
+
+def test_mpirun_large_cluster_flags():
+    hosts = ",".join(f"h{i}:1" for i in range(80))
+    cmd = build_mpirun_command(80, hosts, ["x"], {}, impl=OPENMPI)
+    assert "plm_rsh_no_tree_spawn" in " ".join(cmd)
+
+
+def test_env_forward_args():
+    assert env_forward_args(OPENMPI, ["A", "B"]) == ["-x", "A", "-x", "B"]
+    assert env_forward_args(MPICH, ["A", "B"]) == ["-genvlist", "A,B"]
+
+
+def test_env_from_mpi_openmpi():
+    derived = env_from_mpi({"OMPI_COMM_WORLD_RANK": "3",
+                            "OMPI_COMM_WORLD_SIZE": "8",
+                            "OMPI_COMM_WORLD_LOCAL_RANK": "1",
+                            "OMPI_COMM_WORLD_LOCAL_SIZE": "4"})
+    assert derived == {"HVT_PROCESS_ID": "3", "HVT_NUM_PROCESSES": "8",
+                       "HVT_LOCAL_PROCESS_ID": "1", "HVT_LOCAL_SIZE": "4"}
+
+
+def test_env_from_mpi_does_not_override():
+    derived = env_from_mpi({"HVT_PROCESS_ID": "0",
+                            "OMPI_COMM_WORLD_RANK": "3"})
+    assert "HVT_PROCESS_ID" not in derived
+
+
+# -------------------------------------------------------------- js_run
+
+def test_lsf_hosts():
+    hosts = lsf_hosts({"LSB_MCPU_HOSTS": "launcher1 1 node1 4 node2 4"})
+    assert hosts == {"node1": 4, "node2": 4}
+    # compute nodes named batch* must NOT be filtered; only the first
+    # (launcher) entry is dropped, by position
+    hosts = lsf_hosts({"LSB_MCPU_HOSTS": "launcher1 1 batch01 4 batch02 4"})
+    assert hosts == {"batch01": 4, "batch02": 4}
+    hosts = lsf_hosts({"LSB_HOSTS": "launcher node1 node1 node2"})
+    assert hosts == {"node1": 2, "node2": 1}
+
+
+def test_jsrun_command():
+    cmd = build_jsrun_command(8, ["python", "t.py"])
+    assert cmd[:2] == ["jsrun", "-n8"]
+    assert cmd[-2:] == ["python", "t.py"]
+
+
+# --------------------------------------------------------- config file
+
+def test_config_file_fills_defaults(tmp_path):
+    cfg = tmp_path / "hvt.yaml"
+    cfg.write_text("fusion-threshold-mb: 128\nautotune: true\n"
+                   "min-np: 2\n")
+    args = parse_args(["-np", "4", "--config-file", str(cfg),
+                       "python", "t.py"])
+    assert args.fusion_threshold_mb == 128
+    assert args.autotune is True
+    assert args.min_np == 2
+
+
+def test_config_file_cli_wins(tmp_path):
+    cfg = tmp_path / "hvt.yaml"
+    cfg.write_text("fusion-threshold-mb: 128\n")
+    args = parse_args(["-np", "2", "--fusion-threshold-mb", "32",
+                       "--config-file", str(cfg), "python", "t.py"])
+    assert args.fusion_threshold_mb == 32
+
+
+def test_config_file_rejects_unknown_keys(tmp_path):
+    cfg = tmp_path / "hvt.yaml"
+    cfg.write_text("not-a-real-knob: 1\n")
+    with pytest.raises(ValueError, match="unknown config keys"):
+        load_config(str(cfg))
+
+
+# ------------------------------------------------------------ probe
+
+def test_probe_services_ring():
+    """Two task services on localhost: driver collects info, runs the
+    ring probe, and the loopback address must come out as common."""
+    key = secret.make_secret_key()
+    t0 = TaskService(0, key, salt="0")
+    t1 = TaskService(1, key, salt="1")
+    p0, p1 = t0.start(), t1.start()
+    try:
+        driver = DriverProbe(key)
+        addrs = [f"127.0.0.1:{p0}", f"127.0.0.1:{p1}"]
+        infos = driver.collect_info(addrs)
+        assert infos[0]["host_hash"] != infos[1]["host_hash"]
+        # common NICs by NAME (hosts have different IPs in general)
+        common = driver.common_interfaces(addrs)
+        assert "lo" in common
+        reachable = driver.reachable_addresses(addrs)
+        assert all("127.0.0.1" in v for v in reachable.values())
+    finally:
+        t0.stop()
+        t1.stop()
+
+
+def test_probe_rejects_bad_signature():
+    import urllib.error
+
+    key = secret.make_secret_key()
+    t = TaskService(0, key)
+    port = t.start()
+    try:
+        bad = DriverProbe(secret.make_secret_key())
+        with pytest.raises(urllib.error.HTTPError):
+            bad.collect_info([f"127.0.0.1:{port}"])
+    finally:
+        t.stop()
+
+
+def test_network_interfaces():
+    ifaces = network.get_local_interfaces()
+    assert any("127.0.0.1" in ips for ips in ifaces.values())
+    assert "127.0.0.1" in network.local_addresses()
+
+
+# --------------------------------------------------------- run() API
+
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="C++ engine not built")
+def test_programmatic_run():
+    from horovod_tpu.runner import run
+
+    def train(scale):
+        import numpy as np
+
+        import horovod_tpu as hvt
+
+        val = hvt.allreduce(np.array([float(hvt.rank() + 1)]),
+                            name="r", average=False)
+        return float(np.asarray(val)[0]) * scale, hvt.rank(), hvt.size()
+
+    results = run(train, args=(10,), np=2, master_port=29935)
+    assert len(results) == 2
+    # ranks ordered; allreduce sum = 1+2 = 3 → scaled 30
+    assert results[0] == (30.0, 0, 2)
+    assert results[1] == (30.0, 1, 2)
