@@ -1,0 +1,42 @@
+"""Elastic training example (reference
+``examples/elastic/pytorch/pytorch_mnist_elastic.py`` shape):
+
+    hvtrun --min-np 2 --max-np 4 -np 2 \
+        --host-discovery-script ./discover.sh \
+        python examples/elastic/jax_elastic_train.py
+
+The job survives worker loss (restore from last commit) and picks up new
+hosts at the next commit boundary."""
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")   # one engine proc per slot
+
+import horovod_tpu as hvt                     # noqa: E402
+from horovod_tpu.elastic.state import ObjectState  # noqa: E402
+
+hvt.init()
+
+
+@hvt.elastic.run
+def train(state):
+    rs = np.random.RandomState(0)
+    w_true = np.arange(4, dtype=np.float32)
+    while state.epoch < 20:
+        X = rs.randn(32, 4).astype(np.float32)
+        y = X @ w_true
+        grad = -2 * X.T @ (y - X @ state.w) / len(X)
+        # gradient allreduce across the current world
+        grad = np.asarray(hvt.allreduce(grad, name="grad", average=True))
+        state.w = state.w - 0.05 * grad
+        state.epoch += 1
+        state.commit()     # snapshot + host-update check
+    return state.w
+
+
+if __name__ == "__main__":
+    state = ObjectState(w=np.zeros(4, np.float32), epoch=0)
+    w = train(state)
+    print(f"rank {hvt.rank()}/{hvt.size()} final w={np.round(w, 3)}")

@@ -1,0 +1,72 @@
+"""GPT training with combined data/tensor/sequence parallelism — the
+flagship multi-dimensional-mesh example (no reference counterpart: the
+reference is data-parallel only; this is the TPU-native capability the
+pjit design adds for free, SURVEY.md §2.6).
+
+    python examples/jax/jax_gpt_train.py --dp 2 --tp 2 --sp 2
+(on a virtual mesh: XLA_FLAGS=--xla_force_host_platform_device_count=8)
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvt
+from horovod_tpu.models import GPT, GPTConfig
+from horovod_tpu.models.transformer import param_partition_spec
+from horovod_tpu.parallel.mesh import make_parallel_mesh
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--seq", type=int, default=256)
+    args = p.parse_args()
+
+    hvt.init()
+    mesh = make_parallel_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+    cfg = GPTConfig(vocab_size=32000, n_layers=4, d_model=512, n_heads=8,
+                    d_ff=2048, max_seq_len=args.seq, dtype=jnp.bfloat16)
+    model = GPT(cfg)
+
+    tokens = jnp.asarray(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (args.batch, args.seq)))
+    params = model.init(jax.random.PRNGKey(0), tokens[:2, :8])["params"]
+
+    pspecs = param_partition_spec(params, tp_axis="tp")
+    params = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, pspecs, is_leaf=lambda x: isinstance(x, P))
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp", "sp")))
+
+    tx = hvt.DistributedOptimizer(optax.adamw(3e-4), axis_name=None)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            tgt = jnp.roll(tokens, -1, axis=-1)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), tgt[:, :-1]).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for step in range(args.steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+        if hvt.rank() == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

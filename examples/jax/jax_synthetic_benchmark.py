@@ -1,0 +1,98 @@
+"""Synthetic ResNet-50 benchmark (the reference's
+``examples/pytorch/pytorch_synthetic_benchmark.py`` /
+``examples/tensorflow2/tensorflow2_synthetic_benchmark.py``, TPU-native).
+
+Data-parallel over every visible chip; the gradient allreduce is compiled
+into the step by XLA. Run:
+
+    python examples/jax/jax_synthetic_benchmark.py --batch-size 128
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvt
+from horovod_tpu.models import ResNet50
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=64,
+                   help="per-chip batch size")
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--fp32", action="store_true")
+    args = p.parse_args()
+
+    hvt.init()
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    n_dev = jax.local_device_count()
+    model = ResNet50(num_classes=1000, dtype=dtype)
+
+    global_batch = args.batch_size * n_dev
+    rs = np.random.RandomState(0)
+    images = jnp.asarray(
+        rs.randn(global_batch, 224, 224, 3).astype(np.float32),
+        dtype=dtype)
+    labels = jnp.asarray(rs.randint(0, 1000, (global_batch,)))
+
+    variables = model.init(jax.random.PRNGKey(0), images[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    tx = hvt.DistributedOptimizer(optax.sgd(0.01), axis_name=None)
+    opt_state = tx.init(params)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel.mesh import WORLD_AXIS, global_mesh
+
+    mesh = global_mesh()
+    images = jax.device_put(images, NamedSharding(mesh, P(WORLD_AXIS)))
+    labels = jax.device_put(labels, NamedSharding(mesh, P(WORLD_AXIS)))
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, images, labels):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats}, images,
+                train=True, mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels).mean()
+            return loss, mutated["batch_stats"]
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_stats,
+                opt_state, loss)
+
+    params, batch_stats, opt_state, _ = step(params, batch_stats,
+                                             opt_state, images, labels)
+    jax.block_until_ready(params)      # compile + warm
+
+    if hvt.rank() == 0:
+        print(f"Model: ResNet50, batch {args.batch_size}/chip × "
+              f"{n_dev} chips, dtype {dtype.__name__}")
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, images, labels)
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        rate = global_batch * args.num_batches_per_iter / dt
+        img_secs.append(rate)
+        if hvt.rank() == 0:
+            print(f"Iter: {rate:.1f} img/sec")
+    if hvt.rank() == 0:
+        print(f"Img/sec: {np.mean(img_secs):.1f} "
+              f"+- {1.96 * np.std(img_secs):.1f}")
+
+
+if __name__ == "__main__":
+    main()

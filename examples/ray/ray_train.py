@@ -1,0 +1,27 @@
+"""Ray executor example (reference ``examples/ray/ray_train.py``
+lineage). Requires a ray installation:
+
+    python examples/ray/ray_train.py
+"""
+
+import numpy as np
+
+
+def train_fn():
+    import horovod_tpu as hvt
+
+    val = hvt.allreduce(np.array([float(hvt.rank())]), name="x",
+                        average=True)
+    return float(np.asarray(val)[0]), hvt.rank(), hvt.size()
+
+
+if __name__ == "__main__":
+    import ray
+
+    from horovod_tpu.ray import RayExecutor
+
+    ray.init()
+    executor = RayExecutor(num_workers=2, cpus_per_worker=1)
+    executor.start()
+    print(executor.run(train_fn))
+    executor.shutdown()

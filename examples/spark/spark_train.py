@@ -1,0 +1,22 @@
+"""Horovod-on-Spark example (reference ``examples/spark/pytorch/``
+lineage). Requires pyspark:
+
+    spark-submit examples/spark/spark_train.py
+"""
+
+import numpy as np
+
+
+def train_fn():
+    import horovod_tpu as hvt
+
+    val = hvt.allreduce(np.array([float(hvt.rank() + 1)]), name="s",
+                        average=False)
+    return float(np.asarray(val)[0]), hvt.rank(), hvt.size()
+
+
+if __name__ == "__main__":
+    import horovod_tpu.spark as hvt_spark
+
+    results = hvt_spark.run(train_fn, num_proc=2)
+    print(results)

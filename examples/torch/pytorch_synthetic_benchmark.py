@@ -1,0 +1,66 @@
+"""PyTorch synthetic benchmark through the eager engine path (reference
+``examples/pytorch/pytorch_synthetic_benchmark.py``):
+
+    hvtrun -np 2 python examples/torch/pytorch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.optim as optim
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import horovod_tpu.torch as hvd               # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-iters", type=int, default=3)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = nn.Sequential(
+        nn.Conv2d(3, 32, 3, stride=2), nn.ReLU(),
+        nn.Conv2d(32, 64, 3, stride=2), nn.ReLU(),
+        nn.AdaptiveAvgPool2d(1), nn.Flatten(), nn.Linear(64, 10))
+    optimizer = optim.SGD(model.parameters(), lr=0.01)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, 64, 64)
+    target = torch.randint(0, 10, (args.batch_size,))
+    loss_fn = nn.CrossEntropyLoss()
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = loss_fn(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    benchmark_step()    # warm up
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.perf_counter() - t0
+        img_secs.append(args.batch_size * args.num_batches_per_iter / dt)
+    if hvd.rank() == 0:
+        print(f"Img/sec per proc: {np.mean(img_secs):.1f} "
+              f"+- {1.96 * np.std(img_secs):.1f}")
+        print(f"Total img/sec on {hvd.size()} proc(s): "
+              f"{hvd.size() * np.mean(img_secs):.1f}")
+
+
+if __name__ == "__main__":
+    main()

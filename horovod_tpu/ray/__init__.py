@@ -1,0 +1,6 @@
+"""Ray integration (reference ``horovod/ray/runner.py:250`` RayExecutor,
+``ray/elastic.py:300`` ElasticRayExecutor)."""
+
+from horovod_tpu.ray.runner import Coordinator, RayExecutor  # noqa: F401
+from horovod_tpu.ray.elastic import (ElasticRayExecutor,  # noqa: F401
+                                     RayHostDiscovery)

@@ -1,0 +1,102 @@
+"""Elastic training on Ray (reference ``horovod/ray/elastic.py``:
+``RayHostDiscovery``, ``ElasticRayExecutor:300``): the Ray cluster state
+becomes the host-discovery source for the ElasticDriver, so Ray
+autoscaling grows/shrinks the training job."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from horovod_tpu.runner.elastic.discovery import HostDiscovery
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Discovers hosts from ``ray.nodes()`` (reference
+    ``elastic.py`` RayHostDiscovery): every alive node with enough CPUs
+    (or a GPU when ``use_gpu``) contributes ``slots`` workers.
+
+    ``nodes_fn`` is injectable for tests; defaults to ``ray.nodes``."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1,
+                 nodes_fn: Optional[Callable] = None):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+        self._nodes_fn = nodes_fn
+
+    def _nodes(self):
+        if self._nodes_fn is not None:
+            return self._nodes_fn()
+        import ray
+
+        return ray.nodes()
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        hosts: Dict[str, int] = {}
+        for node in self._nodes():
+            if not node.get("Alive"):
+                continue
+            resources = node.get("Resources", {})
+            hostname = node.get("NodeManagerHostname") or \
+                node.get("NodeManagerAddress")
+            if not hostname:
+                continue
+            if self.use_gpu:
+                slots = int(resources.get("GPU", 0) // self.gpus_per_slot)
+            else:
+                slots = int(resources.get("CPU", 0) // self.cpus_per_slot)
+            if slots > 0:
+                hosts[hostname] = slots
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Fault-tolerant executor: ElasticDriver + RayHostDiscovery
+    (reference ``ElasticRayExecutor:300``). Workers run the user fn under
+    ``@hvt.elastic.run`` semantics; Ray node loss/gain triggers
+    re-rendezvous through the standard elastic protocol."""
+
+    def __init__(self, min_np: int = 1, max_np: Optional[int] = None,
+                 use_gpu: bool = False, cpus_per_slot: int = 1,
+                 reset_limit: Optional[int] = None,
+                 elastic_timeout: float = 600.0,
+                 override_discovery: Optional[HostDiscovery] = None):
+        from horovod_tpu.runner.elastic.settings import ElasticSettings
+
+        self.discovery = override_discovery or RayHostDiscovery(
+            use_gpu=use_gpu, cpus_per_slot=cpus_per_slot)
+        self.settings = ElasticSettings(
+            min_np=min_np, max_np=max_np, reset_limit=reset_limit,
+            elastic_timeout=elastic_timeout)
+        self.driver = None
+        self.rendezvous = None
+
+    def start(self):
+        from horovod_tpu.runner.elastic.driver import ElasticDriver
+        from horovod_tpu.runner.http_server import RendezvousServer
+
+        self.rendezvous = RendezvousServer()
+        self.rendezvous.start()
+        self.driver = ElasticDriver(self.rendezvous, self.discovery,
+                                    self.settings)
+
+    def run(self, worker_fn: Callable, np: Optional[int] = None) -> Dict:
+        """Run ``worker_fn(slot_info) -> exit_code`` elastically on the
+        discovered hosts; returns the final per-rank exit codes. On a
+        live Ray cluster ``worker_fn`` typically submits a Ray task
+        pinned to ``slot_info.hostname``; tests pass a local callable."""
+        if self.driver is None:
+            raise RuntimeError("call start() before run()")
+        self.driver.start(np or self.settings.min_np,
+                          create_worker_fn=worker_fn)
+        self.driver.wait()
+        if self.driver.error:
+            raise RuntimeError(self.driver.error)
+        return self.driver.get_results()
+
+    def shutdown(self):
+        if self.driver is not None:
+            self.driver.stop()
+        if self.rendezvous is not None:
+            self.rendezvous.stop()

@@ -1,0 +1,184 @@
+"""Ray executor (reference ``horovod/ray/runner.py``: ``RayExecutor:250``
+— Ray actors become job slots; ``Coordinator:178`` — builds
+rank/hostname maps and rendezvous env; ``NodeColocator:90`` — workers
+packed per node via placement groups).
+
+The Coordinator is pure logic (no ray import) so rank assignment and env
+construction are unit-testable anywhere; RayExecutor requires a live
+``ray`` installation and is import-gated."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _ray():
+    try:
+        import ray
+
+        return ray
+    except ImportError as e:
+        raise ImportError(
+            "RayExecutor requires ray (pip install 'ray[default]'); the "
+            "machine-local equivalents are hvtrun (CLI) and "
+            "horovod_tpu.runner.run (programmatic)") from e
+
+
+class Coordinator:
+    """Turns a list of per-worker hostnames into the slot env for each
+    worker (reference ``runner.py:178``): ranks are grouped so workers on
+    one node get consecutive local_ranks, and every worker learns the
+    rendezvous (master) address."""
+
+    def __init__(self, master_addr: str, master_port: int):
+        self.master_addr = master_addr
+        self.master_port = master_port
+        self.hostnames: List[str] = []
+
+    def register(self, hostname: str) -> int:
+        """Register one worker; returns its registration index."""
+        self.hostnames.append(hostname)
+        return len(self.hostnames) - 1
+
+    @property
+    def world_size(self) -> int:
+        return len(self.hostnames)
+
+    def node_workers(self) -> "OrderedDict[str, List[int]]":
+        """hostname → registration indices, in first-seen node order."""
+        nodes: "OrderedDict[str, List[int]]" = OrderedDict()
+        for idx, host in enumerate(self.hostnames):
+            nodes.setdefault(host, []).append(idx)
+        return nodes
+
+    def slot_envs(self) -> List[Dict[str, str]]:
+        """Per-registration-index HVT_* env (same keys the hvtrun
+        launcher sets, launch.py slot_env)."""
+        nodes = self.node_workers()
+        size = self.world_size
+        cross_size_at = {}
+        for host, members in nodes.items():
+            for lr in range(len(members)):
+                cross_size_at[lr] = cross_size_at.get(lr, 0) + 1
+        envs: List[Optional[Dict[str, str]]] = [None] * size
+        rank = 0
+        for host_i, (host, members) in enumerate(nodes.items()):
+            for lr, idx in enumerate(members):
+                cross_rank = sum(
+                    1 for h2, m2 in list(nodes.items())[:host_i]
+                    if len(m2) > lr)
+                envs[idx] = {
+                    "HVT_PROCESS_ID": str(rank),
+                    "HVT_NUM_PROCESSES": str(size),
+                    "HVT_LOCAL_PROCESS_ID": str(lr),
+                    "HVT_LOCAL_SIZE": str(len(members)),
+                    "HVT_CROSS_RANK": str(cross_rank),
+                    "HVT_CROSS_SIZE": str(cross_size_at[lr]),
+                    "HVT_HOSTNAME": host,
+                    "HVT_MASTER_ADDR": self.master_addr,
+                    "HVT_MASTER_PORT": str(self.master_port),
+                }
+                rank += 1
+        return [e for e in envs if e is not None]
+
+
+class RayExecutor:
+    """Run a horovod_tpu job on Ray actors (reference
+    ``RayExecutor:250``).
+
+    Usage::
+
+        ex = RayExecutor(num_workers=4, cpus_per_worker=1)
+        ex.start()
+        results = ex.run(train_fn, args=(cfg,))
+        ex.shutdown()
+    """
+
+    def __init__(self, num_workers: int, cpus_per_worker: int = 1,
+                 use_gpu: bool = False, master_port: int = 29560,
+                 env: Optional[dict] = None, force_cpu_jax: bool = True):
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.use_gpu = use_gpu
+        self.master_port = master_port
+        self.extra_env = dict(env or {})
+        self.force_cpu_jax = force_cpu_jax
+        self._workers = []
+
+    def start(self):
+        ray = _ray()
+
+        @ray.remote(num_cpus=self.cpus_per_worker,
+                    num_gpus=1 if self.use_gpu else 0)
+        class Worker:
+            def __init__(self):
+                self._env = {}
+
+            def hostname(self):
+                import socket
+
+                return socket.gethostname()
+
+            def ip(self):
+                import ray as _r
+
+                return _r.util.get_node_ip_address()
+
+            def set_env(self, env):
+                import os
+
+                self._env = dict(env)
+                os.environ.update(env)
+
+            def execute(self, fn, args, kwargs):
+                import os
+
+                if self._env.get("HVT_FORCE_CPU_JAX") == "1":
+                    import jax
+
+                    jax.config.update("jax_platforms", "cpu")
+                import horovod_tpu as hvt
+
+                hvt.init()
+                try:
+                    return fn(*(args or ()), **(kwargs or {}))
+                finally:
+                    hvt.shutdown()
+
+        self._workers = [Worker.remote() for _ in range(self.num_workers)]
+        ray = _ray()
+        hostnames = ray.get([w.hostname.remote() for w in self._workers])
+        ips = ray.get([w.ip.remote() for w in self._workers])
+        coord = Coordinator(master_addr=ips[0],
+                            master_port=self.master_port)
+        for h in hostnames:
+            coord.register(h)
+        envs = coord.slot_envs()
+        # registration order != rank order (ranks are grouped by node);
+        # remember each worker's rank so run() can return rank-ordered
+        self._ranks = [int(e["HVT_PROCESS_ID"]) for e in envs]
+        for w, env in zip(self._workers, envs):
+            env = dict(env)
+            env.update(self.extra_env)
+            if self.force_cpu_jax:
+                env["HVT_FORCE_CPU_JAX"] = "1"
+            w.set_env.remote(env)
+
+    def run(self, fn: Callable, args=(), kwargs=None) -> List[Any]:
+        """Execute ``fn`` on every worker; results are ordered by RANK
+        (matching runner.run and spark.run), not actor creation order."""
+        ray = _ray()
+        if not self._workers:
+            raise RuntimeError("call start() before run()")
+        futures = [w.execute.remote(fn, args, kwargs)
+                   for w in self._workers]
+        results = ray.get(futures)
+        by_rank = sorted(zip(self._ranks, results))
+        return [r for _, r in by_rank]
+
+    def shutdown(self):
+        ray = _ray()
+        for w in self._workers:
+            ray.kill(w)
+        self._workers = []

@@ -1,0 +1,4 @@
+"""Spark integration (reference ``horovod/spark/__init__.py`` +
+``spark/runner.py:195`` ``run()`` — Spark tasks become job slots)."""
+
+from horovod_tpu.spark.runner import (run, slot_envs_from_task_infos)  # noqa: F401,E501

@@ -1,0 +1,130 @@
+"""Ray / Spark integration tests driven by fakes — no ray or pyspark
+installed (the reference's ``test/single/test_ray*.py`` use a local ray
+cluster; the pure-logic cores here are testable without one)."""
+
+import pytest
+
+from horovod_tpu.ray.elastic import ElasticRayExecutor, RayHostDiscovery
+from horovod_tpu.ray.runner import Coordinator, RayExecutor
+from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+from horovod_tpu.spark.runner import slot_envs_from_task_infos
+
+
+# -------------------------------------------------------- Coordinator
+
+def test_coordinator_single_node():
+    c = Coordinator("10.0.0.1", 29560)
+    for _ in range(4):
+        c.register("nodeA")
+    envs = c.slot_envs()
+    assert [e["HVT_PROCESS_ID"] for e in envs] == ["0", "1", "2", "3"]
+    assert all(e["HVT_LOCAL_SIZE"] == "4" for e in envs)
+    assert [e["HVT_LOCAL_PROCESS_ID"] for e in envs] == \
+        ["0", "1", "2", "3"]
+    assert all(e["HVT_CROSS_SIZE"] == "1" for e in envs)
+    assert all(e["HVT_MASTER_ADDR"] == "10.0.0.1" for e in envs)
+
+
+def test_coordinator_multi_node_grouping():
+    """Workers registered interleaved across nodes still get consecutive
+    ranks per node (reference Coordinator:178 groups by hostname)."""
+    c = Coordinator("m", 1)
+    order = ["A", "B", "A", "B"]          # registration order interleaved
+    for h in order:
+        c.register(h)
+    envs = c.slot_envs()
+    # envs are indexed by registration order
+    byhost = {}
+    for reg_idx, env in enumerate(envs):
+        byhost.setdefault(order[reg_idx], []).append(
+            (int(env["HVT_PROCESS_ID"]),
+             int(env["HVT_LOCAL_PROCESS_ID"]),
+             int(env["HVT_CROSS_RANK"])))
+    assert byhost["A"] == [(0, 0, 0), (1, 1, 0)]
+    assert byhost["B"] == [(2, 0, 1), (3, 1, 1)]
+
+
+# ---------------------------------------------------- RayHostDiscovery
+
+def _node(host, cpu=0, gpu=0, alive=True):
+    return {"Alive": alive, "NodeManagerHostname": host,
+            "Resources": {"CPU": cpu, "GPU": gpu}}
+
+
+def test_ray_discovery_cpu_slots():
+    d = RayHostDiscovery(cpus_per_slot=2, nodes_fn=lambda: [
+        _node("a", cpu=8), _node("b", cpu=3),
+        _node("dead", cpu=8, alive=False)])
+    assert d.find_available_hosts_and_slots() == {"a": 4, "b": 1}
+
+
+def test_ray_discovery_gpu_slots():
+    d = RayHostDiscovery(use_gpu=True, nodes_fn=lambda: [
+        _node("a", cpu=8, gpu=2), _node("b", cpu=8, gpu=0)])
+    assert d.find_available_hosts_and_slots() == {"a": 2}
+
+
+def test_elastic_ray_executor_with_fake_cluster():
+    ex = ElasticRayExecutor(
+        min_np=2, max_np=2,
+        override_discovery=FixedHostDiscovery({"localhost": 2}))
+    ex.start()
+    try:
+        results = ex.run(lambda slot: 0, np=2)
+        assert set(results.values()) == {0}
+    finally:
+        ex.shutdown()
+
+
+def test_elastic_ray_executor_propagates_failure():
+    ex = ElasticRayExecutor(
+        min_np=2, max_np=2, reset_limit=0,
+        override_discovery=FixedHostDiscovery({"localhost": 2}))
+    ex.start()
+    try:
+        with pytest.raises(RuntimeError, match="reset count|min_np"):
+            ex.run(lambda slot: 1 if slot.rank == 1 else 0, np=2)
+    finally:
+        ex.shutdown()
+
+
+# -------------------------------------------------------------- Spark
+
+def test_spark_slot_envs_multi_host():
+    envs = slot_envs_from_task_infos(
+        ["hostA:123", "hostA:124", "hostB:125"], master_port=29570)
+    assert [e["HVT_PROCESS_ID"] for e in envs] == ["0", "1", "2"]
+    assert [e["HVT_LOCAL_PROCESS_ID"] for e in envs] == ["0", "1", "0"]
+    assert [e["HVT_LOCAL_SIZE"] for e in envs] == ["2", "2", "1"]
+    assert [e["HVT_CROSS_RANK"] for e in envs] == ["0", "0", "1"]
+    # local_rank 0 exists on both hosts; local_rank 1 only on hostA
+    assert envs[0]["HVT_CROSS_SIZE"] == "2"
+    assert envs[1]["HVT_CROSS_SIZE"] == "1"
+    assert all(e["HVT_MASTER_ADDR"] == "hostA" for e in envs)
+
+
+# -------------------------------------------------------------- gating
+
+def test_ray_executor_gated():
+    try:
+        import ray  # noqa: F401
+
+        pytest.skip("ray installed; gating not applicable")
+    except ImportError:
+        pass
+    ex = RayExecutor(num_workers=2)
+    with pytest.raises(ImportError, match="hvtrun"):
+        ex.start()
+
+
+def test_spark_run_gated():
+    try:
+        import pyspark  # noqa: F401
+
+        pytest.skip("pyspark installed; gating not applicable")
+    except ImportError:
+        pass
+    from horovod_tpu.spark import run
+
+    with pytest.raises(ImportError, match="pyspark"):
+        run(lambda: None, num_proc=2)
