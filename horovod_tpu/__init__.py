@@ -103,6 +103,16 @@ from horovod_tpu import elastic
 
 __version__ = "0.1.0"
 
+
+def __getattr__(name):
+    # lazy submodules: checkpoint pulls in orbax, runner pulls launcher
+    # machinery — neither belongs in the base import path
+    if name in ("checkpoint", "runner"):
+        import importlib
+
+        return importlib.import_module(f"horovod_tpu.{name}")
+    raise AttributeError(name)
+
 __all__ = [
     # lifecycle
     "init", "shutdown", "is_initialized", "start_timeline", "stop_timeline",

@@ -53,33 +53,28 @@ class Coordinator:
         return nodes
 
     def slot_envs(self) -> List[Dict[str, str]]:
-        """Per-registration-index HVT_* env (same keys the hvtrun
-        launcher sets, launch.py slot_env)."""
+        """Per-registration-index HVT_* env. Delegates the
+        rank/local/cross assignment to hosts.get_host_assignments (the
+        single implementation every launch path shares) and maps the
+        grouped slots back onto registration order."""
+        from horovod_tpu.runner.hosts import (HostInfo,
+                                              get_host_assignments,
+                                              slot_env_vars)
+
         nodes = self.node_workers()
-        size = self.world_size
-        cross_size_at = {}
+        host_list = [HostInfo(host, len(members))
+                     for host, members in nodes.items()]
+        slots = get_host_assignments(host_list, self.world_size)
+        by_key = {(s.hostname, s.local_rank): s for s in slots}
+        envs: List[Optional[Dict[str, str]]] = [None] * self.world_size
         for host, members in nodes.items():
-            for lr in range(len(members)):
-                cross_size_at[lr] = cross_size_at.get(lr, 0) + 1
-        envs: List[Optional[Dict[str, str]]] = [None] * size
-        rank = 0
-        for host_i, (host, members) in enumerate(nodes.items()):
             for lr, idx in enumerate(members):
-                cross_rank = sum(
-                    1 for h2, m2 in list(nodes.items())[:host_i]
-                    if len(m2) > lr)
-                envs[idx] = {
-                    "HVT_PROCESS_ID": str(rank),
-                    "HVT_NUM_PROCESSES": str(size),
-                    "HVT_LOCAL_PROCESS_ID": str(lr),
-                    "HVT_LOCAL_SIZE": str(len(members)),
-                    "HVT_CROSS_RANK": str(cross_rank),
-                    "HVT_CROSS_SIZE": str(cross_size_at[lr]),
-                    "HVT_HOSTNAME": host,
+                env = slot_env_vars(by_key[(host, lr)])
+                env.update({
                     "HVT_MASTER_ADDR": self.master_addr,
                     "HVT_MASTER_PORT": str(self.master_port),
-                }
-                rank += 1
+                })
+                envs[idx] = env
         return [e for e in envs if e is not None]
 
 

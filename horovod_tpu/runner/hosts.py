@@ -57,6 +57,20 @@ def parse_hostfile(path: str) -> List[HostInfo]:
     return out
 
 
+def slot_env_vars(slot: SlotInfo) -> dict:
+    """The HVT_* identity env for one slot — single source of truth for
+    every launch path (hvtrun ssh, Ray actors, Spark barrier tasks)."""
+    return {
+        "HVT_PROCESS_ID": str(slot.rank),
+        "HVT_NUM_PROCESSES": str(slot.size),
+        "HVT_LOCAL_PROCESS_ID": str(slot.local_rank),
+        "HVT_LOCAL_SIZE": str(slot.local_size),
+        "HVT_CROSS_RANK": str(slot.cross_rank),
+        "HVT_CROSS_SIZE": str(slot.cross_size),
+        "HVT_HOSTNAME": slot.hostname,
+    }
+
+
 def get_host_assignments(hosts: List[HostInfo], np: int) -> List[SlotInfo]:
     """Pack ``np`` ranks onto host slots in host order, producing
     rank/local_rank/cross_rank per slot (reference hosts.py:100).

@@ -120,15 +120,11 @@ def _ssh_command(env, hostname, ssh_port, command):
 def slot_env(base_env, slot, args, master_addr):
     """Per-slot environment (reference gloo_run.py:65-99
     create_slot_env_vars: HOROVOD_RANK/SIZE/LOCAL_RANK/..._ADDR)."""
+    from horovod_tpu.runner.hosts import slot_env_vars
+
     env = dict(base_env)
+    env.update(slot_env_vars(slot))
     env.update({
-        "HVT_PROCESS_ID": str(slot.rank),
-        "HVT_NUM_PROCESSES": str(slot.size),
-        "HVT_LOCAL_PROCESS_ID": str(slot.local_rank),
-        "HVT_LOCAL_SIZE": str(slot.local_size),
-        "HVT_CROSS_RANK": str(slot.cross_rank),
-        "HVT_CROSS_SIZE": str(slot.cross_size),
-        "HVT_HOSTNAME": slot.hostname,
         "HVT_CYCLE_TIME_MS": str(args.cycle_time_ms),
         "HVT_FUSION_THRESHOLD": str(args.fusion_threshold_mb << 20),
         "HVT_STALL_WARN_SEC": str(args.stall_warning_sec),

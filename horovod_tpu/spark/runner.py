@@ -26,27 +26,25 @@ def slot_envs_from_task_infos(addresses: List[str], master_port: int,
     (``host:port`` strings, rank-ordered). Local ranks count occurrences
     of the same host before/at each rank; cross ranks index hosts having
     that local slot — identical semantics to hosts.get_host_assignments."""
+    from horovod_tpu.runner.hosts import SlotInfo, slot_env_vars
+
     hosts = [a.rsplit(":", 1)[0] for a in addresses]
     size = len(hosts)
     envs = []
     for rank, host in enumerate(hosts):
+        # rank MUST equal the Spark partition id, so hosts may interleave
+        # — local/cross ranks are computed positionally, not regrouped
         local_rank = hosts[:rank].count(host)
-        local_size = hosts.count(host)
-        hosts_with_slot = []
-        for h in dict.fromkeys(hosts):          # stable unique order
-            if hosts.count(h) > local_rank:
-                hosts_with_slot.append(h)
-        envs.append({
-            "HVT_PROCESS_ID": str(rank),
-            "HVT_NUM_PROCESSES": str(size),
-            "HVT_LOCAL_PROCESS_ID": str(local_rank),
-            "HVT_LOCAL_SIZE": str(local_size),
-            "HVT_CROSS_RANK": str(hosts_with_slot.index(host)),
-            "HVT_CROSS_SIZE": str(len(hosts_with_slot)),
-            "HVT_HOSTNAME": host,
-            "HVT_MASTER_ADDR": hosts[0],
-            "HVT_MASTER_PORT": str(master_port),
-        })
+        hosts_with_slot = [h for h in dict.fromkeys(hosts)
+                           if hosts.count(h) > local_rank]
+        slot = SlotInfo(hostname=host, rank=rank, local_rank=local_rank,
+                        cross_rank=hosts_with_slot.index(host), size=size,
+                        local_size=hosts.count(host),
+                        cross_size=len(hosts_with_slot))
+        env = slot_env_vars(slot)
+        env.update({"HVT_MASTER_ADDR": hosts[0],
+                    "HVT_MASTER_PORT": str(master_port)})
+        envs.append(env)
     return envs
 
 

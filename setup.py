@@ -1,0 +1,33 @@
+"""Build hook: compile the C++ core engine (csrc → libhvt_core.so) during
+wheel builds. Metadata lives in pyproject.toml.
+
+The engine is optional at runtime — engine/native.py degrades gracefully
+when the .so is absent (the compiled-XLA training path needs no native
+code) — so a missing toolchain downgrades to a warning instead of
+failing the install. Set HVT_REQUIRE_ENGINE=1 to make it fatal."""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithEngine(build_py):
+    def run(self):
+        try:
+            subprocess.run(["make", "-C", "horovod_tpu/csrc", "-j"],
+                           check=True)
+        except (OSError, subprocess.CalledProcessError) as e:
+            if os.environ.get("HVT_REQUIRE_ENGINE") == "1":
+                raise
+            print(f"WARNING: C++ engine build skipped ({e}); the eager "
+                  f"multi-process path (hvtrun engine backend, torch "
+                  f"binding) will be unavailable. Install g++/make and "
+                  f"rebuild with `make -C horovod_tpu/csrc` to enable it.",
+                  file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithEngine})

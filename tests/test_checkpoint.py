@@ -1,0 +1,81 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4 — orbax file layer with
+broadcast-on-restore)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+import jax.numpy as jnp  # noqa: E402
+
+from horovod_tpu import checkpoint  # noqa: E402
+
+
+def _state(seed=0):
+    rs = np.random.RandomState(seed)
+    return {"params": {"w": jnp.asarray(rs.randn(4, 3).astype(np.float32)),
+                       "b": jnp.asarray(rs.randn(3).astype(np.float32))},
+            "step": jnp.asarray(7)}
+
+
+def _assert_tree_equal(a, b):
+    import jax
+
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = _state()
+    checkpoint.save(str(tmp_path / "ckpt"), state)
+    restored = checkpoint.restore(str(tmp_path / "ckpt"), template=state)
+    _assert_tree_equal(state, restored)
+
+
+def test_manager_latest_and_retention(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), max_to_keep=2,
+                                       async_save=False)
+    try:
+        for step in (1, 2, 3):
+            st = _state(step)
+            assert mgr.save(step, st)
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        assert len(mgr.all_steps()) <= 2           # retention enforced
+        restored = mgr.restore_latest(template=_state(0))
+        _assert_tree_equal(_state(3), restored)
+    finally:
+        mgr.close()
+
+
+def test_manager_save_interval(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), max_to_keep=5,
+                                       save_interval_steps=2,
+                                       async_save=False)
+    try:
+        assert mgr.save(0, _state(0))
+        assert not mgr.save(1, _state(1))          # skipped by interval
+        assert mgr.save(2, _state(2))
+        assert mgr.save(3, _state(3), force=True)  # force overrides
+    finally:
+        mgr.close()
+
+
+def test_restore_latest_empty(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), async_save=False)
+    try:
+        assert mgr.restore_latest() is None
+    finally:
+        mgr.close()
+
+
+def test_async_save_then_wait(tmp_path):
+    mgr = checkpoint.CheckpointManager(str(tmp_path), async_save=True)
+    try:
+        st = _state(42)
+        mgr.save(5, st)
+        mgr.wait()                                  # durable after wait
+        restored = mgr.restore(5, template=st)
+        _assert_tree_equal(st, restored)
+    finally:
+        mgr.close()
