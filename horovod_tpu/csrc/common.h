@@ -130,6 +130,19 @@ struct TensorShape {
   }
 };
 
+inline const char* OpName(OpType op) {
+  switch (op) {
+    case OpType::ALLREDUCE: return "ALLREDUCE";
+    case OpType::ALLGATHER: return "ALLGATHER";
+    case OpType::BROADCAST: return "BROADCAST";
+    case OpType::ALLTOALL: return "ALLTOALL";
+    case OpType::REDUCESCATTER: return "REDUCESCATTER";
+    case OpType::JOIN: return "JOIN";
+    case OpType::BARRIER: return "BARRIER";
+  }
+  return "OP";
+}
+
 // A pending collective submitted by a client thread — the analog of
 // TensorTableEntry (reference common.h:237). Owns copies of the payload so
 // client buffers can be released immediately.

@@ -100,6 +100,13 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   announced_.clear();
   shutdown_requested_ = false;
   fatal_ = false;
+  // only the coordinator writes the timeline file (reference
+  // operations.cc:422-425); started only after a successful rendezvous
+  // so an Init failure leaves no orphan writer thread / open file
+  const char* tl = getenv("HVT_TIMELINE");
+  if (rank_ == 0 && tl && *tl)
+    timeline_.Initialize(tl,
+                         EnvInt("HVT_TIMELINE_MARK_CYCLES", 0) != 0);
   initialized_ = true;
   thread_ = std::thread([this] { ThreadLoop(); });
   return Status::OK();
@@ -114,6 +121,7 @@ void Engine::Shutdown() {
   data_.reset();
   data_listener_.Close();
   initialized_ = false;
+  timeline_.Shutdown();
   // reset engine-thread state for a potential re-init (elastic restart)
   pending_.clear();
   counts_.clear();
@@ -214,6 +222,8 @@ void Engine::ThreadLoop() {
 }
 
 bool Engine::RunCycle() {
+  if (timeline_.active() && timeline_.mark_cycles())
+    timeline_.CycleMark();
   // 1. drain submissions
   {
     std::lock_guard<std::mutex> lk(queue_mu_);
@@ -332,7 +342,16 @@ bool Engine::RunCycle() {
   }
 
   // 5. execute
-  for (auto& resp : responses) ExecuteResponse(resp, pending_);
+  for (auto& resp : responses) {
+    bool trace = timeline_.active()
+        && resp.kind == Response::Kind::TENSOR;
+    if (trace)
+      for (auto& n : resp.names)
+        timeline_.ExecuteStart(n, OpName(resp.op));
+    ExecuteResponse(resp, pending_);
+    if (trace)
+      for (auto& n : resp.names) timeline_.ExecuteEnd(n);
+  }
 
   // feed the autotuner with this cycle's throughput (rank 0 tunes;
   // reference operations.cc:610-642 feeds the ParameterManager the same
@@ -385,6 +404,11 @@ std::vector<Response> Engine::Coordinate(
       tc.seen[r] = true;
       tc.requests.push_back(q);
       if (tc.first_seen_sec == 0) tc.first_seen_sec = now;
+      if (timeline_.active()) {
+        if (tc.count == 0)
+          timeline_.NegotiateStart(q.name, OpName(q.op));
+        timeline_.NegotiateRankReady(q.name, r);
+      }
       tc.count++;
     }
   }
@@ -445,6 +469,7 @@ std::vector<Response> Engine::Coordinate(
   }
   for (auto& name : complete) {
     auto& tc = counts_[name];
+    if (timeline_.active()) timeline_.NegotiateEnd(name);
     out.push_back(BuildResponse(tc.requests));
     counts_.erase(name);
   }

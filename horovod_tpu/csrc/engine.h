@@ -38,6 +38,7 @@
 #include "common.h"
 #include "net.h"
 #include "ring_ops.h"
+#include "timeline.h"
 #include "wire.h"
 
 namespace hvt {
@@ -136,6 +137,7 @@ class Engine {
   std::map<std::string, bool> stall_warned_;
   ParameterManager autotune_;     // rank 0 tunes; workers receive cycle_ms
   int64_t cycle_bytes_ = 0;       // payload bytes executed this cycle
+  EngineTimeline timeline_;       // rank-0 chrome trace (HVT_TIMELINE)
 
   std::vector<uint8_t> fusion_buffer_;
 };

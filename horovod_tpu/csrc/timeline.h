@@ -1,0 +1,178 @@
+// Engine-side Chrome-trace timeline — counterpart of the reference's
+// C++ Timeline (horovod/common/timeline.{h,cc}): every tensor's
+// lifecycle is recorded as chrome://tracing events (NEGOTIATE_<OP> with
+// per-rank ready instants, then the execute phase), produced by the
+// engine thread and drained to disk by a dedicated writer thread so the
+// cycle loop never blocks on file I/O (the reference uses a lock-free
+// SPSC queue, timeline.h:84-86; a mutexed deque swapped wholesale by the
+// writer gives the same non-blocking property at engine-cycle rates).
+//
+// Like the reference (operations.cc:422-425), only the coordinator
+// (rank 0) writes a file; enabled via HVT_TIMELINE=<path>, optional
+// cycle markers via HVT_TIMELINE_MARK_CYCLES=1.
+#pragma once
+
+#include <chrono>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvt {
+
+class EngineTimeline {
+ public:
+  void Initialize(const std::string& path, bool mark_cycles) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (file_) return;
+    file_ = fopen(path.c_str(), "w");
+    if (!file_) return;
+    fputs("[\n", file_);
+    // full reset: re-entered on elastic shutdown/re-init, and the new
+    // trace file must not inherit lanes or the written-something flag
+    first_ = true;
+    lanes_.clear();
+    lane_names_.clear();
+    queue_.clear();
+    next_lane_ = 0;
+    mark_cycles_ = mark_cycles;
+    start_us_ = NowUs();
+    stop_ = false;
+    writer_ = std::thread([this] { WriterLoop(); });
+  }
+
+  bool active() const { return file_ != nullptr; }
+  bool mark_cycles() const { return mark_cycles_; }
+
+  void NegotiateStart(const std::string& tensor, const std::string& op) {
+    Emit(tensor, "B", "NEGOTIATE_" + op);
+  }
+  void NegotiateRankReady(const std::string& tensor, int rank) {
+    Emit(tensor, "i", "RANK_READY_" + std::to_string(rank));
+  }
+  void NegotiateEnd(const std::string& tensor) { Emit(tensor, "E", ""); }
+  void ExecuteStart(const std::string& tensor, const std::string& op) {
+    Emit(tensor, "B", op);
+  }
+  void ExecuteEnd(const std::string& tensor) { Emit(tensor, "E", ""); }
+  void CycleMark() { Emit("CYCLE", "i", "CYCLE_START"); }
+
+  void Shutdown() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!file_) return;
+      stop_ = true;
+    }
+    if (writer_.joinable()) writer_.join();
+    Drain();
+    fputs("\n]\n", file_);
+    fclose(file_);
+    file_ = nullptr;
+  }
+
+ private:
+  struct Event {
+    int64_t ts_us;
+    int lane;
+    char phase;         // B / E / i
+    std::string name;
+  };
+
+  static std::string JsonEscape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      if (c == '"' || c == '\\') {
+        out += '\\';
+        out += c;
+      } else if (static_cast<unsigned char>(c) < 0x20) {
+        char buf[8];
+        snprintf(buf, sizeof(buf), "\\u%04x", c);
+        out += buf;
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+
+  static int64_t NowUs() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::steady_clock::now().time_since_epoch())
+        .count();
+  }
+
+  void Emit(const std::string& tensor, const char* phase,
+            const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!file_) return;
+    auto it = lanes_.find(tensor);
+    int lane;
+    if (it == lanes_.end()) {
+      lane = next_lane_++;
+      lanes_[tensor] = lane;
+      lane_names_.push_back({lane, tensor});
+    } else {
+      lane = it->second;
+    }
+    queue_.push_back(Event{NowUs() - start_us_, lane, phase[0], name});
+  }
+
+  void WriterLoop() {
+    while (true) {
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (stop_) return;
+      }
+      Drain();
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+  }
+
+  void Drain() {
+    std::deque<Event> local;
+    std::deque<std::pair<int, std::string>> names;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      local.swap(queue_);
+      names.swap(lane_names_);
+    }
+    for (auto& [lane, tensor] : names) {
+      fprintf(file_,
+              "%s{\"name\": \"thread_name\", \"ph\": \"M\", \"pid\": 0, "
+              "\"tid\": %d, \"args\": {\"name\": \"%s\"}}",
+              first_ ? "" : ",\n", lane, JsonEscape(tensor).c_str());
+      first_ = false;
+    }
+    for (auto& e : local) {
+      std::string esc = e.name.empty() ? "" : JsonEscape(e.name);
+      fprintf(file_,
+              "%s{\"ph\": \"%c\", \"pid\": 0, \"tid\": %d, "
+              "\"ts\": %lld%s%s%s%s}",
+              first_ ? "" : ",\n", e.phase, e.lane,
+              static_cast<long long>(e.ts_us),
+              e.name.empty() ? "" : ", \"name\": \"",
+              esc.c_str(),
+              e.name.empty() ? "" : "\"",
+              e.phase == 'i' ? ", \"s\": \"t\"" : "");
+      first_ = false;
+    }
+    fflush(file_);
+  }
+
+  std::mutex mu_;
+  FILE* file_ = nullptr;
+  bool mark_cycles_ = false;
+  bool stop_ = false;
+  bool first_ = true;
+  int64_t start_us_ = 0;
+  int next_lane_ = 0;
+  std::unordered_map<std::string, int> lanes_;
+  std::deque<std::pair<int, std::string>> lane_names_;
+  std::deque<Event> queue_;
+  std::thread writer_;
+};
+
+}  // namespace hvt

@@ -1,0 +1,119 @@
+"""Mixture-of-Experts layer with expert parallelism (EP).
+
+The reference is data-parallel only; its alltoall primitive
+(``operations.cc:1099``) is "the usual EP building block" (SURVEY.md
+§2.6). TPU-natively, EP needs no hand-written alltoall: experts are
+sharded over the ``ep`` mesh axis and tokens over ``dp``; the
+dispatch/combine einsums below contract across those axes, so XLA inserts
+the all-to-alls on ICI and fuses them with the expert matmuls — the
+Mesh-TensorFlow / GShard dense-dispatch formulation, which is the
+MXU-friendly way to write MoE (einsums, static shapes, no gather loops).
+
+Components:
+- ``Router``: top-1 softmax gating with capacity and an auxiliary
+  load-balancing loss (GShard eq. (4): E * Σ_e mean(gates_e)·mean(mask_e)).
+- ``MoEMlp``: expert-parallel FFN; expert weights [n_experts, ...] carry
+  ``P("ep", ...)`` in ``param_partition_spec``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class Router(nn.Module):
+    """Top-1 router with capacity (tokens per expert per batch row)."""
+
+    n_experts: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [batch, seq, d_model] → gates [batch, seq, n_experts]
+        logits = nn.Dense(self.n_experts, use_bias=False,
+                          dtype=jnp.float32, name="router")(
+                              x.astype(jnp.float32))
+        gates = jax.nn.softmax(logits, axis=-1)
+        expert_idx = jnp.argmax(gates, axis=-1)            # [b, s]
+        mask = jax.nn.one_hot(expert_idx, self.n_experts,
+                              dtype=jnp.float32)           # [b, s, e]
+
+        # auxiliary load-balance loss (GShard): encourages uniform routing
+        density = mask.mean(axis=1)                        # [b, e]
+        density_proxy = gates.mean(axis=1)                 # [b, e]
+        aux_loss = (density * density_proxy).sum(-1).mean() \
+            * self.n_experts
+
+        seq = x.shape[1]
+        capacity = int(self.capacity_factor * seq / self.n_experts) or 1
+
+        # position of each token within its expert's queue
+        pos_in_expert = (jnp.cumsum(mask, axis=1) - 1.0) * mask  # [b,s,e]
+        keep = (pos_in_expert < capacity).astype(jnp.float32) * mask
+        pos = jnp.einsum("bse,bse->bs", pos_in_expert, keep)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32)         # [b, s, c]
+        # dispatch [b, s, e, c]: token (b,s) → slot (e,c)
+        dispatch = jnp.einsum("bse,bsc->bsec", keep, pos_oh)
+        gate_val = jnp.einsum("bse,bse->bs", gates.astype(jnp.float32),
+                              keep)
+        combine = dispatch * gate_val[..., None, None]
+        return dispatch, combine, aux_loss
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel FFN block: route → all-to-all → expert matmuls
+    (MXU, batched over the local experts) → all-to-all back → combine."""
+
+    n_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        dispatch, combine, aux_loss = Router(
+            self.n_experts, self.capacity_factor, name="router_block")(x)
+
+        # [e, b, c, d]: with x sharded over dp and wi/wo over ep, XLA
+        # lowers this contraction to an all-to-all over ICI
+        expert_in = jnp.einsum("bsec,bsd->ebcd",
+                               dispatch.astype(self.dtype),
+                               x.astype(self.dtype))
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (self.n_experts, d, self.d_ff))
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (self.n_experts, self.d_ff, d))
+        h = jnp.einsum("ebcd,edf->ebcf", expert_in,
+                       wi.astype(self.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ebcf,efd->ebcd", h,
+                                wo.astype(self.dtype))
+        out = jnp.einsum("bsec,ebcd->bsd",
+                         combine.astype(self.dtype), expert_out)
+        self.sow("intermediates", "aux_loss", aux_loss)
+        return out.astype(x.dtype), aux_loss
+
+
+def moe_param_partition_spec(params, ep_axis: str = "ep",
+                             tp_axis: Optional[str] = None):
+    """PartitionSpecs for an MoE param tree: expert-stacked weights
+    ([n_experts, ...]) shard over ``ep_axis`` (dim 0); everything else
+    replicated (compose with the dense model's tp spec separately)."""
+
+    def spec(path, leaf):
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        if "wi" in keys and leaf.ndim == 3:
+            return P(ep_axis, None, tp_axis)
+        if "wo" in keys and leaf.ndim == 3:
+            return P(ep_axis, tp_axis, None)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)

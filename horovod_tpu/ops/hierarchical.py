@@ -1,0 +1,85 @@
+"""Hierarchical allreduce for the compiled path — the TPU-native analog
+of NCCLHierarchicalAllreduce (reference ``ops/nccl_operations.cc:188-350``:
+intra-node ncclReduceScatter → parallel cross-node MPI_Allreduce on one
+slice per local rank → intra-node ncclAllgather).
+
+On a ``(hvt_cross, hvt_local)`` mesh the same decomposition is::
+
+    psum_scatter over LOCAL (ICI)   — each local rank owns 1/L of the data
+    psum        over CROSS (DCN)    — L parallel cross-host reductions
+    all_gather  over LOCAL (ICI)
+
+which is bandwidth-optimal when DCN is the bottleneck: each host moves
+N/L bytes over DCN instead of N. Non-divisible sizes are zero-padded and
+unpadded (the reference's remainder path, ``nccl_operations.cc:249-315``,
+handles the tail with a root reduce/bcast; padding achieves the same
+semantics in one compiled program with static shapes).
+
+Use inside ``shard_map``/``pmap`` over :func:`parallel.mesh.hierarchical_mesh`
+(or any mesh exposing both axes)::
+
+    grads = hierarchical_allreduce(grads, average=True)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.parallel.mesh import CROSS_AXIS, LOCAL_AXIS
+
+
+def hierarchical_allreduce(x, local_axis: str = LOCAL_AXIS,
+                           cross_axis: str = CROSS_AXIS,
+                           average: bool = False):
+    """Allreduce ``x`` over local_axis × cross_axis via RS → AR → AG.
+
+    Accepts a single array or a pytree. Semantically identical to
+    ``psum(x, (local_axis, cross_axis))`` (divided by world size when
+    ``average``); the decomposition is what changes — the bulk reduction
+    rides the fast local axis, and only 1/local_size of the bytes cross
+    the slow axis.
+    """
+
+    def _one(t):
+        t = jnp.asarray(t)
+        shape = t.shape
+        L = jax.lax.axis_size(local_axis)
+        flat = t.reshape(-1)
+        n = flat.shape[0]
+        pad = (-n) % L
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        # ICI: reduce-scatter — my 1/L slice of the local sum
+        piece = jax.lax.psum_scatter(flat, local_axis, tiled=True)
+        # DCN: cross-host allreduce of just that slice
+        piece = jax.lax.psum(piece, cross_axis)
+        # ICI: allgather the reduced slices back to full size
+        full = jax.lax.all_gather(piece, local_axis, tiled=True)
+        if pad:
+            full = full[:n]
+        if average:
+            C = jax.lax.axis_size(cross_axis)
+            full = full / (L * C)
+        return full.reshape(shape)
+
+    return jax.tree.map(_one, x)
+
+
+def hierarchical_allgather(x, local_axis: str = LOCAL_AXIS,
+                           cross_axis: str = CROSS_AXIS):
+    """Hierarchical allgather (reference MPIHierarchicalAllgather
+    lineage, ``ops/mpi_operations.cc``): gather across hosts first (one
+    transfer of the local shard per host over DCN), then within the host
+    over ICI. Concatenates along dim 0 in (cross, local) rank order."""
+
+    def _one(t):
+        t = jnp.asarray(t)
+        over_cross = jax.lax.all_gather(t, cross_axis)    # [C, ...]
+        over_both = jax.lax.all_gather(over_cross, local_axis)  # [L,C,...]
+        # reorder to global rank order: rank = cross * L + local
+        out = jnp.swapaxes(over_both, 0, 1)               # [C, L, ...]
+        return out.reshape((-1,) + t.shape[1:]) if t.ndim >= 1 else out
+
+    return jax.tree.map(_one, x)

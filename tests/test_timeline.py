@@ -43,3 +43,45 @@ def test_timeline_start_stop_idempotent(tmp_path):
     hvt.stop_timeline()
     hvt.stop_timeline()
     assert not timeline.active()
+
+
+def test_engine_timeline_chrome_trace(tmp_path):
+    """2-process engine job with HVT_TIMELINE: the coordinator writes a
+    valid chrome trace containing the per-tensor NEGOTIATE and execute
+    lifecycle (reference test/parallel/test_timeline.py)."""
+    import os
+
+    import pytest
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lib = os.path.join(REPO, "horovod_tpu", "csrc", "build",
+                       "libhvt_core.so")
+    if not os.path.exists(lib):
+        pytest.skip("C++ engine not built")
+    from tests.test_engine_integration import run_workers
+
+    tl_path = str(tmp_path / "engine_timeline.json")
+    run_workers("""
+        for i in range(3):
+            x = np.full((4,), float(r + 1), np.float32)
+            res = np.asarray(hvt.allreduce(x, name=f"t{i}", average=True))
+            np.testing.assert_allclose(res, (1 + n) / 2.0)
+    """, extra_env={"HVT_TIMELINE": tl_path,
+                    "HVT_TIMELINE_MARK_CYCLES": "1"})
+    with open(tl_path) as f:
+        events = json.load(f)
+    assert events, "engine timeline is empty"
+    lane_names = {e.get("args", {}).get("name") for e in events
+                  if e.get("ph") == "M"}
+    assert {"t0", "t1", "t2"} <= lane_names
+    assert any(e.get("name") == "NEGOTIATE_ALLREDUCE" for e in events)
+    assert any(e.get("name") == "ALLREDUCE" for e in events)
+    assert any(e.get("name", "").startswith("RANK_READY_")
+               for e in events)
+    assert any(e.get("name") == "CYCLE_START" for e in events)
+    for tid in {e["tid"] for e in events if e.get("ph") in "BE"}:
+        b = sum(1 for e in events if e.get("tid") == tid
+                and e["ph"] == "B")
+        e_ = sum(1 for e in events if e.get("tid") == tid
+                 and e["ph"] == "E")
+        assert b == e_, f"unbalanced B/E in lane {tid}"
