@@ -27,11 +27,12 @@ from jax.sharding import PartitionSpec as P
 
 
 class Router(nn.Module):
-    """Top-1 router with capacity (tokens per expert per batch row)."""
+    """Top-1 router with capacity (tokens per expert per batch row).
+    Routing math is always float32 — the standard numerically-safe
+    choice regardless of the expert compute dtype."""
 
     n_experts: int
     capacity_factor: float = 1.25
-    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -109,10 +110,10 @@ def moe_param_partition_spec(params, ep_axis: str = "ep",
     replicated (compose with the dense model's tp spec separately)."""
 
     def spec(path, leaf):
-        keys = "/".join(str(getattr(p, "key", p)) for p in path)
-        if "wi" in keys and leaf.ndim == 3:
+        last = str(getattr(path[-1], "key", path[-1])) if path else ""
+        if last == "wi" and leaf.ndim == 3:
             return P(ep_axis, None, tp_axis)
-        if "wo" in keys and leaf.ndim == 3:
+        if last == "wo" and leaf.ndim == 3:
             return P(ep_axis, tp_axis, None)
         return P()
 

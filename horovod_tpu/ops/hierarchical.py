@@ -80,6 +80,6 @@ def hierarchical_allgather(x, local_axis: str = LOCAL_AXIS,
         over_both = jax.lax.all_gather(over_cross, local_axis)  # [L,C,...]
         # reorder to global rank order: rank = cross * L + local
         out = jnp.swapaxes(over_both, 0, 1)               # [C, L, ...]
-        return out.reshape((-1,) + t.shape[1:]) if t.ndim >= 1 else out
+        return out.reshape((-1,) + t.shape[1:])
 
     return jax.tree.map(_one, x)

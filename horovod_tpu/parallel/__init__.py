@@ -7,6 +7,20 @@ from horovod_tpu.parallel.mesh import (
     LOCAL_AXIS,
     CROSS_AXIS,
 )
+from horovod_tpu.parallel.fsdp import (
+    FSDP_AXIS,
+    fsdp_partition_spec,
+    init_sharded_state,
+    shard_pytree,
+)
+from horovod_tpu.parallel.pipeline import (
+    PIPELINE_AXIS,
+    merge_microbatches,
+    pipeline,
+    pipeline_apply,
+    split_microbatches,
+    stage_partition_spec,
+)
 
 __all__ = [
     "build_global_mesh",
@@ -16,4 +30,14 @@ __all__ = [
     "WORLD_AXIS",
     "LOCAL_AXIS",
     "CROSS_AXIS",
+    "FSDP_AXIS",
+    "fsdp_partition_spec",
+    "init_sharded_state",
+    "shard_pytree",
+    "PIPELINE_AXIS",
+    "merge_microbatches",
+    "pipeline",
+    "pipeline_apply",
+    "split_microbatches",
+    "stage_partition_spec",
 ]
