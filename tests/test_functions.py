@@ -56,3 +56,27 @@ def test_compressor_skips_ints():
     c, ctx = Compression.fp16.compress(x)
     assert c.dtype == x.dtype and ctx is None
     assert Compression.none.compress(x)[0] is x
+
+
+def test_sparse_allreduce_single_process():
+    """Sparse path semantics (reference IndexedSlices → allgather,
+    tensorflow/__init__.py:92-108): duplicate indices accumulate on
+    apply; averaging divides by world size."""
+    from horovod_tpu.ops.sparse import (apply_sparse, sparse_allreduce,
+                                        sparse_allreduce_apply)
+
+    idx = np.array([0, 2, 2], np.int32)
+    vals = np.array([[1.0, 1.0], [2.0, 2.0], [3.0, 3.0]], np.float32)
+    gi, gv = sparse_allreduce(idx, vals, average=True, name="sp")
+    # size 1: identity exchange
+    np.testing.assert_array_equal(np.asarray(gi), idx)
+    np.testing.assert_allclose(np.asarray(gv), vals)
+
+    dense = np.zeros((4, 2), np.float32)
+    out = apply_sparse(dense, gi, gv)
+    np.testing.assert_allclose(np.asarray(out)[0], [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(out)[2], [5.0, 5.0])  # 2+3
+    np.testing.assert_allclose(np.asarray(out)[1], 0.0)
+
+    out2 = sparse_allreduce_apply(dense, idx, vals, name="sp2")
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(out))
