@@ -1,5 +1,7 @@
 #include "engine.h"
 
+#include "logging.h"
+
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -109,6 +111,10 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
                          EnvInt("HVT_TIMELINE_MARK_CYCLES", 0) != 0);
   initialized_ = true;
   thread_ = std::thread([this] { ThreadLoop(); });
+  HVT_LOG(INFO, rank_) << "engine up: size " << size_ << ", cycle "
+                       << cycle_ms_ << " ms, fusion "
+                       << (fusion_threshold_ >> 20) << " MB"
+                       << (autotune_.active() ? ", autotune on" : "");
   return Status::OK();
 }
 
@@ -360,6 +366,9 @@ bool Engine::RunCycle() {
       autotune_.Record(cycle_bytes_)) {
     fusion_threshold_ = autotune_.fusion_threshold();
     cycle_ms_ = autotune_.cycle_ms();
+    HVT_LOG(DEBUG, rank_) << "autotune sample " << autotune_.samples()
+                          << ": fusion " << (fusion_threshold_ >> 20)
+                          << " MB, cycle " << cycle_ms_ << " ms";
   }
   cycle_bytes_ = 0;
 
@@ -606,12 +615,11 @@ void Engine::CheckStalls() {
       std::ostringstream missing;
       for (int r = 0; r < size_; ++r)
         if (!tc.seen[r] && !rank_joined_[r]) missing << r << " ";
-      fprintf(stderr,
-              "[hvt] WARNING: tensor '%s' was submitted by some ranks but "
-              "not by ranks [ %s] for %.0f s — possible stall (reference "
-              "stall_inspector semantics)\n",
-              name.c_str(), missing.str().c_str(),
-              now - tc.first_seen_sec);
+      HVT_LOG(WARNING, rank_)
+          << "tensor '" << name << "' was submitted by some ranks but "
+          << "not by ranks [ " << missing.str() << "] for "
+          << static_cast<long>(now - tc.first_seen_sec)
+          << " s — possible stall (reference stall_inspector semantics)";
       stall_warned_[name] = true;
     }
   }

@@ -1,0 +1,78 @@
+// Leveled stream logger — counterpart of the reference's
+// common/logging.{h,cc}: HVT_LOG(INFO) << "...", filtered by
+// HVT_LOG_LEVEL (trace|debug|info|warning|error|fatal|none, default
+// warning) with optional timestamps (HVT_LOG_HIDE_TIME=1 disables),
+// mirroring the HOROVOD_LOG_LEVEL / timestamp knobs surfaced by the
+// launcher (reference launch.py:455-463).
+#pragma once
+
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <sstream>
+#include <string>
+
+#include "common.h"  // EnvInt
+
+namespace hvt {
+
+enum class LogLevel : int {
+  TRACE = 0,
+  DEBUG = 1,
+  INFO = 2,
+  WARNING = 3,
+  ERROR = 4,
+  FATAL = 5,
+  NONE = 6,
+};
+
+inline LogLevel MinLogLevel() {
+  static LogLevel cached = [] {
+    const char* v = getenv("HVT_LOG_LEVEL");
+    if (!v) return LogLevel::WARNING;
+    std::string s(v);
+    for (auto& c : s) c = tolower(c);
+    if (s == "trace") return LogLevel::TRACE;
+    if (s == "debug") return LogLevel::DEBUG;
+    if (s == "info") return LogLevel::INFO;
+    if (s == "warning" || s == "warn") return LogLevel::WARNING;
+    if (s == "error") return LogLevel::ERROR;
+    if (s == "fatal") return LogLevel::FATAL;
+    if (s == "none" || s == "off") return LogLevel::NONE;
+    return LogLevel::WARNING;
+  }();
+  return cached;
+}
+
+class LogMessage : public std::ostringstream {
+ public:
+  LogMessage(LogLevel level, int rank) : level_(level), rank_(rank) {}
+  ~LogMessage() override {
+    static const char* names[] = {"TRACE", "DEBUG", "INFO", "WARNING",
+                                  "ERROR", "FATAL"};
+    char ts[32] = "";
+    if (EnvInt("HVT_LOG_HIDE_TIME", 0) == 0) {
+      time_t t = time(nullptr);
+      struct tm tmv;
+      localtime_r(&t, &tmv);
+      strftime(ts, sizeof(ts), "%H:%M:%S ", &tmv);
+    }
+    fprintf(stderr, "[%s%s hvt:%d] %s\n", ts,
+            names[static_cast<int>(level_)], rank_, str().c_str());
+    if (level_ == LogLevel::FATAL) abort();
+  }
+
+ private:
+  LogLevel level_;
+  int rank_;
+};
+
+// usage: HVT_LOG(INFO, rank) << "engine up, size " << size;
+// The if/else pair keeps the macro dangling-else-safe inside an
+// unbraced outer if/else.
+#define HVT_LOG(level, rank)                             \
+  if (::hvt::LogLevel::level < ::hvt::MinLogLevel()) {   \
+  } else                                                 \
+    ::hvt::LogMessage(::hvt::LogLevel::level, (rank))
+
+}  // namespace hvt

@@ -36,10 +36,15 @@ def sparse_allreduce(indices, values, average: bool = True, name=None,
                              else f"{name}.values",
                              process_set=process_set)
     if average:
-        # divide by the number of participants that actually contributed
-        # — derived from the gather width so the eager (per-process) and
-        # traced (per-device) paths both average correctly
-        n = all_values.shape[0] // max(jnp.shape(values)[0], 1)
+        # divisor = number of participants. Ranks may contribute UNEQUAL
+        # row counts (allgatherv), so the gather width of the payload
+        # says nothing about the world size; gather a one-row marker per
+        # rank instead — its width IS the participant count on both the
+        # eager (per-process) and traced (per-device) paths.
+        marker = jnp.ones((1,), jnp.int32)
+        n = int(C.allgather(marker, name=None if name is None
+                            else f"{name}.nparts",
+                            process_set=process_set).shape[0])
         all_values = all_values / max(n, 1)
     return all_indices, all_values
 

@@ -178,3 +178,53 @@ def test_worker_crash_fails_job():
             raise SystemExit(1)
     """, expect_rc=1, timeout=60)
     assert "GOT-ERROR" in out or "ranks failed" in out
+
+
+def test_allreduce_dtype_matrix_2proc():
+    """Every wire dtype allreduces correctly (the reference sweeps dtypes
+    across its parallel suites, e.g. test_torch.py/test_tensorflow.py)."""
+    out = run_workers("""
+        import ml_dtypes
+        cases = [
+            ("float32", np.float32, 1e-6),
+            ("float64", np.float64, 1e-12),
+            ("float16", np.float16, 1e-2),
+            ("bfloat16", ml_dtypes.bfloat16, 1e-1),
+            ("int32", np.int32, 0),
+            ("int64", np.int64, 0),
+            ("uint8", np.uint8, 0),
+        ]
+        for dname, dt, tol in cases:
+            x = (np.arange(8) % 4 + r + 1).astype(dt)
+            res = np.asarray(hvt.allreduce(x, name=f"dt.{dname}",
+                                           average=False))
+            expect = sum((np.arange(8) % 4 + rr + 1).astype(np.float64)
+                         for rr in range(n))
+            np.testing.assert_allclose(
+                np.asarray(res, np.float64), expect, atol=float(tol),
+                err_msg=dname)
+            assert res.dtype == np.dtype(dt), (dname, res.dtype)
+        print(f"DTYPES-OK-{r}", flush=True)
+    """)
+    assert "DTYPES-OK-0" in out and "DTYPES-OK-1" in out
+
+
+def test_sparse_allreduce_unequal_nnz_2proc():
+    """Regression: average must divide by world size on every rank even
+    when ranks contribute different row counts (allgatherv)."""
+    out = run_workers("""
+        from horovod_tpu.ops.sparse import sparse_allreduce
+        if r == 0:
+            idx = np.array([0], np.int32)
+            vals = np.full((1, 2), 10.0, np.float32)
+        else:
+            idx = np.array([1, 2, 3], np.int32)
+            vals = np.full((3, 2), 20.0, np.float32)
+        gi, gv = sparse_allreduce(idx, vals, average=True, name="uneq")
+        gi, gv = np.asarray(gi), np.asarray(gv)
+        assert gi.shape[0] == 4
+        np.testing.assert_allclose(gv[gi == 0], 5.0)
+        np.testing.assert_allclose(gv[gi == 2], 10.0)
+        print(f"UNEQ-OK-{r}", flush=True)
+    """)
+    assert "UNEQ-OK-0" in out and "UNEQ-OK-1" in out
