@@ -35,9 +35,13 @@ def run(func):
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        from horovod_tpu.elastic import preemption
         from horovod_tpu.runner.elastic import notification
 
         notification.init_worker_notification(state)
+        # TPU preemption notices (SIGTERM / maintenance events) surface as
+        # HostsUpdatedInterrupt at the next commit (SURVEY §5.3)
+        preemption.watch_state(state)
         round_ = _sync_slot_from_rendezvous(0)
         reset_required = False
         skip_sync = False

@@ -158,6 +158,12 @@ class ElasticDriver:
         rendezvous the same way, ``registration.py:28``). Reports carry
         the worker's round; stale-round reports are dropped so a slow
         READY can't leak into the next round's barrier."""
+        if scope == "preempt":
+            # a worker received a preemption notice (SIGTERM/maintenance
+            # event); broadcast a host-update so every worker reaches its
+            # commit point and re-rendezvous before the chips vanish
+            self._notify_workers_host_changes()
+            return
         if scope != "state":
             return
         try:
