@@ -165,9 +165,11 @@ def _apply_slot_env(info: dict, world: dict):
             env["HVT_MASTER_ADDR"] = "127.0.0.1"
         else:
             env["HVT_MASTER_ADDR"] = master_host
-        # rotate the engine control port per round so a lingering listener
-        # from the previous round can't collide with the new master
+        # per-round engine control port: prefer the launcher-published
+        # free-probed port (world info), falling back to a wide rotation
+        # so a lingering listener from an old round can't collide
         base = int(env.get("HVT_MASTER_PORT_BASE",
                            env.get("HVT_MASTER_PORT", "29510")))
         env.setdefault("HVT_MASTER_PORT_BASE", str(base))
-        env["HVT_MASTER_PORT"] = str(base + world["round"] % 64)
+        env["HVT_MASTER_PORT"] = str(
+            world.get("master_port") or base + world["round"] % 2048)

@@ -75,11 +75,27 @@ def DistributedOptimizer(*args, **kwargs):
         "horovod_tpu.jax.DistributedOptimizer for TPU training")
 
 
-def broadcast_global_variables(root_rank=0):
-    """Broadcast all Keras backend variables (reference
-    ``keras/__init__.py:92``)."""
+def broadcast_global_variables(root_rank=0, model=None, variables=None):
+    """Broadcast Keras variables from ``root_rank`` (reference
+    ``keras/__init__.py:92``).
+
+    Keras 3 (the default for TF >= 2.16) removed the private backend
+    variable registry the reference relied on, so prefer passing
+    ``model`` (its ``weights`` are broadcast) or an explicit
+    ``variables`` list; the legacy registry is only used as a fallback
+    when it exists."""
     _require_keras()
     from horovod_tpu import tensorflow as hvt_tf
 
-    hvt_tf.broadcast_variables(
-        _keras.backend._get_variables(None), root_rank)
+    if variables is None:
+        if model is not None:
+            variables = model.weights
+        elif hasattr(_keras.backend, "_get_variables"):
+            variables = _keras.backend._get_variables(None)
+        else:
+            raise ValueError(
+                "broadcast_global_variables on Keras 3 needs an explicit "
+                "model= or variables= argument (the tf.keras global "
+                "variable registry no longer exists); e.g. "
+                "broadcast_global_variables(0, model=my_model)")
+    hvt_tf.broadcast_variables(variables, root_rank)

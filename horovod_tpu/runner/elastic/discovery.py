@@ -28,8 +28,10 @@ class HostDiscovery:
 class HostDiscoveryScript(HostDiscovery):
     """Runs a user-provided executable that prints one host per line,
     either ``hostname:slots`` or bare ``hostname`` (then ``default_slots``
-    applies). Non-zero exit or unparsable output yields no hosts for that
-    poll — the HostManager keeps the previous view until the next success.
+    applies). A failing or timed-out script RAISES — callers that poll
+    (the driver's discovery thread) catch and keep the previous view, so
+    a transient discovery blip never reads as "all hosts gone" (reference
+    ``driver.py`` ``_discover_hosts`` retains state on a failed poll).
     """
 
     def __init__(self, script: str, default_slots: int = 1,
@@ -39,12 +41,9 @@ class HostDiscoveryScript(HostDiscovery):
         self._timeout = timeout
 
     def find_available_hosts_and_slots(self) -> Dict[str, int]:
-        try:
-            out = subprocess.run(
-                self._script, shell=True, capture_output=True,
-                timeout=self._timeout, check=True).stdout.decode()
-        except (subprocess.CalledProcessError, subprocess.TimeoutExpired):
-            return {}
+        out = subprocess.run(
+            self._script, shell=True, capture_output=True,
+            timeout=self._timeout, check=True).stdout.decode()
         hosts: Dict[str, int] = {}
         for line in out.splitlines():
             line = line.strip()

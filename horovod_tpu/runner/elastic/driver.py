@@ -72,7 +72,12 @@ class ElasticDriver:
         discovery poll. ``np`` is the preferred initial world size."""
         if create_worker_fn is not None:
             self._create_worker_fn = create_worker_fn
-        self._host_manager.update_available_hosts()
+        try:
+            self._host_manager.update_available_hosts()
+        except Exception:
+            # transient discovery failure at startup: wait_for_available
+            # _slots below keeps polling until the deadline
+            pass
         self.wait_for_available_slots(self._settings.min_np)
         self._activate_round(np)
         self._discovery_thread.start()
@@ -144,7 +149,10 @@ class ElasticDriver:
                     f"timed out waiting for {min_np} slots; discovered "
                     f"{hosts.count_available_slots()} "
                     f"({hosts.host_slots})")
-            self._host_manager.update_available_hosts()
+            try:
+                self._host_manager.update_available_hosts()
+            except Exception:
+                pass  # keep previous view; retry next interval
             time.sleep(self._settings.discovery_interval)
 
     # -------------------------------------------------- worker-facing hooks

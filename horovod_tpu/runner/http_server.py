@@ -60,6 +60,10 @@ class RendezvousServer:
         self._verbose = verbose
         self._round = 0
         self._on_put = on_put
+        # optional fn(slots, round) -> int: the engine control-star port
+        # for this round, published in world info so every worker (fresh
+        # spawn or survivor re-syncing) agrees on it
+        self.master_port_fn = None
 
     def set_put_hook(self, fn):
         """``fn(scope, key, value_bytes)`` called on every /kv PUT — the
@@ -87,6 +91,9 @@ class RendezvousServer:
                        "hosts": sorted({s.hostname for s in slots}),
                        "master_host": slots[0].hostname if slots else None,
                        "round": self._round}
+        if self.master_port_fn is not None and slots:
+            self._world["master_port"] = int(
+                self.master_port_fn(slots, self._round))
 
     @property
     def round(self):

@@ -180,6 +180,21 @@ def _run_elastic(args) -> int:
         elastic_timeout=args.elastic_timeout,
         reset_limit=args.reset_limit, verbose=args.verbose)
     rendezvous = RendezvousServer(verbose=args.verbose)
+
+    def choose_master_port(slots, round_):
+        # Engine control-star port for this round, published via world
+        # info. When the master slot is on this host, probe a genuinely
+        # free port (a fixed rotation window wraps after enough rounds
+        # and can collide with a lingering listener from an old round —
+        # ADVICE r1); for a remote master fall back to a wide rotation
+        # off the configured base.
+        if _is_local(slots[0].hostname):
+            with socket.socket() as s:
+                s.bind(("", 0))
+                return s.getsockname()[1]
+        return args.master_port + round_ % 2048
+
+    rendezvous.master_port_fn = choose_master_port
     rendezvous_port = rendezvous.start()
 
     def driver_addr_for(slot_hostname):
@@ -205,7 +220,8 @@ def _run_elastic(args) -> int:
         # elastic/run.py _apply_slot_env)
         env["HVT_MASTER_PORT_BASE"] = str(args.master_port)
         env["HVT_MASTER_PORT"] = str(
-            args.master_port + rendezvous.round % 64)
+            (rendezvous.world or {}).get("master_port")
+            or args.master_port + rendezvous.round % 2048)
         if _is_local(slot.hostname):
             cmd = list(args.command)
         else:

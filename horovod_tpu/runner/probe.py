@@ -27,7 +27,12 @@ class _SignedHandler(BaseHTTPRequestHandler):
     def _read_signed(self) -> Optional[dict]:
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n)
-        digest = bytes.fromhex(self.headers.get("X-HVT-Digest", ""))
+        try:
+            digest = bytes.fromhex(self.headers.get("X-HVT-Digest", ""))
+        except ValueError:
+            # malformed (non-hex / odd-length) digest header is a failed
+            # authentication, not a server error
+            digest = b""
         if not secret.check_digest(self.key, body, digest):
             self.send_response(403)
             self.end_headers()

@@ -63,12 +63,29 @@ def test_discovery_script_parses_host_slots(tmp_path):
     assert d.find_available_hosts_and_slots() == {"host-1": 2, "host-2": 4}
 
 
-def test_discovery_script_failure_yields_empty(tmp_path):
+def test_discovery_script_failure_raises_and_keeps_previous_view(tmp_path):
+    # A failing script must raise (not return {}), and a HostManager poll
+    # over it must keep the previous host view — a transient discovery
+    # blip is not "all hosts gone" (ADVICE r1; reference driver.py
+    # _discover_hosts semantics).
+    import pytest
+    import subprocess
+
     script = tmp_path / "discover.sh"
-    script.write_text("#!/bin/sh\nexit 1\n")
+    script.write_text("#!/bin/sh\necho host-1:2\n")
     script.chmod(0o755)
     d = HostDiscoveryScript(str(script))
-    assert d.find_available_hosts_and_slots() == {}
+    mgr = HostManager(d)
+    assert mgr.update_available_hosts()
+    assert mgr.current_hosts.host_slots == {"host-1": 2}
+
+    script.write_text("#!/bin/sh\nexit 1\n")
+    with pytest.raises(subprocess.CalledProcessError):
+        d.find_available_hosts_and_slots()
+    with pytest.raises(subprocess.CalledProcessError):
+        mgr.update_available_hosts()
+    # previous view retained
+    assert mgr.current_hosts.host_slots == {"host-1": 2}
 
 
 def test_host_manager_stable_order():
