@@ -1,0 +1,105 @@
+#include "backends.h"
+
+#include <algorithm>
+#include <map>
+#include <stdexcept>
+
+namespace hvt {
+
+Topology Topology::Build(int rank, const std::vector<std::string>& hosts) {
+  Topology t;
+  t.host_of_rank = hosts;
+  // hosts in first-appearance order; ranks ascend within a host because we
+  // scan by rank
+  std::map<std::string, std::vector<int>> by_host;
+  std::vector<std::string> order;
+  for (int r = 0; r < static_cast<int>(hosts.size()); ++r) {
+    auto& v = by_host[hosts[r]];
+    if (v.empty()) order.push_back(hosts[r]);
+    v.push_back(r);
+  }
+  t.n_hosts = static_cast<int>(order.size());
+  const auto& mine = by_host[hosts[rank]];
+  t.local_group = mine;
+  t.my_local = GroupIndexOf(mine, rank);
+  size_t local_size = mine.size();
+  for (auto& h : order)
+    t.homogeneous = t.homogeneous && by_host[h].size() == local_size;
+  if (t.homogeneous) {
+    for (auto& h : order)
+      t.cross_group.push_back(by_host[h][t.my_local]);
+    std::sort(t.cross_group.begin(), t.cross_group.end());
+  }
+  return t;
+}
+
+void CollectiveBackend::Allgatherv(const void*, int64_t,
+                                   const std::vector<int64_t>&, int64_t,
+                                   void*) {
+  throw std::runtime_error(std::string("hvt backend '") + Name() +
+                           "' does not implement allgather");
+}
+
+void CollectiveBackend::Broadcast(void*, int64_t, int) {
+  throw std::runtime_error(std::string("hvt backend '") + Name() +
+                           "' does not implement broadcast");
+}
+
+void CollectiveBackend::Alltoallv(const void*, const std::vector<int64_t>&,
+                                  int64_t, void*,
+                                  const std::vector<int64_t>&) {
+  throw std::runtime_error(std::string("hvt backend '") + Name() +
+                           "' does not implement alltoall");
+}
+
+void RingBackend::Allreduce(void* buf, int64_t count, DataType dtype,
+                            ReduceKind red) {
+  dp_->Allreduce(buf, count, dtype, red);
+}
+
+void RingBackend::Allgatherv(const void* in, int64_t my_rows,
+                             const std::vector<int64_t>& rows,
+                             int64_t row_bytes, void* out) {
+  dp_->Allgatherv(in, my_rows, rows, row_bytes, out);
+}
+
+void RingBackend::Broadcast(void* buf, int64_t bytes, int root) {
+  dp_->Broadcast(buf, bytes, root);
+}
+
+void RingBackend::Alltoallv(const void* in,
+                            const std::vector<int64_t>& send_rows,
+                            int64_t row_bytes, void* out,
+                            const std::vector<int64_t>& recv_rows) {
+  dp_->Alltoallv(in, send_rows, row_bytes, out, recv_rows);
+}
+
+bool HierarchicalBackend::Enabled(const Response& resp,
+                                  int64_t total_elems) const {
+  return enabled_ && resp.op == OpType::ALLREDUCE &&
+         resp.kind == Response::Kind::TENSOR &&
+         resp.reduce != ReduceKind::ADASUM && total_elems > 0;
+}
+
+void HierarchicalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
+                                    ReduceKind red) {
+  // reference NCCLHierarchicalAllreduce decomposition
+  // (nccl_operations.cc:188-350): local reduce-scatter, parallel
+  // cross-host allreduce (one slice per local rank), local allgather.
+  const int l = static_cast<int>(topo_.local_group.size());
+  const size_t el = DataTypeSize(dtype);
+  auto* bytes = static_cast<uint8_t*>(buf);
+  std::vector<int64_t> seg_off(l + 1);
+  for (int i = 0; i <= l; ++i) seg_off[i] = count * i / l;
+  dp_->RingReduceScatter(bytes, seg_off, el, dtype, red, topo_.local_group);
+  // I now own fully-reduced (locally) segment (my_local+1) % l; my cross
+  // peers (same local index on every host) own the SAME segment of their
+  // hosts' local sums — allreduce it across hosts, all slices in parallel.
+  const int own = (topo_.my_local + 1) % l;
+  int64_t own_n = seg_off[own + 1] - seg_off[own];
+  dp_->AllreduceGroup(bytes + seg_off[own] * el, own_n, dtype, red,
+                      topo_.cross_group);
+  dp_->RingAllgatherSegs(bytes, seg_off, el, topo_.local_group);
+}
+
+}  // namespace hvt

@@ -1,0 +1,97 @@
+// Ordered collective-backend architecture — the TPU-native engine's
+// counterpart of the reference's OperationManager priority list
+// (horovod/common/operations.cc:142-249): the engine dispatches each
+// response to the FIRST backend whose Enabled() accepts it, so alternate
+// data planes (hierarchical, future shared-memory local paths) slot in
+// ahead of the always-enabled flat ring fallback.
+//
+// HierarchicalBackend is the eager analog of the reference's
+// NCCLHierarchicalAllreduce (horovod/common/ops/nccl_operations.cc:188-350):
+// reduce-scatter within the host (LOCAL communicator) → allreduce across
+// hosts among same-local-index peers (CROSS) → allgather within the host.
+// On a real deployment the local phase rides loopback/shared memory while
+// only the cross phase crosses the network, cutting cross-host traffic to
+// ~2·bytes/local_size per rank.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "ring_ops.h"
+#include "wire.h"
+
+namespace hvt {
+
+// Host topology derived at rendezvous — the GLOBAL/LOCAL/CROSS
+// communicator split (reference common.h:115-119, SURVEY §5.8: TPU
+// mapping LOCAL=chips on one host, CROSS=one peer per host).
+struct Topology {
+  std::vector<std::string> host_of_rank;  // by global rank
+  std::vector<int> local_group;           // ranks on my host, ascending
+  std::vector<int> cross_group;           // my local index on every host
+  int my_local = 0;
+  int n_hosts = 1;
+  bool homogeneous = true;  // every host has the same local size
+
+  static Topology Build(int rank, const std::vector<std::string>& hosts);
+};
+
+class CollectiveBackend {
+ public:
+  virtual ~CollectiveBackend() = default;
+  virtual const char* Name() const = 0;
+  // total_elems: summed numels of the (possibly fused) response.
+  virtual bool Enabled(const Response& resp, int64_t total_elems) const = 0;
+  virtual void Allreduce(void* buf, int64_t count, DataType dtype,
+                         ReduceKind red) = 0;
+  virtual void Allgatherv(const void* in, int64_t my_rows,
+                          const std::vector<int64_t>& rows,
+                          int64_t row_bytes, void* out);
+  virtual void Broadcast(void* buf, int64_t bytes, int root);
+  virtual void Alltoallv(const void* in,
+                         const std::vector<int64_t>& send_rows,
+                         int64_t row_bytes, void* out,
+                         const std::vector<int64_t>& recv_rows);
+};
+
+// Flat TCP ring over the full mesh — always enabled (the fallback).
+class RingBackend : public CollectiveBackend {
+ public:
+  explicit RingBackend(DataPlane* dp) : dp_(dp) {}
+  const char* Name() const override { return "ring"; }
+  bool Enabled(const Response&, int64_t) const override { return true; }
+  void Allreduce(void* buf, int64_t count, DataType dtype,
+                 ReduceKind red) override;
+  void Allgatherv(const void* in, int64_t my_rows,
+                  const std::vector<int64_t>& rows, int64_t row_bytes,
+                  void* out) override;
+  void Broadcast(void* buf, int64_t bytes, int root) override;
+  void Alltoallv(const void* in, const std::vector<int64_t>& send_rows,
+                 int64_t row_bytes, void* out,
+                 const std::vector<int64_t>& recv_rows) override;
+
+ private:
+  DataPlane* dp_;
+};
+
+// Local reduce-scatter → cross-host allreduce → local allgather.
+// Enabled for non-Adasum allreduces on a homogeneous multi-host topology
+// with >1 rank per host; HVT_HIERARCHICAL_ALLREDUCE=0 disables.
+class HierarchicalBackend : public CollectiveBackend {
+ public:
+  HierarchicalBackend(DataPlane* dp, Topology topo, bool enabled)
+      : dp_(dp), topo_(std::move(topo)), enabled_(enabled) {}
+  const char* Name() const override { return "hierarchical"; }
+  bool Enabled(const Response& resp, int64_t total_elems) const override;
+  void Allreduce(void* buf, int64_t count, DataType dtype,
+                 ReduceKind red) override;
+
+ private:
+  DataPlane* dp_;
+  Topology topo_;
+  bool enabled_;
+};
+
+}  // namespace hvt

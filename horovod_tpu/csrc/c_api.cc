@@ -31,7 +31,8 @@ int hvt_size() { return Engine::Get().size(); }
 int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,
                const long long* dims, const void* data, long long nbytes,
                int root_rank, double prescale, double postscale,
-               int nsplits, const long long* splits) {
+               int nsplits, const long long* splits, int group_id,
+               int group_size) {
   auto e = std::make_shared<TensorTableEntry>();
   e->name = name ? name : "";
   e->op = static_cast<OpType>(op);
@@ -46,6 +47,8 @@ int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,
     memcpy(e->input.data(), data, static_cast<size_t>(nbytes));
   }
   for (int i = 0; i < nsplits; ++i) e->splits.push_back(splits[i]);
+  e->group_id = group_id;
+  e->group_size = group_size;
   return Engine::Get().Submit(std::move(e));
 }
 
@@ -135,6 +138,12 @@ int hvt_bo_suggest(const double* X, const double* y, int n, int d,
   auto s = bo.Suggest();
   for (int j = 0; j < d; ++j) out[j] = s[j];
   return 0;
+}
+
+// Data-plane collectives executed so far (one fused unit = one) — lets
+// tests assert fusion/grouping behavior.
+long long hvt_data_ops() {
+  return static_cast<long long>(Engine::Get().data_ops());
 }
 
 // Current engine tuning state: [fusion_threshold, cycle_ms, samples,

@@ -160,6 +160,11 @@ struct TensorTableEntry {
   std::vector<int64_t> splits;          // alltoallv send splits (rows)
   std::vector<uint8_t> output;          // filled by the op
   std::vector<int64_t> recv_splits;     // alltoallv result splits
+  // deterministic fusion group (reference group_table.h): members of a
+  // group are negotiated atomically and fused into one collective.
+  // group_id < 0 → ungrouped. group_size = total members of the group.
+  int32_t group_id = -1;
+  int32_t group_size = 0;
 };
 
 using EntryPtr = std::shared_ptr<TensorTableEntry>;

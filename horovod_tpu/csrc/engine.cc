@@ -28,9 +28,11 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   fusion_threshold_ = EnvInt("HVT_FUSION_THRESHOLD", 64 << 20);
   stall_warn_sec_ =
       static_cast<double>(EnvInt("HVT_STALL_WARN_SEC", 60));
+  disable_group_fusion_ = EnvInt("HVT_DISABLE_GROUP_FUSION", 0) != 0;
   cache_ = ResponseCache(
       static_cast<size_t>(EnvInt("HVT_CACHE_CAPACITY", 1024)));
   autotune_.Initialize(fusion_threshold_, cycle_ms_);
+  std::vector<std::string> topo_hosts(size_, "localhost");
   try {
     if (size_ > 1) {
       data_listener_.Listen(0);
@@ -38,14 +40,20 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
       std::string my_host = host_env ? host_env : "127.0.0.1";
       std::string my_ep =
           my_host + ":" + std::to_string(data_listener_.port());
+      // topology identity may differ from the dialable endpoint host
+      // (HVT_TOPO_HOST lets tests fake a multi-host layout on loopback)
+      const char* topo_env = getenv("HVT_TOPO_HOST");
+      std::string my_topo = topo_env && *topo_env ? topo_env : my_host;
 
-      // endpoint exchange over the control star (the rendezvous;
-      // reference analog: gloo HTTP-store scoped KV, gloo_context.cc)
+      // endpoint + topology exchange over the control star (the
+      // rendezvous; reference analog: gloo HTTP-store scoped KV,
+      // gloo_context.cc)
       std::vector<std::string> endpoints(size_);
       if (rank_ == 0) {
         Listener control_listener;
         control_listener.Listen(master_port);
         endpoints[0] = my_ep;
+        topo_hosts[0] = my_topo;
         workers_.resize(size_);
         for (int i = 0; i < size_ - 1; ++i) {
           Sock s = control_listener.Accept();
@@ -53,20 +61,24 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
           Reader rd(frame);
           int32_t r = rd.i32();
           endpoints[r] = rd.str();
+          topo_hosts[r] = rd.str();
           workers_[r] = std::move(s);
         }
         Writer w;
         for (auto& ep : endpoints) w.str(ep);
+        for (auto& th : topo_hosts) w.str(th);
         for (int r = 1; r < size_; ++r) workers_[r].SendFrame(w.buf);
       } else {
         control_ = Sock::Connect(master_addr, master_port);
         Writer w;
         w.i32(rank_);
         w.str(my_ep);
+        w.str(my_topo);
         control_.SendFrame(w.buf);
         auto frame = control_.RecvFrame();
         Reader rd(frame);
         for (auto& ep : endpoints) ep = rd.str();
+        for (auto& th : topo_hosts) th = rd.str();
       }
 
       // full data mesh: i connects to j for i < j; acceptor learns the
@@ -95,6 +107,16 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   } catch (const std::exception& e) {
     return Status::Error(std::string("hvt init failed: ") + e.what());
   }
+  // ordered backend list (reference operations.cc:142-249): hierarchical
+  // first when the topology supports it, flat ring as the fallback
+  topo_ = Topology::Build(rank_, topo_hosts);
+  bool hier_ok = topo_.homogeneous && topo_.n_hosts > 1 &&
+                 topo_.local_group.size() > 1;
+  bool hier_on = hier_ok && EnvInt("HVT_HIERARCHICAL_ALLREDUCE", 1) != 0;
+  backends_.clear();
+  backends_.push_back(std::make_unique<HierarchicalBackend>(
+      data_.get(), topo_, hier_on));
+  backends_.push_back(std::make_unique<RingBackend>(data_.get()));
   rank_joined_.assign(size_, false);
   rank_shutdown_.assign(size_, false);
   hit_pending_.assign(size_, {});
@@ -114,7 +136,13 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   HVT_LOG(INFO, rank_) << "engine up: size " << size_ << ", cycle "
                        << cycle_ms_ << " ms, fusion "
                        << (fusion_threshold_ >> 20) << " MB"
-                       << (autotune_.active() ? ", autotune on" : "");
+                       << (autotune_.active() ? ", autotune on" : "")
+                       << (hier_on
+                               ? ", hierarchical allreduce ("
+                                     + std::to_string(topo_.n_hosts) + "x"
+                                     + std::to_string(
+                                           topo_.local_group.size()) + ")"
+                               : "");
   return Status::OK();
 }
 
@@ -124,6 +152,7 @@ void Engine::Shutdown() {
   if (thread_.joinable()) thread_.join();
   workers_.clear();
   control_.Close();
+  backends_.clear();  // before data_: backends hold raw DataPlane*
   data_.reset();
   data_listener_.Close();
   initialized_ = false;
@@ -137,6 +166,7 @@ void Engine::Shutdown() {
   last_join_rank_ = -1;
   announced_.clear();
   counts_.clear();
+  groups_.clear();
   stall_warned_.clear();
 }
 
@@ -275,10 +305,14 @@ bool Engine::RunCycle() {
     r.prescale = e->prescale;
     r.postscale = e->postscale;
     r.splits = e->splits;
-    // Only ALLREDUCE is cacheable: its execution params are fully
-    // rank-symmetric. allgather/alltoall rows vary per call and per rank.
-    int32_t pos = e->op == OpType::ALLREDUCE ? cache_.Lookup(r)
-                                             : ResponseCache::kMiss;
+    r.group_id = e->group_id;
+    r.group_size = e->group_size;
+    // Only ungrouped ALLREDUCE is cacheable: its execution params are
+    // fully rank-symmetric. allgather/alltoall rows vary per call and per
+    // rank; grouped tensors renegotiate as an atomic unit each time.
+    int32_t pos = (e->op == OpType::ALLREDUCE && e->group_id < 0)
+                      ? cache_.Lookup(r)
+                      : ResponseCache::kMiss;
     if (pos >= 0 && !join_pending_) {
       hit_positions.push_back(pos);
     } else {
@@ -479,8 +513,54 @@ std::vector<Response> Engine::Coordinate(
   for (auto& name : complete) {
     auto& tc = counts_[name];
     if (timeline_.active()) timeline_.NegotiateEnd(name);
-    out.push_back(BuildResponse(tc.requests));
+    Response resp = BuildResponse(tc.requests);
+    int32_t gid = tc.requests[0].group_id;
+    int32_t gsize = tc.requests[0].group_size;
     counts_.erase(name);
+    if (gid < 0 || gsize <= 0 || resp.kind == Response::Kind::BARRIER) {
+      out.push_back(std::move(resp));
+      continue;
+    }
+    // group member: hold until every member of the group is globally
+    // ready, then release adjacently (reference group_table semantics —
+    // grouped_allreduce is all-or-nothing)
+    auto& gs = groups_[gid];
+    gs.expected = gsize;
+    if (resp.kind == Response::Kind::ERROR && !gs.poisoned) {
+      gs.poisoned = true;
+      gs.error = resp.error + " (fusion group " + std::to_string(gid) +
+                 " aborted)";
+    }
+    if (gs.poisoned) {
+      // dissolve: error out held members and every later-arriving member
+      for (auto& [n2, r2] : gs.held) {
+        Response err;
+        err.kind = Response::Kind::ERROR;
+        err.names = {n2};
+        err.error = gs.error;
+        out.push_back(std::move(err));
+        gs.released++;
+      }
+      gs.held.clear();
+      if (resp.kind != Response::Kind::ERROR) {
+        resp.kind = Response::Kind::ERROR;
+        resp.error = gs.error;
+      }
+      out.push_back(std::move(resp));
+      gs.released++;
+    } else {
+      resp.group_id = gid;
+      gs.held.emplace(name, std::move(resp));
+      if (static_cast<int>(gs.held.size()) + gs.released >= gs.expected) {
+        for (auto& [n2, r2] : gs.held) {
+          out.push_back(std::move(r2));
+          gs.released++;
+        }
+        gs.held.clear();
+      }
+    }
+    if (gs.released >= gs.expected)
+      groups_.erase(gid);  // deregister on completion (operations.cc:622)
   }
 
   FuseResponses(out);
@@ -508,6 +588,10 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
       return fail("mismatched root rank for tensor '" + a.name + "'");
     if (q.prescale != a.prescale || q.postscale != a.postscale)
       return fail("mismatched scale factors for tensor '" + a.name + "'");
+    if (q.group_id != a.group_id || q.group_size != a.group_size)
+      return fail("mismatched fusion group for tensor '" + a.name +
+                  "' (all ranks must submit grouped collectives with "
+                  "identical membership)");
     bool shape_free_dim0 =
         a.op == OpType::ALLGATHER || a.op == OpType::ALLTOALL;
     if (shape_free_dim0) {
@@ -578,10 +662,14 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
 void Engine::FuseResponses(std::vector<Response>& responses) {
   // merge adjacent allreduce responses with identical execution params
   // while the fused payload stays under the threshold (reference
-  // controller.cc:777 FuseResponses)
+  // controller.cc:777 FuseResponses). Members of the same fusion group
+  // merge UNCONDITIONALLY (no threshold — deterministic group fusion,
+  // reference controller.cc:199-223) unless HVT_DISABLE_GROUP_FUSION is
+  // set; grouped responses never merge with ungrouped ones or with other
+  // groups, so each group stays one atomic collective.
   std::vector<Response> fused;
   for (auto& r : responses) {
-    bool can_fuse =
+    bool params_match =
         !fused.empty() && r.kind == Response::Kind::TENSOR &&
         fused.back().kind == Response::Kind::TENSOR &&
         r.op == OpType::ALLREDUCE && fused.back().op == OpType::ALLREDUCE &&
@@ -589,12 +677,17 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
         r.prescale == fused.back().prescale &&
         r.postscale == fused.back().postscale &&
         r.reduce != ReduceKind::ADASUM;
+    bool same_group = params_match && r.group_id >= 0 &&
+                      fused.back().group_id == r.group_id &&
+                      !disable_group_fusion_;
+    bool can_fuse = params_match && (same_group || (r.group_id < 0 &&
+                                                    fused.back().group_id < 0));
     if (can_fuse) {
       int64_t cur = 0, add = 0;
       for (auto n : fused.back().numels) cur += n;
       for (auto n : r.numels) add += n;
       int64_t el = static_cast<int64_t>(DataTypeSize(r.dtype));
-      if ((cur + add) * el <= fusion_threshold_) {
+      if (same_group || (cur + add) * el <= fusion_threshold_) {
         fused.back().names.insert(fused.back().names.end(), r.names.begin(),
                                   r.names.end());
         fused.back().numels.insert(fused.back().numels.end(),
@@ -605,6 +698,13 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
     fused.push_back(std::move(r));
   }
   responses = std::move(fused);
+}
+
+CollectiveBackend* Engine::PickBackend(const Response& resp,
+                                       int64_t total_elems) {
+  for (auto& b : backends_)
+    if (b->Enabled(resp, total_elems)) return b.get();
+  return backends_.back().get();  // ring fallback accepts everything
 }
 
 void Engine::CheckStalls() {
@@ -704,6 +804,7 @@ void Engine::ExecuteResponse(const Response& resp,
   }
 
   const size_t el = DataTypeSize(resp.dtype);
+  data_ops_++;  // one per TENSOR response = one data-plane collective
   for (int64_t n : resp.numels)
     cycle_bytes_ += n * static_cast<int64_t>(el);
   switch (resp.op) {
@@ -771,8 +872,8 @@ void Engine::ExecuteResponse(const Response& resp,
       if (resp.prescale != 1.0)
         ScaleBuffer(fusion_buffer_.data(), total, resp.dtype,
                     resp.prescale);
-      data_->Allreduce(fusion_buffer_.data(), total, resp.dtype,
-                       resp.reduce);
+      PickBackend(resp, total)->Allreduce(fusion_buffer_.data(), total,
+                                          resp.dtype, resp.reduce);
       double post = resp.postscale;
       if (resp.reduce == ReduceKind::AVERAGE) post /= size_;
       if (post != 1.0)
@@ -783,11 +884,13 @@ void Engine::ExecuteResponse(const Response& resp,
         if (entries[i]) {
           entries[i]->output.assign(fusion_buffer_.data() + off,
                                     fusion_buffer_.data() + off + bytes);
-          // every rank inserts in the same order → identical caches
+          // every rank inserts in the same order → identical caches;
+          // grouped tensors stay uncached (groups renegotiate as a unit)
           CachedParams p{resp.op,      resp.reduce,    resp.dtype,
                          entries[i]->shape, resp.root, resp.prescale,
                          resp.postscale, entries[i]->splits};
-          if (!join_pending_) cache_.Insert(resp.names[i], p);
+          if (!join_pending_ && resp.group_id < 0)
+            cache_.Insert(resp.names[i], p);
           CompleteEntry(entries[i], Status::OK());
         }
         off += bytes;

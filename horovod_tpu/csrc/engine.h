@@ -34,6 +34,7 @@
 #include <vector>
 
 #include "autotune.h"
+#include "backends.h"
 #include "cache.h"
 #include "common.h"
 #include "net.h"
@@ -64,6 +65,9 @@ class Engine {
   const ParameterManager& autotune() const { return autotune_; }
   int64_t fusion_threshold() const { return fusion_threshold_; }
   int current_cycle_ms() const { return cycle_ms_; }
+  // total data-plane collectives executed (one fused allreduce = one);
+  // introspection for tests asserting fusion behavior
+  int64_t data_ops() const { return data_ops_.load(); }
 
   // Returns handle (>=0) or -1 when not initialized.
   int32_t Submit(EntryPtr entry);
@@ -95,11 +99,18 @@ class Engine {
   void FuseResponses(std::vector<Response>& responses);
   void CheckStalls();
 
+  // first backend whose Enabled() accepts the response (never null —
+  // the ring fallback accepts everything)
+  CollectiveBackend* PickBackend(const Response& resp, int64_t total_elems);
+
   // control plane
   Sock control_;                 // workers: connection to rank 0
   std::vector<Sock> workers_;    // rank 0: connections from workers
   std::unique_ptr<DataPlane> data_;
   Listener data_listener_;
+  // ordered backend list (reference operations.cc:142-249); built at Init
+  std::vector<std::unique_ptr<CollectiveBackend>> backends_;
+  Topology topo_;
 
   int rank_ = 0, size_ = 1;
   // atomic: mutated by the engine thread, read by the introspection API
@@ -127,6 +138,19 @@ class Engine {
 
   // rank-0-only state
   std::map<std::string, TensorCount> counts_;
+  // Group table (reference group_table.h): members of a fusion group are
+  // held after negotiation until EVERY member is globally ready, then
+  // released adjacently (name-sorted) so FuseResponses merges them into
+  // one collective. A member error poisons the whole group.
+  struct GroupState {
+    int expected = 0;
+    int released = 0;
+    bool poisoned = false;
+    std::string error;
+    std::map<std::string, Response> held;  // name-sorted → deterministic
+  };
+  std::map<int32_t, GroupState> groups_;
+  bool disable_group_fusion_ = false;  // HVT_DISABLE_GROUP_FUSION
   std::vector<bool> rank_joined_;
   std::vector<bool> rank_shutdown_;
   std::vector<std::set<int64_t>> hit_pending_;  // per rank, cache positions
@@ -137,6 +161,7 @@ class Engine {
   std::map<std::string, bool> stall_warned_;
   ParameterManager autotune_;     // rank 0 tunes; workers receive cycle_ms
   int64_t cycle_bytes_ = 0;       // payload bytes executed this cycle
+  std::atomic<int64_t> data_ops_{0};
   EngineTimeline timeline_;       // rank-0 chrome trace (HVT_TIMELINE)
 
   std::vector<uint8_t> fusion_buffer_;

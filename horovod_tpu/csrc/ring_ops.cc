@@ -209,33 +209,29 @@ void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor) {
 
 // ---- collectives -----------------------------------------------------------
 
-void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
-                          ReduceKind red) {
-  if (size_ == 1 || count == 0) return;
-  const size_t el = DataTypeSize(dtype);
-  auto* bytes = static_cast<uint8_t*>(buf);
-  const int n = size_;
-  // segment boundaries (element granularity)
-  std::vector<int64_t> seg_off(n + 1);
-  for (int i = 0; i <= n; ++i) seg_off[i] = count * i / n;
-
-  const int next = (rank_ + 1) % n;
-  const int prev = (rank_ + n - 1) % n;
+void DataPlane::RingReduceScatter(uint8_t* bytes,
+                                  const std::vector<int64_t>& seg_off,
+                                  size_t el, DataType dtype, ReduceKind red,
+                                  const std::vector<int>& group) {
+  const int l = static_cast<int>(group.size());
+  if (l == 1) return;
+  const int idx = GroupIndexOf(group, rank_);
+  const int next = group[(idx + 1) % l];
+  const int prev = group[(idx + l - 1) % l];
   int64_t max_seg = 0;
-  for (int i = 0; i < n; ++i)
+  for (int i = 0; i < l; ++i)
     max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
   scratch_.resize(static_cast<size_t>(max_seg) * el);
 
-  // reduce-scatter: after N-1 steps, rank r owns fully-reduced segment
-  // (r+1) % n
-  for (int step = 0; step < n - 1; ++step) {
-    int send_seg = (rank_ - step + n) % n;
-    int recv_seg = (rank_ - step - 1 + n) % n;
+  // after l-1 steps, group index i owns fully-reduced segment (i+1) % l
+  for (int step = 0; step < l - 1; ++step) {
+    int send_seg = (idx - step + l) % l;
+    int recv_seg = (idx - step - 1 + l) % l;
     int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
     int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
-    // full-duplex: send to next, recv from prev (rank parity ordering
+    // full-duplex: send to next, recv from prev (index parity ordering
     // avoids head-of-line deadlock on blocking sockets for small frames)
-    if (rank_ % 2 == 0) {
+    if (idx % 2 == 0) {
       peer(next).SendAll(bytes + seg_off[send_seg] * el,
                          static_cast<size_t>(send_n) * el);
       peer(prev).RecvAll(scratch_.data(), static_cast<size_t>(recv_n) * el);
@@ -247,13 +243,23 @@ void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
     ReduceInto(bytes + seg_off[recv_seg] * el, scratch_.data(), recv_n,
                dtype, red);
   }
-  // allgather ring: rotate owned segments
-  for (int step = 0; step < n - 1; ++step) {
-    int send_seg = (rank_ + 1 - step + n) % n;
-    int recv_seg = (rank_ - step + n) % n;
+}
+
+void DataPlane::RingAllgatherSegs(uint8_t* bytes,
+                                  const std::vector<int64_t>& seg_off,
+                                  size_t el,
+                                  const std::vector<int>& group) {
+  const int l = static_cast<int>(group.size());
+  if (l == 1) return;
+  const int idx = GroupIndexOf(group, rank_);
+  const int next = group[(idx + 1) % l];
+  const int prev = group[(idx + l - 1) % l];
+  for (int step = 0; step < l - 1; ++step) {
+    int send_seg = (idx + 1 - step + l) % l;
+    int recv_seg = (idx - step + l) % l;
     int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
     int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
-    if (rank_ % 2 == 0) {
+    if (idx % 2 == 0) {
       peer(next).SendAll(bytes + seg_off[send_seg] * el,
                          static_cast<size_t>(send_n) * el);
       peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
@@ -265,6 +271,28 @@ void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
                          static_cast<size_t>(send_n) * el);
     }
   }
+}
+
+void DataPlane::AllreduceGroup(void* buf, int64_t count, DataType dtype,
+                               ReduceKind red,
+                               const std::vector<int>& group) {
+  if (group.size() == 1 || count == 0) return;
+  const size_t el = DataTypeSize(dtype);
+  auto* bytes = static_cast<uint8_t*>(buf);
+  const int l = static_cast<int>(group.size());
+  // segment boundaries (element granularity)
+  std::vector<int64_t> seg_off(l + 1);
+  for (int i = 0; i <= l; ++i) seg_off[i] = count * i / l;
+  RingReduceScatter(bytes, seg_off, el, dtype, red, group);
+  RingAllgatherSegs(bytes, seg_off, el, group);
+}
+
+void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
+                          ReduceKind red) {
+  if (size_ == 1 || count == 0) return;
+  std::vector<int> all(size_);
+  for (int i = 0; i < size_; ++i) all[i] = i;
+  AllreduceGroup(buf, count, dtype, red, all);
 }
 
 void DataPlane::Allgatherv(const void* in, int64_t my_rows,

@@ -23,6 +23,14 @@
 
 namespace hvt {
 
+// Index of `rank` within an ascending rank group (throws if absent) —
+// shared by the ring phases and the topology builder (backends.cc).
+inline int GroupIndexOf(const std::vector<int>& group, int rank) {
+  for (size_t i = 0; i < group.size(); ++i)
+    if (group[i] == rank) return static_cast<int>(i);
+  throw std::runtime_error("hvt: rank not in collective group");
+}
+
 class DataPlane {
  public:
   // peers: socket per rank (peers[self] unused/invalid).
@@ -33,6 +41,25 @@ class DataPlane {
   int size() const { return size_; }
 
   void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red);
+  // Group-parameterized ring collective over a subset of ranks (ascending
+  // global ranks, must contain this rank). Disjoint groups may run
+  // concurrently — the mesh is pairwise, so their traffic never crosses.
+  // Building block of the hierarchical LOCAL/CROSS composition
+  // (backends.h).
+  void AllreduceGroup(void* buf, int64_t count, DataType dtype,
+                      ReduceKind red, const std::vector<int>& group);
+  // Ring reduce-scatter phase: after it, the rank at group index i owns
+  // fully-reduced segment (i+1) % |group| of `bytes` (segments given by
+  // seg_off, element size el).
+  void RingReduceScatter(uint8_t* bytes,
+                         const std::vector<int64_t>& seg_off, size_t el,
+                         DataType dtype, ReduceKind red,
+                         const std::vector<int>& group);
+  // Ring allgather phase rotating owned segments (inverse of the above's
+  // ownership: entering, group index i holds segment (i+1) % |group|).
+  void RingAllgatherSegs(uint8_t* bytes,
+                         const std::vector<int64_t>& seg_off, size_t el,
+                         const std::vector<int>& group);
   // rows per rank along dim 0; row_bytes = bytes of one row.
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,

@@ -23,6 +23,10 @@ struct Request {
   int32_t root_rank = 0;
   double prescale = 1.0, postscale = 1.0;
   std::vector<int64_t> splits;
+  // deterministic fusion group (reference group_table.h / Request group
+  // semantics); -1 → ungrouped
+  int32_t group_id = -1;
+  int32_t group_size = 0;
 };
 
 struct Response {
@@ -46,6 +50,9 @@ struct Response {
   // for allgather/alltoall so joined ranks — which have no local entry to
   // read a shape from — still use the same transfer sizes as their peers.
   int64_t trailing = 1;
+  // fusion-group id the member(s) came from; workers use it to skip the
+  // response cache for grouped tensors (groups renegotiate as a unit)
+  int32_t group_id = -1;
 };
 
 class Writer {
@@ -112,6 +119,8 @@ inline void EncodeRequest(Writer& w, const Request& r) {
   w.f64(r.prescale);
   w.f64(r.postscale);
   w.i64vec(r.splits);
+  w.i32(r.group_id);
+  w.i32(r.group_size);
 }
 
 inline Request DecodeRequest(Reader& rd) {
@@ -126,6 +135,8 @@ inline Request DecodeRequest(Reader& rd) {
   r.prescale = rd.f64();
   r.postscale = rd.f64();
   r.splits = rd.i64vec();
+  r.group_id = rd.i32();
+  r.group_size = rd.i32();
   return r;
 }
 
@@ -155,6 +166,7 @@ inline void EncodeResponse(Writer& w, const Response& r) {
   w.i64vec(r.numels);
   w.i64vec(r.rows_flat);
   w.i64(r.trailing);
+  w.i32(r.group_id);
 }
 
 inline Response DecodeResponse(Reader& rd) {
@@ -173,6 +185,7 @@ inline Response DecodeResponse(Reader& rd) {
   r.numels = rd.i64vec();
   r.rows_flat = rd.i64vec();
   r.trailing = rd.i64();
+  r.group_id = rd.i32();
   return r;
 }
 

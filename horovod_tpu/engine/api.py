@@ -175,19 +175,24 @@ def _scale(arr, factor):
 # collectives
 # --------------------------------------------------------------------------
 
-def allreduce(tensor, op, name=None, prescale_factor=1.0,
-              postscale_factor=1.0, process_set=global_process_set) -> Handle:
+def _op_wire_name(op) -> str:
+    """Map a collective_ops reduce-op class to its engine wire name."""
     from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min,
                                                 Product, Sum)
 
+    return {Average: "avg", Sum: "sum", Adasum: "adasum", Min: "min",
+            Max: "max", Product: "prod"}[op]
+
+
+def allreduce(tensor, op, name=None, prescale_factor=1.0,
+              postscale_factor=1.0, process_set=global_process_set) -> Handle:
     arr, kind = _to_numpy(tensor)
     n = _nprocs()
     if n == 1:
         out = _scale(_scale(arr.copy(), prescale_factor), postscale_factor)
         return _immediate(_from_numpy(out, kind))
     native = _require_multiproc_engine()
-    opname = {Average: "avg", Sum: "sum", Adasum: "adasum", Min: "min",
-              Max: "max", Product: "prod"}[op]
+    opname = _op_wire_name(op)
     h = native.submit("allreduce", arr, kind,
                       name=_auto_name("allreduce", name), op_kind=opname,
                       prescale=prescale_factor, postscale=postscale_factor,
@@ -213,15 +218,50 @@ def _combine_handles(handles) -> Handle:
     return h
 
 
+_group_seq = 0
+
+
 def grouped_allreduce(tensors, op, name=None, prescale_factor=1.0,
                       postscale_factor=1.0,
                       process_set=global_process_set) -> Handle:
-    return _combine_handles(
-        [allreduce(t, op, name=f"{name}.{i}" if name else None,
-                   prescale_factor=prescale_factor,
-                   postscale_factor=postscale_factor,
-                   process_set=process_set)
-         for i, t in enumerate(tensors)])
+    """Allreduce a list of tensors as one deterministic fusion group.
+
+    On the multi-process engine path the members carry an engine-side
+    group id (reference ``group_table.h``): the coordinator negotiates
+    them atomically (all-or-nothing readiness) and fuses them into ONE
+    ring collective regardless of the fusion threshold, unless
+    ``HVT_DISABLE_GROUP_FUSION`` is set. Group ids are assigned in
+    program order, which is identical across ranks (SPMD), so membership
+    matches without extra coordination."""
+    from horovod_tpu.engine import native
+
+    tensors = list(tensors)
+    if not tensors:
+        return _immediate([])
+    if _nprocs() == 1 or not native.engine_running():
+        return _combine_handles(
+            [allreduce(t, op, name=f"{name}.{i}" if name else None,
+                       prescale_factor=prescale_factor,
+                       postscale_factor=postscale_factor,
+                       process_set=process_set)
+             for i, t in enumerate(tensors)])
+    global _group_seq
+    _group_seq += 1
+    gid = _group_seq
+    opname = _op_wire_name(op)
+    handles = []
+    for i, t in enumerate(tensors):
+        arr, kind = _to_numpy(t)
+        h = native.submit(
+            "allreduce", arr, kind,
+            name=(f"{name}.{i}" if name
+                  else _auto_name("grouped_allreduce", None)),
+            op_kind=opname, prescale=prescale_factor,
+            postscale=postscale_factor, process_set=process_set,
+            group_id=gid, group_size=len(tensors))
+        handles.append(
+            _ConvertingHandle(h, lambda r, k=kind: _from_numpy(r, k)))
+    return _combine_handles(handles)
 
 
 def allgather(tensor, name=None, process_set=global_process_set) -> Handle:
@@ -287,11 +327,7 @@ def reducescatter(tensor, op, name=None, prescale_factor=1.0,
         out = _scale(_scale(arr.copy(), prescale_factor), postscale_factor)
         return _immediate(_from_numpy(out, kind))
     native = _require_multiproc_engine()
-    from horovod_tpu.ops.collective_ops import (Average, Max, Min, Product,
-                                                Sum)
-
-    opname = {Average: "avg", Sum: "sum", Min: "min", Max: "max",
-              Product: "prod"}[op]
+    opname = _op_wire_name(op)
     h = native.submit("reducescatter", arr, kind,
                       name=_auto_name("reducescatter", name),
                       op_kind=opname, prescale=prescale_factor,

@@ -65,8 +65,12 @@ def _load():
             ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
             ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
             ctypes.c_double, ctypes.c_double, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong)]
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int]
         lib.hvt_result_bytes.restype = ctypes.c_longlong
+        if getattr(lib, "hvt_data_ops", None) is not None:
+            # introspection symbol; a stale .so without it must not break
+            # the graceful-degrade contract of _load()
+            lib.hvt_data_ops.restype = ctypes.c_longlong
         lib.hvt_result_read.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                         ctypes.c_longlong]
         lib.hvt_result_recv_splits.argtypes = [
@@ -109,6 +113,14 @@ def shutdown_if_running():
     if lib is not None and _engine_inited:
         lib.hvt_shutdown()
         _engine_inited = False
+
+
+def engine_data_ops() -> int:
+    """Data-plane collectives executed so far (one fused unit = one)."""
+    lib = _load()
+    if not engine_running() or getattr(lib, "hvt_data_ops", None) is None:
+        return 0
+    return int(lib.hvt_data_ops())
 
 
 def engine_rank() -> int:
@@ -218,7 +230,7 @@ class NativeHandle:
 
 def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
            prescale=1.0, postscale=1.0, splits=None, process_set=None,
-           **_ignored):
+           group_id=-1, group_size=0, **_ignored):
     """Submit an eager collective; returns a handle whose wait() yields the
     framework-converted result (conversion handled by engine/api.py)."""
     if not engine_running():
@@ -259,7 +271,7 @@ def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
         len(dims), dims_arr,
         arr.ctypes.data_as(ctypes.c_void_p) if arr.size else None,
         ctypes.c_longlong(arr.nbytes), root_rank, prescale, postscale,
-        len(splits_list), splits_arr)
+        len(splits_list), splits_arr, int(group_id), int(group_size))
     if h < 0:
         raise HorovodInternalError("hvt engine rejected submission "
                                    "(not initialized)")

@@ -209,6 +209,139 @@ def test_allreduce_dtype_matrix_2proc():
     assert "DTYPES-OK-0" in out and "DTYPES-OK-1" in out
 
 
+def _run_raw(script_body, np_=4, extra_env=None, timeout=120):
+    """Launch a raw worker script (no run_workers template) — for tests
+    that must set per-rank env before hvt.init()."""
+    _PORT[0] += 1
+    path = f"/tmp/hvt_raw_{os.getpid()}_{_PORT[0]}.py"
+    with open(path, "w") as f:
+        f.write(script_body)
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": ""})
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np",
+         str(np_), "--master-port", str(_PORT[0]), sys.executable, path],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    return proc.stdout + proc.stderr
+
+
+_HIER_BODY = f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+rank = int(os.environ["HVT_PROCESS_ID"])
+os.environ["HVT_TOPO_HOST"] = "hostA" if rank < 2 else "hostB"
+import numpy as np
+import horovod_tpu as hvt
+hvt.init()
+r, n = hvt.rank(), hvt.size()
+assert n == 4
+# integer payloads are exact in fp32: hierarchical must match the flat
+# ring (and the analytic expectation) bitwise
+for name, count in [("a", 1), ("b", 5), ("c", 64), ("d", 1000)]:
+    x = (np.arange(count) % 7 + r + 1).astype(np.float32)
+    res = np.asarray(hvt.allreduce(x, op=hvt.Sum, name=name))
+    expect = sum(
+        (np.arange(count) % 7 + rr + 1) for rr in range(n)).astype(
+        np.float32)
+    np.testing.assert_array_equal(res, expect)
+# fused unit (several tensors in one cycle) through the same path
+hs = [hvt.allreduce_async(np.full((16,), float(r + 1 + i), np.float32),
+                          op=hvt.Sum, name=f"f{{i}}") for i in range(3)]
+for i, h in enumerate(hs):
+    np.testing.assert_array_equal(
+        np.asarray(hvt.synchronize(h)),
+        np.full((16,), float(sum(rr + 1 + i for rr in range(n))),
+                np.float32))
+mx = np.asarray(hvt.allreduce(np.float32([r]), op=hvt.Max, name="mx"))
+np.testing.assert_array_equal(mx, [3.0])
+avg = np.asarray(hvt.allreduce(np.full((8,), float(r + 1), np.float32),
+                               name="avg"))
+np.testing.assert_allclose(avg, 2.5)
+d64 = np.asarray(hvt.allreduce(np.arange(10, dtype=np.float64) + r,
+                               op=hvt.Sum, name="d64"))
+np.testing.assert_array_equal(d64, np.arange(10, dtype=np.float64) * 4 + 6)
+print(f"HIER-OK-{{r}}", flush=True)
+hvt.shutdown()
+"""
+
+
+def test_hierarchical_allreduce_2x2_topology():
+    """Faked 2-host x 2-slot topology (HVT_TOPO_HOST): the hierarchical
+    backend (local reduce-scatter -> cross allreduce -> local allgather,
+    reference nccl_operations.cc:188-350) must engage and produce results
+    identical to the flat ring's."""
+    out = _run_raw(_HIER_BODY, extra_env={"HVT_LOG_LEVEL": "info"})
+    assert "hierarchical allreduce (2x2)" in out, out
+    for r in range(4):
+        assert f"HIER-OK-{r}" in out
+
+
+def test_hierarchical_disabled_falls_back_to_ring():
+    """HVT_HIERARCHICAL_ALLREDUCE=0 keeps the ordered backend list on the
+    ring fallback; results unchanged."""
+    out = _run_raw(_HIER_BODY, extra_env={
+        "HVT_LOG_LEVEL": "info", "HVT_HIERARCHICAL_ALLREDUCE": "0"})
+    assert "hierarchical allreduce" not in out, out
+    for r in range(4):
+        assert f"HIER-OK-{r}" in out
+
+
+def test_grouped_allreduce_single_ring_op_2proc():
+    """A 3-tensor group must fuse into ONE data-plane collective even when
+    the fusion threshold is too small for threshold-based fusion
+    (deterministic group fusion, reference controller.cc:199-223)."""
+    out = run_workers("""
+        from horovod_tpu.engine import native
+        base = native.engine_data_ops()
+        xs = [np.full((4,), float(r + 1 + i), np.float32) for i in range(3)]
+        res = hvt.grouped_allreduce(xs, op=hvt.Sum, name="grp")
+        for i, t in enumerate(res):
+            expect = sum(float(rr + 1 + i) for rr in range(n))
+            np.testing.assert_allclose(np.asarray(t), expect)
+        ops = native.engine_data_ops() - base
+        assert ops == 1, f"expected 1 fused ring op for the group, got {ops}"
+        print(f"GROUP-OK-{r}", flush=True)
+    """, extra_env={"HVT_FUSION_THRESHOLD": "1"})
+    assert "GROUP-OK-0" in out and "GROUP-OK-1" in out
+
+
+def test_grouped_allreduce_disable_group_fusion_2proc():
+    """HVT_DISABLE_GROUP_FUSION keeps group members un-merged (3 ring ops)
+    while negotiation stays atomic."""
+    out = run_workers("""
+        from horovod_tpu.engine import native
+        base = native.engine_data_ops()
+        xs = [np.full((4,), float(i + 1), np.float32) for i in range(3)]
+        res = hvt.grouped_allreduce(xs, op=hvt.Sum, name="grp")
+        for i, t in enumerate(res):
+            np.testing.assert_allclose(np.asarray(t), float(i + 1) * n)
+        ops = native.engine_data_ops() - base
+        assert ops == 3, f"expected 3 unmerged ring ops, got {ops}"
+        print(f"NOFUSE-OK-{r}", flush=True)
+    """, extra_env={"HVT_FUSION_THRESHOLD": "1",
+                    "HVT_DISABLE_GROUP_FUSION": "1"})
+    assert "NOFUSE-OK-0" in out and "NOFUSE-OK-1" in out
+
+
+def test_grouped_member_mismatch_poisons_group_2proc():
+    """A cross-rank shape mismatch on ONE member must error the WHOLE
+    group (all-or-nothing), not deadlock the remaining members."""
+    run_workers("""
+        xs = [np.zeros((2,), np.float32),
+              np.zeros((r + 2,), np.float32),   # mismatched across ranks
+              np.zeros((2,), np.float32)]
+        try:
+            hvt.grouped_allreduce(xs, op=hvt.Sum, name="badgrp")
+            raise SystemExit("expected ValueError")
+        except ValueError as e:
+            assert "mismatched shape" in str(e) or "aborted" in str(e), e
+    """)
+
+
 def test_sparse_allreduce_unequal_nnz_2proc():
     """Regression: average must divide by world size on every rank even
     when ranks contribute different row counts (allgatherv)."""
