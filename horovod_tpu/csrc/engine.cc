@@ -729,14 +729,22 @@ void Engine::CheckStalls() {
 // execution
 // --------------------------------------------------------------------------
 
-// local Adasum tree combine over gathered per-rank vectors (fp32/fp64)
+// local Adasum tree combine over gathered per-rank vectors (fp32/fp64).
+// Levels with stride < start_level average instead of adasum-combining —
+// the reference's GPU start_level composition (adasum.h:177-183: local
+// ranks average, only cross-host levels run the scale-invariant combine).
 template <typename T>
-static void AdasumTree(std::vector<std::vector<T>>& vs) {
+static void AdasumTree(std::vector<std::vector<T>>& vs, int start_level) {
   int n = static_cast<int>(vs.size());
   for (int stride = 1; stride < n; stride <<= 1) {
     for (int base = 0; base < n; base += stride << 1) {
       auto& a = vs[base];
       auto& b = vs[base + stride];
+      if (stride < start_level) {
+        for (size_t i = 0; i < a.size(); ++i)
+          a[i] = static_cast<T>(0.5 * (static_cast<double>(a[i]) + b[i]));
+        continue;
+      }
       double dot = 0, asq = 0, bsq = 0;
       for (size_t i = 0; i < a.size(); ++i) {
         dot += static_cast<double>(a[i]) * b[i];
@@ -749,6 +757,39 @@ static void AdasumTree(std::vector<std::vector<T>>& vs) {
         a[i] = static_cast<T>(ca * a[i] + cb * b[i]);
     }
   }
+}
+
+// AdasumTree pairs by GLOBAL rank adjacency, so "local ranks average
+// first" is only true when each host's ranks are a contiguous run.
+static bool HostContiguousRanks(const std::vector<std::string>& hosts) {
+  std::set<std::string> closed;
+  for (size_t i = 0; i < hosts.size(); ++i) {
+    if (i == 0 || hosts[i] != hosts[i - 1]) {
+      if (!closed.insert(hosts[i]).second) return false;  // host reappears
+    }
+  }
+  return true;
+}
+
+// HVT_ADASUM_START_LEVEL: integer, or "local" for the host-local rank
+// count (the reference GPU op's choice).
+static int AdasumStartLevel(const Topology& topo, int rank) {
+  const char* v = getenv("HVT_ADASUM_START_LEVEL");
+  if (!v || !*v) return 1;
+  if (std::string(v) == "local") {
+    // the composition assumes host-contiguous global ranks and equal
+    // local sizes; with an interleaved placement the levels would invert
+    // (cross-host pairs averaging) — fall back to pure adasum instead
+    if (!topo.homogeneous || !HostContiguousRanks(topo.host_of_rank)) {
+      HVT_LOG(WARNING, rank)
+          << "HVT_ADASUM_START_LEVEL=local needs host-contiguous ranks "
+          << "and equal per-host sizes; falling back to pure adasum";
+      return 1;
+    }
+    return static_cast<int>(topo.local_group.size());
+  }
+  int n = atoi(v);
+  return n > 0 ? n : 1;
 }
 
 void Engine::ExecuteResponse(const Response& resp,
@@ -825,7 +866,7 @@ void Engine::ExecuteResponse(const Response& resp,
             memcpy(vs[r].data(), gathered.data() + r * mine.size(),
                    mine.size());
           }
-          AdasumTree(vs);
+          AdasumTree(vs, AdasumStartLevel(topo_, rank_));
           if (e) {
             e->output.resize(mine.size());
             memcpy(e->output.data(), vs[0].data(), mine.size());
@@ -837,7 +878,7 @@ void Engine::ExecuteResponse(const Response& resp,
             memcpy(vs[r].data(), gathered.data() + r * mine.size(),
                    mine.size());
           }
-          AdasumTree(vs);
+          AdasumTree(vs, AdasumStartLevel(topo_, rank_));
           if (e) {
             e->output.resize(mine.size());
             memcpy(e->output.data(), vs[0].data(), mine.size());

@@ -322,6 +322,12 @@ def alltoall(tensor, splits=None, name=None,
 def reducescatter(tensor, op, name=None, prescale_factor=1.0,
                   postscale_factor=1.0,
                   process_set=global_process_set) -> Handle:
+    from horovod_tpu.ops.collective_ops import Adasum
+
+    if op is Adasum:
+        raise ValueError(
+            "reducescatter does not support Adasum (the scale-invariant "
+            "combine needs the full vectors); use allreduce(op=Adasum)")
     arr, kind = _to_numpy(tensor)
     if _nprocs() == 1:
         out = _scale(_scale(arr.copy(), prescale_factor), postscale_factor)

@@ -41,40 +41,91 @@ def pairwise_adasum(a, b):
     return (ca * af + cb * bf).astype(a.dtype)
 
 
-def adasum_reduce(t, axis_name, axis_index_groups=None):
+def adasum_reduce(t, axis_name, axis_index_groups=None, start_level=None):
     """Adasum-combine ``t`` across the mesh axis (traced path).
 
     At level k, ranks pair with stride 2^k inside blocks of 2^(k+1); after
     log2(n) levels every rank holds adasum over all ranks, matching the
     reference's recursion order (``adasum.h:194-336``).
+
+    ``axis_index_groups``: optional partition of the axis (a process set
+    plus its complement, or any partition). Every group of size >= 2 must
+    be power-of-two sized and is adasum-combined internally; singleton and
+    complement members pass through unchanged (the reference's
+    "not included" semantics).
+
+    ``start_level``: levels with stride < start_level use a plain AVERAGE
+    instead of the adasum combine — the reference's GPU start_level trick
+    (``adasum.h:177-183``: intra-node levels average, only cross-node
+    levels run the scale-invariant combine; the GPU op passes local_size).
+    Default 1 (pure adasum); the ``HVT_ADASUM_START_LEVEL`` env var sets a
+    global default (an integer, or ``local`` for the local mesh size).
+    The pairing is by axis-index adjacency, so ``local`` assumes the mesh
+    axis orders same-host chips contiguously (the default host-major
+    ordering of ``global_mesh``).
     """
-    if axis_index_groups is not None:
-        raise NotImplementedError(
-            "Adasum over a strict process subset is not yet supported on "
-            "the traced path; use the global process set")
     n = lax.axis_size(axis_name)
-    if n & (n - 1):
-        raise ValueError(
-            f"Adasum requires a power-of-two number of workers, got {n} "
-            "(reference enforces the same: tensorflow/__init__.py:146)")
-    if n == 1:
+    if start_level is None:
+        import os
+
+        raw = os.environ.get("HVT_ADASUM_START_LEVEL", "1")
+        if raw == "local":
+            from horovod_tpu.common import basics
+
+            start_level = basics.local_size()
+        else:
+            start_level = int(raw)
+    start_level = max(1, int(start_level))
+
+    if axis_index_groups is None:
+        member_groups = [list(range(n))]
+    else:
+        member_groups = [list(g) for g in axis_index_groups]
+    for g in member_groups:
+        if len(g) & (len(g) - 1):
+            raise ValueError(
+                f"Adasum requires power-of-two group sizes, got {len(g)} "
+                "(reference enforces the same: tensorflow/__init__.py:146)")
+    max_size = max(len(g) for g in member_groups)
+    if max_size == 1:
         return t
 
     orig_dtype = t.dtype
     v = t.astype(jnp.float32)
 
-    levels = int(n).bit_length() - 1
+    from horovod_tpu.ops.collective_ops import Sum, _grouped_reduce
+
+    levels = int(max_size).bit_length() - 1
     for k in range(levels):
         stride = 1 << k
         block = stride << 1
-        groups = []
-        for base in range(0, n, block):
-            for off in range(stride):
-                groups.append([base + off, base + off + stride])
-        from horovod_tpu.ops.collective_ops import Sum, _grouped_reduce
+        pair_groups = []
+        paired = []
+        for g in member_groups:
+            if stride < len(g):
+                for base in range(0, len(g), block):
+                    for off in range(stride):
+                        pair_groups.append(
+                            [g[base + off], g[base + off + stride]])
+                paired.extend(g)
+            else:
+                # finished groups / complement: singleton no-op reduces
+                # keep the partition covering the whole axis
+                pair_groups.extend([r] for r in g)
 
-        s = _grouped_reduce(v, Sum, axis_name, groups)  # a + b
-        partner = s - v
+        s = _grouped_reduce(v, Sum, axis_name, pair_groups)  # a + b
+        if stride < start_level:
+            # below start_level: plain average of the pair; members whose
+            # group is done (singletons) must keep their value
+            half = 0.5 * s
+            if len(paired) == n:
+                v = half
+            else:
+                idx = lax.axis_index(axis_name)
+                mask = jnp.isin(idx, jnp.asarray(paired))
+                v = jnp.where(mask, half, v)
+            continue
+        partner = s - v  # singletons: partner = 0 → combine is identity
         my_sq = jnp.sum(v * v)
         partner_sq = jnp.sum(partner * partner)
         dot = jnp.sum(v * partner)

@@ -193,6 +193,12 @@ def _traced_allreduce(t, op, axis, process_set, prescale, postscale):
     elif op is Adasum:
         from horovod_tpu.ops import adasum as _adasum
 
+        if groups is not None:
+            # ProcessSet groups are [set, complement]; the complement must
+            # pass through unchanged (and may not be power-of-two sized),
+            # so it participates as singletons
+            members, rest = groups[0], [r for g in groups[1:] for r in g]
+            groups = [list(members)] + [[r] for r in rest]
         r = _adasum.adasum_reduce(t, axis, axis_index_groups=groups)
     else:
         raise ValueError(f"unknown reduce op {op}")

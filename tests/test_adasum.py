@@ -89,3 +89,86 @@ def test_pairwise_helper():
     b = np.array([0.0, 1.0], np.float32)
     np.testing.assert_allclose(np.asarray(pairwise_adasum(a, b)),
                                [1.0, 1.0], rtol=1e-6)
+
+
+def test_adasum_process_subset(world_mesh):
+    """Adasum over a strict process subset (traced path): members combine
+    within the set; complement shards pass through unchanged."""
+    sub = hvt.add_process_set([0, 1, 2, 3])
+    rng = np.random.RandomState(7)
+    x = rng.randn(N, 5).astype(np.float32)
+    f = jax.jit(jax.shard_map(
+        lambda t: hvt.allreduce(t[0], op=hvt.Adasum,
+                                process_set=sub)[None],
+        mesh=world_mesh, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)))
+    out = np.asarray(f(x))
+    expected = np_adasum(list(x[:4]))
+    for r in range(4):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-4, atol=1e-5)
+    # complement untouched
+    np.testing.assert_allclose(out[4:], x[4:], rtol=1e-6)
+    hvt.remove_process_set(sub)
+
+
+def np_adasum_start_level(vs, start_level):
+    """Host model with the GPU start_level composition: levels below
+    start_level average, the rest adasum-combine (adasum.h:177-183)."""
+    vs = [v.astype(np.float64) for v in vs]
+    n = len(vs)
+    stride = 1
+    while stride < n:
+        out = list(vs)
+        for base in range(0, n, 2 * stride):
+            for off in range(stride):
+                i, j = base + off, base + off + stride
+                if stride < start_level:
+                    c = 0.5 * (vs[i] + vs[j])
+                else:
+                    c = np_adasum_pair(vs[i], vs[j])
+                out[i] = c
+                out[j] = c
+        vs = out
+        stride *= 2
+    return vs[0]
+
+
+def test_adasum_start_level_hierarchical(world_mesh):
+    """start_level=4 (e.g. 4 chips per host on an 8-chip world): local
+    levels average, only the cross-host level runs the adasum combine."""
+    from horovod_tpu.ops.adasum import adasum_reduce
+
+    rng = np.random.RandomState(13)
+    x = rng.randn(N, 6).astype(np.float32)
+    f = jax.jit(jax.shard_map(
+        lambda t: adasum_reduce(t[0], WORLD_AXIS, start_level=4)[None],
+        mesh=world_mesh, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)))
+    out = np.asarray(f(x))
+    expected = np_adasum_start_level(list(x), 4)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-4, atol=1e-5)
+    # sanity: start_level >= n degenerates to the plain mean
+    g = jax.jit(jax.shard_map(
+        lambda t: adasum_reduce(t[0], WORLD_AXIS, start_level=N)[None],
+        mesh=world_mesh, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)))
+    np.testing.assert_allclose(np.asarray(g(x))[0], x.mean(0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_subset_with_start_level(world_mesh):
+    """Process subset + start_level compose: the 4-member set averages at
+    level 0 then adasum-combines at level 1; complement untouched."""
+    from horovod_tpu.ops.adasum import adasum_reduce
+
+    sub_groups = [[0, 1, 2, 3], [4], [5], [6], [7]]
+    rng = np.random.RandomState(21)
+    x = rng.randn(N, 4).astype(np.float32)
+    f = jax.jit(jax.shard_map(
+        lambda t: adasum_reduce(t[0], WORLD_AXIS,
+                                axis_index_groups=sub_groups,
+                                start_level=2)[None],
+        mesh=world_mesh, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)))
+    out = np.asarray(f(x))
+    expected = np_adasum_start_level(list(x[:4]), 2)
+    for r in range(4):
+        np.testing.assert_allclose(out[r], expected, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(out[4:], x[4:], rtol=1e-6)

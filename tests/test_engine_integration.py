@@ -122,6 +122,18 @@ def test_adasum_2proc():
     """)
 
 
+def test_adasum_start_level_2proc():
+    """HVT_ADASUM_START_LEVEL: levels below it average instead of
+    adasum-combining (reference GPU composition, adasum.h:177-183) — with
+    2 ranks and start level 2, the result is the plain mean."""
+    run_workers("""
+        x = np.asarray([4.0, 0.0], np.float32) if r == 0 else \
+            np.asarray([0.0, 2.0], np.float32)
+        res = np.asarray(hvt.allreduce(x, op=hvt.Adasum, name="asl"))
+        np.testing.assert_allclose(res, [2.0, 1.0], rtol=1e-6)
+    """, extra_env={"HVT_ADASUM_START_LEVEL": "2"})
+
+
 def test_join_uneven_steps_2proc():
     # rank 1 runs fewer steps then joins; rank 0 keeps reducing
     # (reference Join semantics, operations.cc:1164)
