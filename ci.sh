@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# CI entry point — the single command rounds/reviewers run to validate the
+# tree (the reference pins its matrix in .buildkite/gen-pipeline.sh; this
+# is the same intent for one TPU/CPU host).
+#
+#   ./ci.sh            # full: build + tests + dryrun + bench smoke
+#   ./ci.sh --fast     # skip the bench smoke
+#
+# Stages:
+#   1. build the C++ core engine (csrc -> libhvt_core.so)
+#   2. full test suite (8-device virtual CPU mesh; includes the
+#      multi-process engine/launcher/elastic integration suites)
+#   3. driver multi-chip dryrun: dp/sp/tp + MoE ep + GPipe pp on an
+#      8-device mesh with exact single-device parity checks
+#   4. bench smoke: tiny ResNet block through bench.py end to end
+#      (CPU shapes; validates the harness, not the numbers)
+set -euo pipefail
+cd "$(dirname "$0")"
+
+FAST=0
+[[ "${1:-}" == "--fast" ]] && FAST=1
+
+echo "=== [1/4] build C++ engine ==="
+make -C horovod_tpu/csrc -j
+
+echo "=== [2/4] test suite ==="
+python -m pytest tests/ -x -q
+
+echo "=== [3/4] multi-chip dryrun (8 virtual devices) ==="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
+
+if [[ "$FAST" == "0" ]]; then
+  echo "=== [4/4] bench smoke (CPU harness validation) ==="
+  JAX_PLATFORMS=cpu python bench.py --model resnet50 --batch-size 2 \
+    --num-iters 1 --num-batches-per-iter 2 --image-size 32 --no-scaling
+else
+  echo "=== [4/4] bench smoke skipped (--fast) ==="
+fi
+
+echo "CI OK"

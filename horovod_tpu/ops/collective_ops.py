@@ -169,7 +169,15 @@ def _grouped_reduce(t, op, axis, groups):
     base = {Average: lax.psum, Sum: lax.psum, Min: lax.pmin,
             Max: lax.pmax}[op]
     out = jnp.full_like(t, identity)
+    # singletons reduce to themselves — no collective needed (adasum
+    # pairing emits one singleton per finished/complement rank, which
+    # would otherwise cost O(n) full-axis reduces here)
+    singles = [g[0] for g in groups if len(g) == 1]
+    if singles:
+        out = jnp.where(jnp.isin(idx, jnp.asarray(singles)), t, out)
     for g in groups:
+        if len(g) == 1:
+            continue
         member = jnp.isin(idx, jnp.asarray(g))
         contrib = jnp.where(member, t, identity)
         red = base(contrib, axis)

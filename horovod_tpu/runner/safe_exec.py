@@ -1,6 +1,7 @@
 """Robust child process management (reference
 ``horovod/runner/common/util/safe_shell_exec.py``: fork + process-group
-kill, event-driven termination, stdout/err forwarding)."""
+kill, event-driven termination, stdout/err forwarding, parent-death
+safety so a SIGKILLed launcher never leaks workers)."""
 
 from __future__ import annotations
 
@@ -11,13 +12,44 @@ import sys
 import threading
 import time
 
+_PR_SET_PDEATHSIG = 1  # linux/prctl.h
+
+# Resolve libc at import time: preexec_fn runs between fork() and exec()
+# where taking the import/allocator locks can deadlock a child forked
+# from a multithreaded launcher (subprocess docs' preexec warning).
+try:
+    import ctypes as _ctypes
+
+    _libc = _ctypes.CDLL(None, use_errno=True)
+    _libc.prctl  # resolve the symbol now, not post-fork
+except Exception:  # pragma: no cover - non-linux
+    _libc = None
+
+
+def _child_preexec():
+    """Runs in the forked child before exec: new session (own process
+    group, so terminate() can killpg) + PDEATHSIG so the kernel delivers
+    SIGTERM to the child if the launcher dies — including SIGKILL, which
+    the launcher cannot catch to clean up itself (reference
+    safe_shell_exec.py:60-140 achieves this with a middleman process;
+    prctl covers the same contract without one). PR_SET_PDEATHSIG
+    survives execve, so arbitrary worker commands are covered.
+
+    Note: the kernel ties PDEATHSIG to the spawning THREAD — callers must
+    spawn from a thread that outlives the child (both launcher paths do:
+    run_all spawns from the main thread; the elastic per-slot threads
+    block on child.wait())."""
+    os.setsid()
+    if _libc is not None:
+        _libc.prctl(_PR_SET_PDEATHSIG, signal.SIGTERM, 0, 0, 0)
+
 
 class Child:
     def __init__(self, cmd, env, tag=None, stdout=None):
         self.tag = tag
         self.proc = subprocess.Popen(
             cmd, env=env, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, start_new_session=True)
+            stderr=subprocess.STDOUT, preexec_fn=_child_preexec)
         self._pump = threading.Thread(target=self._forward,
                                       args=(stdout or sys.stdout,),
                                       daemon=True)

@@ -1,4 +1,10 @@
 """Spark integration (reference ``horovod/spark/__init__.py`` +
-``spark/runner.py:195`` ``run()`` — Spark tasks become job slots)."""
+``spark/runner.py:195`` ``run()`` — Spark tasks become job slots;
+estimator/store ecosystem per ``spark/common/store.py`` +
+``spark/keras/estimator.py`` / ``spark/torch/estimator.py``)."""
 
+from horovod_tpu.spark.estimator import (JaxEstimator, JaxModel,  # noqa: F401,E501
+                                         TorchEstimator, TorchModel)
 from horovod_tpu.spark.runner import (run, slot_envs_from_task_infos)  # noqa: F401,E501
+from horovod_tpu.spark.store import (DBFSLocalStore, FilesystemStore,  # noqa: F401,E501
+                                     HDFSStore, LocalStore, Store)

@@ -1,76 +1,144 @@
 """Spark ML Estimator API (reference ``spark/keras/estimator.py:106``
 KerasEstimator / ``spark/torch/estimator.py:91`` TorchEstimator:
-DataFrame → distributed fit → Spark Transformer).
+DataFrame → distributed fit → Spark Transformer, with ``Store``-backed
+checkpointing and callbacks plumbed into the executor training loop —
+reference ``spark/keras/remote.py`` / ``spark/torch/remote.py``).
+
+Two flavors:
+
+- :class:`JaxEstimator` — wraps a user ``train_fn`` (the JAX-native
+  analog of the reference's Keras flavor); the loop is the user's.
+- :class:`TorchEstimator` — owns an epoch-structured torch training loop
+  (module + optimizer factory + loss), gradients combined through
+  ``horovod_tpu.torch.DistributedOptimizer``, per-epoch checkpoints
+  published to the store via the local-scratch-dir + sync contract, and
+  ``callbacks`` with ``on_epoch_end(epoch, logs)`` invoked on rank 0.
 
 The reference materializes DataFrames through Petastorm stores
 (``spark/common/store.py``); TPU-natively the estimator converts the
 (feature, label) columns to per-partition numpy shards — each barrier
-task trains on its shard with gradients combined across tasks — and
-returns a ``JaxModel`` whose ``transform`` runs batched inference inside
-``mapPartitions``. Petastorm-scale out-of-core storage is out of scope;
-for datasets beyond executor memory, feed TFRecord/array files directly
-from the training fn instead."""
+task trains on its shard with gradients combined across tasks. Petastorm
+out-of-core storage is out of scope; for datasets beyond executor
+memory, feed TFRecord/array files from the training fn, using the
+store's data-path layout.
+
+Both estimators split fit into a Spark-facing ``fit(df)`` and a pure
+``_fit_arrays(X, y, run_fn=...)`` so the gated test rig exercises the
+full fit → checkpoint → load → transform round trip without pyspark
+(the Ray/Spark fake-test pattern)."""
 
 from __future__ import annotations
 
+import io
+import json
+import pickle
+import uuid
 from typing import Any, Callable, List, Optional
 
 
-class JaxEstimator:
-    """Minimal Spark estimator over a user-provided train step.
+def _pickle_dumps(obj) -> bytes:
+    """cloudpickle when available (ships with pyspark; required for
+    closures/lambdas), stdlib pickle otherwise."""
+    try:
+        import cloudpickle
+
+        return cloudpickle.dumps(obj)
+    except ImportError:
+        return pickle.dumps(obj)
+
+
+def _local_run(worker, num_proc=None, **_kw):
+    """In-process run_fn used by the fake test rig (world size 1)."""
+    return [worker()]
+
+
+def _collect_xy(df, feature_cols, label_col):
+    import numpy as np
+
+    rows = df.select(*feature_cols, label_col).collect()
+    X = np.asarray([[r[c] for c in feature_cols] for r in rows],
+                   dtype=np.float32)
+    y = np.asarray([r[label_col] for r in rows], dtype=np.float32)
+    return X, y
+
+
+class _EstimatorBase:
+    """Shared Spark-facing plumbing (collect → _fit_arrays → model)."""
+
+    def fit(self, df):
+        from horovod_tpu.spark.runner import _require_pyspark, run
+
+        _require_pyspark()
+        X, y = _collect_xy(df, self.feature_cols, self.label_col)
+        # ship the dataset once per executor (broadcast), not once per
+        # task via the function closure
+        sc = df.sparkSession.sparkContext
+        bc = sc.broadcast((X, y))
+
+        def run_fn(worker, num_proc=None, master_port=29575):
+            return run(worker, num_proc=num_proc, master_port=master_port)
+
+        # X/y must NOT also ride the worker closure (cloudpickle would
+        # serialize the captured cells per task, defeating the broadcast)
+        return self._fit_arrays(None, None, run_fn=run_fn, broadcast=bc)
+
+
+class JaxEstimator(_EstimatorBase):
+    """Spark estimator over a user-provided train step.
 
     Parameters
     - ``train_fn(shard_X, shard_y, epochs) -> (params, predict_fn)``:
       trains on the rank's shard (gradients allreduced via the live
       horovod_tpu runtime) and returns the final params plus a pure
       ``predict_fn(params, X) -> scalar-per-row predictions``; must be
-      cloudpickle-able.
+      picklable (cloudpickle under pyspark).
     - ``feature_cols`` / ``label_col``: DataFrame columns.
     - ``num_proc``: world size (default: spark default parallelism).
     - ``epochs``: passes over each shard.
+    - ``store`` / ``run_id``: when given, the fitted model is published
+      to ``store.get_checkpoint_path(run_id)`` and can be restored with
+      :meth:`JaxModel.load`.
     """
 
     def __init__(self, train_fn: Callable, feature_cols: List[str],
                  label_col: str, num_proc: Optional[int] = None,
-                 epochs: int = 1, master_port: int = 29575):
+                 epochs: int = 1, master_port: int = 29575,
+                 store=None, run_id: Optional[str] = None):
         self.train_fn = train_fn
         self.feature_cols = list(feature_cols)
         self.label_col = label_col
         self.num_proc = num_proc
         self.epochs = epochs
         self.master_port = master_port
+        self.store = store
+        self.run_id = run_id
 
-    def fit(self, df) -> "JaxModel":
-        from horovod_tpu.spark.runner import _require_pyspark, run
-
-        _require_pyspark()
-        import numpy as np
-
-        feature_cols, label_col = self.feature_cols, self.label_col
-        rows = df.select(*feature_cols, label_col).collect()
-        X = np.asarray([[r[c] for c in feature_cols] for r in rows],
-                       dtype=np.float32)
-        y = np.asarray([r[label_col] for r in rows], dtype=np.float32)
+    def _fit_arrays(self, X, y, run_fn=None, broadcast=None) -> "JaxModel":
         train_fn, epochs = self.train_fn, self.epochs
-        # ship the dataset once per executor (broadcast), not once per
-        # task via the function closure
-        sc = df.sparkSession.sparkContext
-        bc = sc.broadcast((X, y))
+        run_fn = run_fn or _local_run
+        bc = broadcast
 
         def worker():
             import horovod_tpu as hvt
 
-            bx, by = bc.value
-            n = hvt.size()
-            r = hvt.rank()
+            bx, by = bc.value if bc is not None else (X, y)
+            n, r = hvt.size(), hvt.rank()
             return train_fn(bx[r::n], by[r::n], epochs)
 
-        results = run(worker, num_proc=self.num_proc,
-                      master_port=self.master_port)
+        results = run_fn(worker, num_proc=self.num_proc,
+                         master_port=self.master_port)
         # all ranks end with identical params (allreduced training);
         # rank 0's result is the model
         params, predict_fn = results[0]
-        return JaxModel(params, predict_fn, self.feature_cols)
+        model = JaxModel(params, predict_fn, self.feature_cols)
+        if self.store is not None:
+            run_id = self.run_id or f"jax-{uuid.uuid4().hex[:8]}"
+            self.run_id = run_id
+            self.store.write(
+                self.store.get_checkpoint_path(run_id),
+                _pickle_dumps({"params": params, "predict_fn": predict_fn,
+                               "feature_cols": self.feature_cols}))
+        return model
 
 
 class JaxModel:
@@ -84,6 +152,20 @@ class JaxModel:
         self.predict_fn = predict_fn
         self.feature_cols = list(feature_cols)
         self.output_col = output_col
+
+    @classmethod
+    def load(cls, store, run_id: str, output_col: str = "prediction"
+             ) -> "JaxModel":
+        """Restore a fitted model from the store (reference estimators
+        read back through Store the same way)."""
+        blob = pickle.loads(store.read(store.get_checkpoint_path(run_id)))
+        return cls(blob["params"], blob["predict_fn"],
+                   blob["feature_cols"], output_col=output_col)
+
+    def _predict_arrays(self, X):
+        import numpy as np
+
+        return np.asarray(self.predict_fn(self.params, X))
 
     def transform(self, df):
         from horovod_tpu.spark.runner import _require_pyspark
@@ -111,6 +193,198 @@ class JaxModel:
         # explicit schema: inference from an empty RDD fails, and the
         # empty-input case must still yield a DataFrame with the
         # prediction column
+        schema = StructType(df.schema.fields
+                            + [StructField(output_col, DoubleType())])
+        return df.sparkSession.createDataFrame(
+            df.rdd.mapPartitions(infer), schema)
+
+
+class TorchEstimator(_EstimatorBase):
+    """Torch-flavor estimator owning the training loop (reference
+    ``spark/torch/estimator.py:91`` + the executor loop in
+    ``spark/torch/remote.py``).
+
+    Parameters
+    - ``model``: a ``torch.nn.Module`` (its initial weights are the
+      starting point on every rank — broadcast from rank 0).
+    - ``optimizer_fn(params) -> torch.optim.Optimizer``.
+    - ``loss_fn(pred, target) -> scalar tensor`` (default MSE).
+    - ``epochs`` / ``batch_size``: loop shape.
+    - ``store`` / ``run_id``: per-epoch checkpoints are written to a
+      local scratch dir and published via ``store.sync_fn`` (the
+      reference's remote-training contract); final weights land at
+      ``store.get_checkpoint_path(run_id)``.
+    - ``callbacks``: objects with ``on_epoch_end(epoch, logs)`` —
+      invoked on rank 0 with ``logs={"loss": float}``.
+    """
+
+    def __init__(self, model, optimizer_fn: Callable,
+                 feature_cols: List[str], label_col: str,
+                 loss_fn: Optional[Callable] = None,
+                 num_proc: Optional[int] = None, epochs: int = 1,
+                 batch_size: int = 32, master_port: int = 29576,
+                 store=None, run_id: Optional[str] = None,
+                 callbacks: Optional[list] = None):
+        self.model = model
+        self.optimizer_fn = optimizer_fn
+        self.loss_fn = loss_fn
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.master_port = master_port
+        self.store = store
+        self.run_id = run_id or f"torch-{uuid.uuid4().hex[:8]}"
+        self.callbacks = list(callbacks or [])
+
+    def _fit_arrays(self, X, y, run_fn=None, broadcast=None
+                    ) -> "TorchModel":
+        import torch
+
+        run_fn = run_fn or _local_run
+        model_blob = _pickle_dumps(self.model)
+        optimizer_fn, loss_fn = self.optimizer_fn, self.loss_fn
+        epochs, batch_size = self.epochs, self.batch_size
+        store, run_id = self.store, self.run_id
+        callbacks = self.callbacks
+        bc = broadcast
+
+        def worker():
+            import numpy as np
+            import torch
+
+            import horovod_tpu as hvt
+            import horovod_tpu.torch as hvt_torch
+
+            bx, by = bc.value if bc is not None else (X, y)
+            n, r = hvt.size(), hvt.rank()
+            sx = torch.from_numpy(np.ascontiguousarray(bx[r::n]))
+            sy = torch.from_numpy(np.ascontiguousarray(by[r::n]))
+            model = pickle.loads(model_blob)
+            opt = hvt_torch.DistributedOptimizer(
+                optimizer_fn(model.parameters()),
+                named_parameters=model.named_parameters())
+            hvt_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+            lf = loss_fn or torch.nn.functional.mse_loss
+
+            def train_epochs(ckpt_dir=None, on_epoch=None):
+                history = []
+                for epoch in range(epochs):
+                    perm = torch.randperm(
+                        len(sx), generator=torch.Generator().manual_seed(
+                            1000 + epoch))
+                    total, batches = 0.0, 0
+                    for i in range(0, len(sx), batch_size):
+                        idx = perm[i:i + batch_size]
+                        opt.zero_grad()
+                        pred = model(sx[idx])
+                        loss = lf(pred.reshape(-1), sy[idx].reshape(-1))
+                        loss.backward()
+                        opt.step()
+                        total += float(loss.detach())
+                        batches += 1
+                    logs = {"loss": total / max(batches, 1)}
+                    history.append(logs)
+                    if r == 0:
+                        for cb in callbacks:
+                            cb.on_epoch_end(epoch, dict(logs))
+                        if ckpt_dir is not None:
+                            torch.save(model.state_dict(),
+                                       f"{ckpt_dir}/checkpoint-{epoch}.pt")
+                            if on_epoch is not None:
+                                # publish NOW: a failure at epoch k must
+                                # not lose checkpoints 0..k-1 (reference
+                                # remote.py publishes each epoch)
+                                on_epoch()
+                return history
+
+            if store is not None and r == 0:
+                sync = store.sync_fn(run_id)
+                with store.get_local_output_dir_fn(run_id)() as d:
+                    history = train_epochs(ckpt_dir=d, on_epoch=lambda:
+                                           sync(d))
+            else:
+                history = train_epochs()
+            return model.state_dict(), history
+
+        results = run_fn(worker, num_proc=self.num_proc,
+                         master_port=self.master_port)
+        state_dict, history = results[0]
+        model = pickle.loads(model_blob)
+        model.load_state_dict(state_dict)
+        if store is not None:
+            buf = io.BytesIO()
+            torch.save(model.state_dict(), buf)
+            store.write(store.get_checkpoint_path(run_id), buf.getvalue())
+            store.write(
+                store.get_run_path(run_id) + "/meta.json",
+                json.dumps({"feature_cols": self.feature_cols,
+                            "label_col": self.label_col}).encode())
+            store.write(
+                store.get_logs_path(run_id) + "/history.json",
+                json.dumps(history).encode())
+        return TorchModel(model, self.feature_cols)
+
+
+class TorchModel:
+    """Transformer produced by ``TorchEstimator.fit``."""
+
+    def __init__(self, model, feature_cols: List[str],
+                 output_col: str = "prediction"):
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.output_col = output_col
+
+    @classmethod
+    def load(cls, store, run_id: str, model, feature_cols=None,
+             output_col: str = "prediction") -> "TorchModel":
+        """Restore weights from the store into ``model`` (an instance of
+        the architecture that was fitted); feature_cols default to the
+        ones persisted at fit time."""
+        import torch
+
+        blob = store.read(store.get_checkpoint_path(run_id))
+        model.load_state_dict(torch.load(io.BytesIO(blob)))
+        if feature_cols is None:
+            meta = json.loads(store.read(
+                store.get_run_path(run_id) + "/meta.json"))
+            feature_cols = meta["feature_cols"]
+        return cls(model, feature_cols=list(feature_cols),
+                   output_col=output_col)
+
+    def _predict_arrays(self, X):
+        import numpy as np
+        import torch
+
+        self.model.eval()
+        with torch.no_grad():
+            out = self.model(torch.from_numpy(
+                np.ascontiguousarray(np.asarray(X, np.float32))))
+        return out.reshape(len(X), -1).squeeze(-1).numpy()
+
+    def transform(self, df):
+        from horovod_tpu.spark.runner import _require_pyspark
+
+        _require_pyspark()
+        import numpy as np
+        from pyspark.sql import Row
+        from pyspark.sql.types import DoubleType, StructField, StructType
+
+        feature_cols, output_col = self.feature_cols, self.output_col
+        predict = self._predict_arrays
+
+        def infer(rows_iter):
+            rows = list(rows_iter)
+            if not rows:
+                return
+            X = np.asarray([[r[c] for c in feature_cols] for r in rows],
+                           dtype=np.float32)
+            for r, p in zip(rows, predict(X).tolist()):
+                d = r.asDict()
+                d[output_col] = float(p)
+                yield Row(**d)
+
         schema = StructType(df.schema.fields
                             + [StructField(output_col, DoubleType())])
         return df.sparkSession.createDataFrame(

@@ -1,0 +1,278 @@
+"""Storage abstraction for Spark estimators (reference
+``horovod/spark/common/store.py``: ``Store`` / ``FilesystemStore`` /
+``LocalStore`` / ``HDFSStore`` / ``DBFSLocalStore``).
+
+A Store owns the layout under a prefix path:
+
+    <prefix>/intermediate_train_data[.<idx>]   training data
+    <prefix>/intermediate_val_data[.<idx>]     validation data
+    <prefix>/runs/<run_id>/checkpoint.<ext>    per-run checkpoints
+    <prefix>/runs/<run_id>/logs                per-run logs
+
+plus the executor-side contract the estimators use: a local scratch dir
+per run (``get_local_output_dir_fn``) and a ``sync_fn`` that publishes it
+into the store — on a shared/local filesystem that is a copy; remote
+flavors override ``exists/read/write/sync_fn``.
+
+The reference materializes DataFrames into Petastorm parquet under the
+data paths; the TPU estimators keep datasets in memory (see
+``estimator.py``), so the data-path API exists for layout parity and
+user code, while checkpoints/logs are fully used."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+
+
+class Store:
+    """Interface (reference ``store.py:32``)."""
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Factory by path scheme (reference ``store.py:144``)."""
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith("dbfs:/"):
+            return DBFSLocalStore(prefix_path, *args, **kwargs)
+        return FilesystemStore(prefix_path, *args, **kwargs)
+
+    # -- layout ------------------------------------------------------------
+
+    def get_train_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_test_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_runs_path(self) -> str:
+        raise NotImplementedError
+
+    def get_run_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_filename(self) -> str:
+        return "checkpoint.bin"
+
+    # -- io ----------------------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes):
+        raise NotImplementedError
+
+    # -- executor-side contract -------------------------------------------
+
+    def get_local_output_dir_fn(self, run_id: str):
+        """Context manager yielding a scratch dir on the executor; used
+        with ``sync_fn`` (reference ``store.py:109``)."""
+
+        @contextlib.contextmanager
+        def local_dir():
+            d = tempfile.mkdtemp(prefix=f"hvt_run_{run_id}_")
+            try:
+                yield d
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+
+        return local_dir
+
+    def sync_fn(self, run_id: str):
+        """Returns ``sync(local_dir)`` publishing the scratch dir into the
+        run path (reference ``store.py:112``)."""
+        raise NotImplementedError
+
+
+class FilesystemStore(Store):
+    """Store over a locally-mounted filesystem path — local disk, NFS, or
+    any fuse mount (reference ``FilesystemStore:153`` / ``LocalStore``)."""
+
+    def __init__(self, prefix_path: str, train_path=None, val_path=None,
+                 test_path=None, runs_path=None):
+        self.prefix_path = self._localize(prefix_path)
+        self._train = train_path or os.path.join(self.prefix_path,
+                                                 "intermediate_train_data")
+        self._val = val_path or os.path.join(self.prefix_path,
+                                             "intermediate_val_data")
+        self._test = test_path or os.path.join(self.prefix_path,
+                                               "intermediate_test_data")
+        self._runs = runs_path or os.path.join(self.prefix_path, "runs")
+
+    @staticmethod
+    def _localize(path: str) -> str:
+        if path.startswith("file://"):
+            return path[len("file://"):]
+        return path
+
+    @staticmethod
+    def _with_idx(path: str, idx) -> str:
+        return path if idx is None else f"{path}.{idx}"
+
+    def get_train_data_path(self, idx=None) -> str:
+        return self._with_idx(self._train, idx)
+
+    def get_val_data_path(self, idx=None) -> str:
+        return self._with_idx(self._val, idx)
+
+    def get_test_data_path(self, idx=None) -> str:
+        return self._with_idx(self._test, idx)
+
+    def get_runs_path(self) -> str:
+        return self._runs
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self._runs, run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id),
+                            self.get_checkpoint_filename())
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(self._localize(path))
+
+    def read(self, path: str) -> bytes:
+        with open(self._localize(path), "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        path = self._localize(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)  # atomic publish
+
+    def sync_fn(self, run_id: str):
+        run_path = self.get_run_path(run_id)
+
+        def sync(local_dir: str):
+            os.makedirs(run_path, exist_ok=True)
+            for root, _dirs, files in os.walk(local_dir):
+                rel = os.path.relpath(root, local_dir)
+                dst_dir = (run_path if rel == "."
+                           else os.path.join(run_path, rel))
+                os.makedirs(dst_dir, exist_ok=True)
+                for fn in files:
+                    shutil.copy2(os.path.join(root, fn),
+                                 os.path.join(dst_dir, fn))
+
+        return sync
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Databricks DBFS through its local fuse mount (reference
+    ``DBFSLocalStore``): ``dbfs:/path`` ↔ ``/dbfs/path``."""
+
+    @staticmethod
+    def _localize(path: str) -> str:
+        if path.startswith("dbfs:/"):
+            return "/dbfs/" + path[len("dbfs:/"):].lstrip("/")
+        return FilesystemStore._localize(path)
+
+
+class HDFSStore(Store):
+    """HDFS-backed store via pyarrow (reference ``HDFSStore``). Gated:
+    raises a clear ImportError when pyarrow's HDFS support is absent."""
+
+    def __init__(self, prefix_path: str, **hdfs_kwargs):
+        try:
+            from pyarrow import fs as pafs
+        except ImportError as e:  # pragma: no cover - env without pyarrow
+            raise ImportError(
+                "HDFSStore requires pyarrow; use FilesystemStore over an "
+                "NFS/fuse mount instead") from e
+        # hdfs://[host[:port]]/path — the URL authority names the
+        # namenode (reference HDFSStore parses it the same way);
+        # hdfs:///path falls back to the ambient Hadoop config
+        rest = prefix_path[len("hdfs://"):]
+        authority, _, path = rest.partition("/")
+        host = hdfs_kwargs.pop("host", None)
+        port = hdfs_kwargs.pop("port", None)
+        if authority:
+            if ":" in authority:
+                ahost, aport = authority.rsplit(":", 1)
+                host = host or ahost
+                port = port if port is not None else int(aport)
+            else:
+                host = host or authority
+        kw = dict(hdfs_kwargs)
+        if port is not None:
+            kw["port"] = port
+        self._fs = pafs.HadoopFileSystem(host or "default", **kw)
+        self.prefix_path = "/" + path
+        self._runs = self.prefix_path.rstrip("/") + "/runs"
+
+    def get_train_data_path(self, idx=None) -> str:
+        p = self.prefix_path.rstrip("/") + "/intermediate_train_data"
+        return p if idx is None else f"{p}.{idx}"
+
+    def get_val_data_path(self, idx=None) -> str:
+        p = self.prefix_path.rstrip("/") + "/intermediate_val_data"
+        return p if idx is None else f"{p}.{idx}"
+
+    def get_test_data_path(self, idx=None) -> str:
+        p = self.prefix_path.rstrip("/") + "/intermediate_test_data"
+        return p if idx is None else f"{p}.{idx}"
+
+    def get_runs_path(self) -> str:
+        return self._runs
+
+    def get_run_path(self, run_id: str) -> str:
+        return f"{self._runs}/{run_id}"
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/{self.get_checkpoint_filename()}"
+
+    def get_logs_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/logs"
+
+    def exists(self, path: str) -> bool:
+        from pyarrow import fs as pafs
+
+        info = self._fs.get_file_info([path])[0]
+        return info.type != pafs.FileType.NotFound
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes):
+        self._fs.create_dir(os.path.dirname(path), recursive=True)
+        with self._fs.open_output_stream(path) as f:
+            f.write(data)
+
+    def sync_fn(self, run_id: str):
+        run_path = self.get_run_path(run_id)
+
+        def sync(local_dir: str):
+            for root, _dirs, files in os.walk(local_dir):
+                rel = os.path.relpath(root, local_dir)
+                dst_dir = (run_path if rel == "."
+                           else f"{run_path}/{rel}")
+                for fn in files:
+                    with open(os.path.join(root, fn), "rb") as f:
+                        self.write(f"{dst_dir}/{fn}", f.read())
+
+        return sync
+
+
+# reference exposes LocalStore as an alias of the filesystem flavor
+LocalStore = FilesystemStore

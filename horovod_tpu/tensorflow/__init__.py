@@ -8,10 +8,18 @@ strictly more capable than the out-of-graph TF custom-op design. A torch
 binding (``horovod_tpu.torch``) covers eager-style training.
 
 When TensorFlow is importable, this module exposes the eager-mode subset
-of the reference API (rank/size topology, allreduce/allgather/broadcast
-on ``tf.Tensor`` via zero-copy numpy bridging, and broadcast_variables);
-graph-mode custom ops are not provided — use the JAX binding for compiled
-training on TPU."""
+of the reference API: rank/size topology, allreduce/allgather/broadcast
+on ``tf.Tensor`` via numpy bridging, ``broadcast_variables``,
+``DistributedGradientTape`` (reference ``tensorflow/__init__.py:673``)
+and an eager ``DistributedOptimizer`` wrapping ``apply_gradients``
+(reference ``:396-568``). Graph-mode custom ops are not provided — use
+the JAX binding for compiled training on TPU.
+
+The gradient plumbing (reduce list-of-grads with compression, sparse
+allgather path, local aggregation) is numpy-level and framework-agnostic,
+so the gated tests exercise it with fakes even where TF is absent — the
+same pattern as the Ray/Spark suites. The numpy bridge loses device
+placement and in-graph gradients by design; see README limits."""
 
 from __future__ import annotations
 
@@ -22,9 +30,12 @@ except ImportError:  # pragma: no cover - environment without TF
     _tf = None
     _TF_AVAILABLE = False
 
+import numpy as np
+
 from horovod_tpu.common.basics import (cross_rank, cross_size,  # noqa: F401
                                        init, is_initialized, local_rank,
                                        local_size, rank, shutdown, size)
+from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
 
 
 def _require_tf():
@@ -85,9 +96,204 @@ def broadcast_variables(variables, root_rank=0):
                            name=f"bcast_var_{i}"))
 
 
-def DistributedOptimizer(*args, **kwargs):
-    _require_tf()
-    raise NotImplementedError(
-        "graph-mode TF DistributedOptimizer is not provided; TPU-compiled "
-        "training uses horovod_tpu.jax.DistributedOptimizer (the XLA "
-        "collectives replace the TF custom-op engine path)")
+# --------------------------------------------------------------------------
+# gradient plumbing (framework-agnostic core, numpy transport)
+# --------------------------------------------------------------------------
+
+def _is_indexed_slices(g) -> bool:
+    """Duck-typed tf.IndexedSlices (works for the numpy fakes too)."""
+    return hasattr(g, "values") and hasattr(g, "indices")
+
+
+def _to_framework(arr, like):
+    """Convert a numpy result back toward the caller's framework: real TF
+    gets a tf.Tensor; fakes/numpy stay numpy."""
+    if _TF_AVAILABLE and like is not None and not isinstance(
+            like, np.ndarray):
+        return _tf.convert_to_tensor(arr)
+    return arr
+
+
+def _allreduce_grads(grads, op=None, compression=Compression.none,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=None, name_prefix="grad"):
+    """Reduce a list of gradients (None entries pass through; IndexedSlices
+    take the sparse allgather path — reference
+    ``tensorflow/__init__.py:92-108``)."""
+    from horovod_tpu.ops import collective_ops as C
+    from horovod_tpu.ops.sparse import sparse_allreduce
+
+    op = op or C.Average
+    ps = process_set or C.global_process_set
+    outs = []
+    for i, g in enumerate(grads):
+        if g is None:
+            outs.append(None)
+            continue
+        if _is_indexed_slices(g):
+            gi, gv = sparse_allreduce(
+                np.asarray(g.indices), np.asarray(g.values),
+                average=op is C.Average, name=f"{name_prefix}.{i}",
+                process_set=ps)
+            gi, gv = np.asarray(gi), np.asarray(gv)
+            if _TF_AVAILABLE and not isinstance(g.values, np.ndarray):
+                outs.append(_tf.IndexedSlices(
+                    _tf.convert_to_tensor(gv), _tf.convert_to_tensor(gi),
+                    dense_shape=getattr(g, "dense_shape", None)))
+            else:
+                # fakes: same type rebuilt as (values, indices)
+                outs.append(type(g)(gv, gi))
+            continue
+        arr, ctx = compression.compress(np.asarray(g))
+        red = C.allreduce(arr, op=op, name=f"{name_prefix}.{i}",
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=ps)
+        outs.append(_to_framework(
+            compression.decompress(np.asarray(red), ctx), g))
+    return outs
+
+
+class DistributedGradientTape:
+    """Wrap a ``tf.GradientTape`` so ``.gradient()`` returns
+    allreduce-averaged gradients (reference
+    ``tensorflow/__init__.py:673-742`` ``_DistributedGradientTape``).
+
+    Accepts any tape-like object exposing ``gradient`` — real
+    ``tf.GradientTape`` when TF is installed, a fake in the gated tests.
+    """
+
+    def __init__(self, gradtape, device_dense="", device_sparse="",
+                 compression=Compression.none, op=None,
+                 prescale_factor=1.0, postscale_factor=1.0,
+                 process_set=None):
+        del device_dense, device_sparse  # placement is XLA's concern here
+        self._tape = gradtape
+        self._compression = compression
+        self._op = op
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        self._process_set = process_set
+        self._name_seq = 0
+
+    # context-manager + attribute passthrough (watch, stop_recording, ...)
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        single = not isinstance(grads, (list, tuple))
+        glist = [grads] if single else list(grads)
+        self._name_seq += 1
+        outs = _allreduce_grads(
+            glist, op=self._op, compression=self._compression,
+            prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
+            process_set=self._process_set,
+            name_prefix=f"DistributedGradientTape.{self._name_seq}")
+        return outs[0] if single else outs
+
+
+class _DistributedOptimizer:
+    """Eager optimizer wrapper: allreduce gradients in
+    ``apply_gradients`` before delegating to the wrapped optimizer —
+    the eager analog of the reference's ``_DistributedOptimizer``
+    (``tensorflow/__init__.py:396``) with
+    ``backward_passes_per_step`` local aggregation (reference
+    ``gradient_aggregation_eager.py``)."""
+
+    def __init__(self, optimizer, compression=Compression.none, op=None,
+                 backward_passes_per_step=1,
+                 average_aggregated_gradients=False,
+                 prescale_factor=1.0, postscale_factor=1.0,
+                 process_set=None):
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._opt = optimizer
+        self._compression = compression
+        self._op = op
+        self.backward_passes_per_step = backward_passes_per_step
+        self._average_aggregated = average_aggregated_gradients
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+        self._process_set = process_set
+        self._agg = None       # list of numpy accumulators (None for None)
+        self._agg_count = 0
+        self._apply_seq = 0
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def _aggregate(self, grads):
+        if self._agg is None:
+            self._agg = [None if g is None else np.asarray(g).copy()
+                         for g in grads]
+        else:
+            if len(grads) != len(self._agg):
+                raise ValueError(
+                    "apply_gradients called with a different number of "
+                    "gradients than the aggregation in flight")
+            for i, g in enumerate(grads):
+                if g is not None:
+                    if self._agg[i] is None:
+                        self._agg[i] = np.asarray(g).copy()
+                    else:
+                        self._agg[i] = self._agg[i] + np.asarray(g)
+        self._agg_count += 1
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        gv = list(grads_and_vars)
+        grads = [g for g, _ in gv]
+        variables = [v for _, v in gv]
+        if any(_is_indexed_slices(g) for g in grads if g is not None) and \
+                self.backward_passes_per_step > 1:
+            raise ValueError(
+                "backward_passes_per_step > 1 does not support sparse "
+                "(IndexedSlices) gradients")
+        self._apply_seq += 1
+        if self.backward_passes_per_step > 1:
+            self._aggregate(grads)
+            if self._agg_count < self.backward_passes_per_step:
+                return None  # aggregation step: no variable update
+            grads = self._agg
+            if self._average_aggregated:
+                grads = [None if g is None
+                         else g / self.backward_passes_per_step
+                         for g in grads]
+            self._agg = None
+            self._agg_count = 0
+        reduced = _allreduce_grads(
+            grads, op=self._op, compression=self._compression,
+            prescale_factor=self._prescale,
+            postscale_factor=self._postscale,
+            process_set=self._process_set,
+            name_prefix=f"DistributedOptimizer.{self._apply_seq}")
+        return self._opt.apply_gradients(zip(reduced, variables), **kwargs)
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=None,
+                         average_aggregated_gradients=False,
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         process_set=None):
+    """Wrap an (eager/keras-style) optimizer so ``apply_gradients``
+    exchanges gradients across workers first (reference
+    ``tensorflow/__init__.py:568``). Graph-mode (TF1 ``compute_gradients``
+    rewriting) is not provided — use ``DistributedGradientTape`` for
+    custom loops, or the JAX binding for compiled TPU training."""
+    del name, use_locking, device_dense, device_sparse
+    return _DistributedOptimizer(
+        optimizer, compression=compression, op=op,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)

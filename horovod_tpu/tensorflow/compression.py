@@ -1,0 +1,61 @@
+"""Gradient compression for the TF binding (reference
+``horovod/tensorflow/compression.py``: ``Compressor`` /
+``NoneCompressor`` / ``FP16Compressor:46``).
+
+The transport under this binding is the numpy bridge, so compression
+operates at the numpy level: it applies identically to real ``tf.Tensor``
+inputs (converted on entry) and to the numpy fakes the gated tests use."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compressor:
+    """Interface: compress before the wire, decompress after."""
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, ctx) where ctx is whatever
+        ``decompress`` needs to restore the original form."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Halve wire bytes for floating gradients; non-float dtypes pass
+    through (same guard as the reference)."""
+
+    @staticmethod
+    def compress(tensor):
+        arr = np.asarray(tensor)
+        if np.issubdtype(arr.dtype, np.floating) and arr.dtype != np.float16:
+            return arr.astype(np.float16), arr.dtype
+        return arr, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return np.asarray(tensor).astype(ctx)
+
+
+class Compression:
+    """Namespace mirroring the reference's ``Compression.none`` /
+    ``Compression.fp16`` selection API."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor

@@ -119,3 +119,50 @@ def test_rendezvous_server_roundtrip():
             assert e.code == 404
     finally:
         srv.stop()
+
+
+def test_launcher_sigkill_leaves_no_orphan_workers(tmp_path):
+    """SIGKILL the launcher mid-job: workers must die via PDEATHSIG, not
+    leak (reference safe_shell_exec.py:60-140 parent-death contract)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import os, sys, time\n"
+        "print(f'WPID {os.getpid()}', flush=True)\n"
+        "time.sleep(120)\n")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    launcher = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         sys.executable, str(worker)],
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    pids = []
+    deadline = time.time() + 30
+    while len(pids) < 2 and time.time() < deadline:
+        line = launcher.stdout.readline()
+        if "WPID" in line:
+            pids.append(int(line.rsplit(" ", 1)[1]))
+    assert len(pids) == 2, f"workers did not start (got {pids})"
+    launcher.kill()  # SIGKILL: launcher gets NO chance to clean up
+    launcher.wait()
+    deadline = time.time() + 10
+    alive = set(pids)
+    while alive and time.time() < deadline:
+        for pid in list(alive):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                alive.discard(pid)
+        time.sleep(0.2)
+    for pid in alive:  # cleanup before failing
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    assert not alive, f"orphan workers survived launcher SIGKILL: {alive}"
