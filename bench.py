@@ -27,6 +27,13 @@ Prints ONE JSON line:
 vs_baseline denominator: the reference's only published absolute number,
 1656.82 img/sec for ResNet-101 on 16 GPUs (``docs/benchmarks.rst:43``)
 = 103.55 img/sec/device.
+
+Profile notes (real v5-lite chip, bs=512/step trace): convolutions run at
+~89% of the in-harness matmul ceiling; the residual is the fp32
+BatchNorm statistics passes (convert+reduce over activations, ~18% of
+step — measured by comparing against running-stats-only execution).
+Keeping fp32 statistics is a deliberate accuracy/parity choice (the
+reference's fp16 recipes also keep BN in fp32).
 """
 
 import argparse
@@ -190,8 +197,10 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101"])
-    p.add_argument("--batch-size", type=int, default=128,
-                   help="per-chip batch size")
+    p.add_argument("--batch-size", type=int, default=256,
+                   help="per-chip batch size (256 measured best on v5-lite:"
+                        " MFU 0.38 vs 0.34 at 128; BN statistics passes "
+                        "are the residual non-conv cost — see docstring)")
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--fp32", action="store_true",

@@ -32,7 +32,7 @@ int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,
                const long long* dims, const void* data, long long nbytes,
                int root_rank, double prescale, double postscale,
                int nsplits, const long long* splits, int group_id,
-               int group_size) {
+               int group_size, int n_members, const long long* members) {
   auto e = std::make_shared<TensorTableEntry>();
   e->name = name ? name : "";
   e->op = static_cast<OpType>(op);
@@ -49,6 +49,7 @@ int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,
   for (int i = 0; i < nsplits; ++i) e->splits.push_back(splits[i]);
   e->group_id = group_id;
   e->group_size = group_size;
+  for (int i = 0; i < n_members; ++i) e->members.push_back(members[i]);
   return Engine::Get().Submit(std::move(e));
 }
 

@@ -165,6 +165,10 @@ struct TensorTableEntry {
   // group_id < 0 → ungrouped. group_size = total members of the group.
   int32_t group_id = -1;
   int32_t group_size = 0;
+  // process set: global ranks participating in this collective
+  // (ascending); empty → the global set. Mirrors the later-lineage
+  // horovod ProcessSet on the eager path.
+  std::vector<int64_t> members;
 };
 
 using EntryPtr = std::shared_ptr<TensorTableEntry>;

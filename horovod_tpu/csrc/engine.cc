@@ -307,10 +307,13 @@ bool Engine::RunCycle() {
     r.splits = e->splits;
     r.group_id = e->group_id;
     r.group_size = e->group_size;
-    // Only ungrouped ALLREDUCE is cacheable: its execution params are
-    // fully rank-symmetric. allgather/alltoall rows vary per call and per
-    // rank; grouped tensors renegotiate as an atomic unit each time.
-    int32_t pos = (e->op == OpType::ALLREDUCE && e->group_id < 0)
+    r.members = e->members;
+    // Only ungrouped, global-set ALLREDUCE is cacheable: its execution
+    // params are fully rank-symmetric. allgather/alltoall rows vary per
+    // call and per rank; grouped tensors renegotiate as an atomic unit;
+    // process-set responses carry membership the cache does not key on.
+    int32_t pos = (e->op == OpType::ALLREDUCE && e->group_id < 0 &&
+                   e->members.empty())
                       ? cache_.Lookup(r)
                       : ResponseCache::kMiss;
     if (pos >= 0 && !join_pending_) {
@@ -441,7 +444,16 @@ std::vector<Response> Engine::Coordinate(
     for (auto pos : invalids)
       if (pos >= 0) pending_evictions_.push_back(pos);
     for (auto& q : reqs) {
-      auto& tc = counts_[q.name];
+      // negotiation state is keyed by (name, process set): different
+      // sets may legitimately reuse a tensor name (each rank belongs to
+      // at most one of them for a given name — its local pending table
+      // dedups by name)
+      std::string ck = q.name;
+      if (!q.members.empty()) {
+        ck += '\x01';
+        for (auto mr : q.members) ck += std::to_string(mr) + ",";
+      }
+      auto& tc = counts_[ck];
       if (tc.seen.empty()) tc.seen.assign(size_, false);
       if (tc.seen[r]) continue;
       tc.seen[r] = true;
@@ -459,6 +471,104 @@ std::vector<Response> Engine::Coordinate(
   int active = 0;
   for (int r = 0; r < size_; ++r)
     if (!rank_joined_[r]) active++;
+
+  // cross-set conflict check: the same tensor name pending under two
+  // DIFFERENT process sets that share a rank means the ranks disagree on
+  // the set — deliver a per-tensor ERROR instead of letting both
+  // negotiations starve (disjoint sets may legitimately reuse names).
+  // The ERROR fires only once every active member of every conflicting
+  // entry has announced the name — earlier, a member whose submission is
+  // still in its local queue would miss the broadcast (the response
+  // targets pending entries) and its entry would starve instead.
+  {
+    auto overlap = [&](const std::vector<int64_t>& a,
+                       const std::vector<int64_t>& b) {
+      if (a.empty() || b.empty()) return true;  // global overlaps any set
+      size_t i = 0, j = 0;
+      while (i < a.size() && j < b.size()) {
+        if (a[i] == b[j]) return true;
+        if (a[i] < b[j]) ++i; else ++j;
+      }
+      return false;
+    };
+    bool any_sets = false;
+    for (auto& [k, tc] : counts_)
+      if (k.find('\x01') != std::string::npos) {
+        any_sets = true;
+        break;
+      }
+    std::map<std::string, std::vector<std::string>> by_name;
+    if (any_sets)  // common no-process-set path pays nothing
+      for (auto& [k, tc] : counts_)
+        by_name[tc.requests[0].name].push_back(k);
+    std::set<std::string> conflicted;
+    struct ConflictErr {
+      std::string name;
+      std::vector<int64_t> members;  // union; empty → all ranks
+    };
+    std::vector<ConflictErr> errs;
+    for (auto& [nm, keys] : by_name) {
+      if (keys.size() < 2) continue;
+      std::set<std::string> cand;
+      for (size_t i = 0; i < keys.size(); ++i)
+        for (size_t j = i + 1; j < keys.size(); ++j) {
+          const Request& a = counts_[keys[i]].requests[0];
+          const Request& b = counts_[keys[j]].requests[0];
+          if (overlap(a.members, b.members)) {
+            cand.insert(keys[i]);
+            cand.insert(keys[j]);
+          }
+        }
+      if (cand.empty()) continue;
+      std::vector<bool> seen_any(size_, false);
+      for (auto& k : keys) {
+        auto& tc = counts_[k];
+        for (int r = 0; r < size_; ++r)
+          seen_any[r] = seen_any[r] || (r < static_cast<int>(
+                                            tc.seen.size()) && tc.seen[r]);
+      }
+      bool covered = true;
+      for (auto& k : cand) {
+        const auto& mem = counts_[k].requests[0].members;
+        if (mem.empty()) {
+          for (int r = 0; r < size_; ++r)
+            if (!rank_joined_[r]) covered = covered && seen_any[r];
+        } else {
+          for (auto mr : mem)
+            if (mr >= 0 && mr < size_ && !rank_joined_[mr])
+              covered = covered && seen_any[mr];
+        }
+      }
+      if (!covered) continue;  // wait for stragglers to announce
+      conflicted.insert(cand.begin(), cand.end());
+      // the ERROR must reach exactly the conflicted entries' members —
+      // an innocent disjoint set reusing the name keeps its entry (its
+      // members are disjoint from every conflicted entry by
+      // construction, so rank-level targeting is entry-level targeting)
+      std::set<int64_t> uni;
+      bool global = false;
+      for (auto& k : cand) {
+        const auto& mem = counts_[k].requests[0].members;
+        if (mem.empty()) global = true;
+        for (auto mr : mem) uni.insert(mr);
+      }
+      ConflictErr ce;
+      ce.name = nm;
+      if (!global)
+        ce.members.assign(uni.begin(), uni.end());
+      errs.push_back(std::move(ce));
+    }
+    for (auto& k : conflicted) counts_.erase(k);
+    for (auto& ce : errs) {
+      Response err;
+      err.kind = Response::Kind::ERROR;
+      err.names = {ce.name};
+      err.members = ce.members;
+      err.error = "tensor '" + ce.name + "' was submitted with "
+                  "conflicting process sets across ranks";
+      out.push_back(std::move(err));
+    }
+  }
 
   // JOIN: everyone joined → emit join response (workers drop their joined
   // flag after executing it; a duplicate response in the crossover cycle
@@ -505,14 +615,22 @@ std::vector<Response> Engine::Coordinate(
     }
   }
 
-  // slow path: tensors every active rank announced
+  // slow path: tensors every active participant announced (the global
+  // set, or the request's process-set members)
   std::vector<std::string> complete;
   for (auto& [name, tc] : counts_) {
-    if (tc.count >= active && active > 0) complete.push_back(name);
+    const auto& mem = tc.requests[0].members;
+    int required = active;
+    if (!mem.empty()) {
+      required = 0;
+      for (auto mr : mem)
+        if (mr >= 0 && mr < size_ && !rank_joined_[mr]) required++;
+    }
+    if (tc.count >= required && required > 0) complete.push_back(name);
   }
   for (auto& name : complete) {
     auto& tc = counts_[name];
-    if (timeline_.active()) timeline_.NegotiateEnd(name);
+    if (timeline_.active()) timeline_.NegotiateEnd(tc.requests[0].name);
     Response resp = BuildResponse(tc.requests);
     int32_t gid = tc.requests[0].group_id;
     int32_t gsize = tc.requests[0].group_size;
@@ -592,6 +710,9 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
       return fail("mismatched fusion group for tensor '" + a.name +
                   "' (all ranks must submit grouped collectives with "
                   "identical membership)");
+    if (q.members != a.members)
+      return fail("mismatched process set for tensor '" + a.name +
+                  "' (every participant must pass the same set)");
     bool shape_free_dim0 =
         a.op == OpType::ALLGATHER || a.op == OpType::ALLTOALL;
     if (shape_free_dim0) {
@@ -615,12 +736,40 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
   resp.prescale = a.prescale;
   resp.postscale = a.postscale;
   resp.numels = {a.shape.num_elements()};
+  resp.members = a.members;
+
+  // participant count + rank → position map (identity for the global set)
+  const int m = a.members.empty() ? size_
+                                  : static_cast<int>(a.members.size());
+  auto pos_of = [&](int rank) -> int {
+    if (a.members.empty()) return rank;
+    for (size_t i = 0; i < a.members.size(); ++i)
+      if (a.members[i] == rank) return static_cast<int>(i);
+    return -1;
+  };
+  if (!a.members.empty()) {
+    int64_t prev = -1;
+    for (auto mr : a.members) {
+      if (mr <= prev || mr >= size_)
+        return fail("process set for tensor '" + a.name +
+                    "' must be ascending unique ranks within the world");
+      prev = mr;
+    }
+    for (auto& q : reqs)
+      if (pos_of(q.rank) < 0)
+        return fail("rank " + std::to_string(q.rank) + " submitted '" +
+                    a.name + "' but is not in its process set");
+  }
 
   if (a.op == OpType::BARRIER) resp.kind = Response::Kind::BARRIER;
 
   if (a.op == OpType::ALLREDUCE && a.reduce == ReduceKind::ADASUM &&
-      (size_ & (size_ - 1)) != 0)
-    return fail("Adasum requires a power-of-two world size");
+      (m & (m - 1)) != 0)
+    return fail("Adasum requires a power-of-two participant count");
+
+  if (a.op == OpType::BROADCAST && pos_of(a.root_rank) < 0)
+    return fail("broadcast root " + std::to_string(a.root_rank) +
+                " is not in the process set for '" + a.name + "'");
 
   if (a.op == OpType::ALLGATHER || a.op == OpType::ALLTOALL) {
     // trailing dims were validated equal across ranks above; carry the
@@ -630,31 +779,32 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
       resp.trailing *= a.shape.dims[d];
   }
   if (a.op == OpType::ALLGATHER) {
-    resp.rows_flat.assign(size_, 0);
+    resp.rows_flat.assign(m, 0);
     for (auto& q : reqs)
-      resp.rows_flat[q.rank] = q.shape.dims.empty() ? 1 : q.shape.dims[0];
+      resp.rows_flat[pos_of(q.rank)] =
+          q.shape.dims.empty() ? 1 : q.shape.dims[0];
   }
   if (a.op == OpType::ALLTOALL) {
-    resp.rows_flat.assign(static_cast<size_t>(size_) * size_, 0);
+    resp.rows_flat.assign(static_cast<size_t>(m) * m, 0);
     for (auto& q : reqs) {
-      if (static_cast<int>(q.splits.size()) != size_)
-        return fail("alltoall splits length must equal world size for '" +
-                    a.name + "'");
+      if (static_cast<int>(q.splits.size()) != m)
+        return fail("alltoall splits length must equal the participant "
+                    "count for '" + a.name + "'");
       int64_t total = 0;
       for (auto s : q.splits) total += s;
       if (!q.shape.dims.empty() && total != q.shape.dims[0])
         return fail("alltoall splits must sum to dim 0 for '" + a.name +
                     "'");
-      for (int d = 0; d < size_; ++d)
-        resp.rows_flat[static_cast<size_t>(q.rank) * size_ + d] =
+      for (int d = 0; d < m; ++d)
+        resp.rows_flat[static_cast<size_t>(pos_of(q.rank)) * m + d] =
             q.splits[d];
     }
   }
   if (a.op == OpType::REDUCESCATTER) {
     int64_t rows = a.shape.dims.empty() ? 1 : a.shape.dims[0];
-    if (rows % size_ != 0)
-      return fail("reducescatter dim 0 must divide world size for '" +
-                  a.name + "'");
+    if (rows % m != 0)
+      return fail("reducescatter dim 0 must divide the participant count "
+                  "for '" + a.name + "'");
   }
   return resp;
 }
@@ -676,6 +826,7 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
         r.dtype == fused.back().dtype && r.reduce == fused.back().reduce &&
         r.prescale == fused.back().prescale &&
         r.postscale == fused.back().postscale &&
+        r.members == fused.back().members &&
         r.reduce != ReduceKind::ADASUM;
     bool same_group = params_match && r.group_id >= 0 &&
                       fused.back().group_id == r.group_id &&
@@ -712,11 +863,20 @@ void Engine::CheckStalls() {
   for (auto& [name, tc] : counts_) {
     if (tc.first_seen_sec == 0 || stall_warned_[name]) continue;
     if (now - tc.first_seen_sec > stall_warn_sec_) {
+      const auto& mem = tc.requests[0].members;
+      auto expected = [&](int r) {
+        if (mem.empty()) return true;
+        for (auto mr : mem)
+          if (mr == r) return true;
+        return false;
+      };
       std::ostringstream missing;
       for (int r = 0; r < size_; ++r)
-        if (!tc.seen[r] && !rank_joined_[r]) missing << r << " ";
+        if (!tc.seen[r] && !rank_joined_[r] && expected(r))
+          missing << r << " ";
       HVT_LOG(WARNING, rank_)
-          << "tensor '" << name << "' was submitted by some ranks but "
+          << "tensor '" << tc.requests[0].name
+          << "' was submitted by some ranks but "
           << "not by ranks [ " << missing.str() << "] for "
           << static_cast<long>(now - tc.first_seen_sec)
           << " s — possible stall (reference stall_inspector semantics)";
@@ -805,6 +965,13 @@ void Engine::ExecuteResponse(const Response& resp,
 
   switch (resp.kind) {
     case Response::Kind::ERROR: {
+      if (!resp.members.empty()) {
+        // member-targeted error (cross-set conflicts): an innocent
+        // disjoint set reusing the name must keep its pending entry
+        bool mine = false;
+        for (auto mr : resp.members) mine = mine || mr == rank_;
+        if (!mine) return;
+      }
       for (auto& name : resp.names) {
         auto e = take(name);
         if (e) CompleteEntry(e, Status::PreconditionError(resp.error));
@@ -844,6 +1011,23 @@ void Engine::ExecuteResponse(const Response& resp,
       break;
   }
 
+  // process-set participants (the whole world when members is empty);
+  // non-member ranks skip the response — they are not in the sub-rings
+  std::vector<int> grp;
+  if (resp.members.empty()) {
+    grp.resize(size_);
+    for (int i = 0; i < size_; ++i) grp[i] = i;
+  } else {
+    bool mine = false;
+    for (auto mr : resp.members) {
+      grp.push_back(static_cast<int>(mr));
+      mine = mine || mr == rank_;
+    }
+    if (!mine) return;
+  }
+  const int m = static_cast<int>(grp.size());
+  const int my_pos = GroupIndexOf(grp, rank_);
+
   const size_t el = DataTypeSize(resp.dtype);
   data_ops_++;  // one per TENSOR response = one data-plane collective
   for (int64_t n : resp.numels)
@@ -855,13 +1039,14 @@ void Engine::ExecuteResponse(const Response& resp,
         int64_t numel = resp.numels[0];
         std::vector<uint8_t> mine(numel * el, 0);
         if (e) memcpy(mine.data(), e->input.data(), mine.size());
-        std::vector<uint8_t> gathered(mine.size() * size_);
-        std::vector<int64_t> rows(size_, numel);
-        data_->Allgatherv(mine.data(), numel, rows,
-                          static_cast<int64_t>(el), gathered.data());
+        std::vector<uint8_t> gathered(mine.size() * m);
+        std::vector<int64_t> rows(m, numel);
+        data_->AllgathervGroup(mine.data(), numel, rows,
+                               static_cast<int64_t>(el), gathered.data(),
+                               grp);
         if (resp.dtype == DataType::FLOAT32) {
-          std::vector<std::vector<float>> vs(size_);
-          for (int r = 0; r < size_; ++r) {
+          std::vector<std::vector<float>> vs(m);
+          for (int r = 0; r < m; ++r) {
             vs[r].resize(numel);
             memcpy(vs[r].data(), gathered.data() + r * mine.size(),
                    mine.size());
@@ -872,8 +1057,8 @@ void Engine::ExecuteResponse(const Response& resp,
             memcpy(e->output.data(), vs[0].data(), mine.size());
           }
         } else if (resp.dtype == DataType::FLOAT64) {
-          std::vector<std::vector<double>> vs(size_);
-          for (int r = 0; r < size_; ++r) {
+          std::vector<std::vector<double>> vs(m);
+          for (int r = 0; r < m; ++r) {
             vs[r].resize(numel);
             memcpy(vs[r].data(), gathered.data() + r * mine.size(),
                    mine.size());
@@ -913,10 +1098,15 @@ void Engine::ExecuteResponse(const Response& resp,
       if (resp.prescale != 1.0)
         ScaleBuffer(fusion_buffer_.data(), total, resp.dtype,
                     resp.prescale);
-      PickBackend(resp, total)->Allreduce(fusion_buffer_.data(), total,
-                                          resp.dtype, resp.reduce);
+      if (resp.members.empty()) {
+        PickBackend(resp, total)->Allreduce(fusion_buffer_.data(), total,
+                                            resp.dtype, resp.reduce);
+      } else {
+        data_->AllreduceGroup(fusion_buffer_.data(), total, resp.dtype,
+                              resp.reduce, grp);
+      }
       double post = resp.postscale;
-      if (resp.reduce == ReduceKind::AVERAGE) post /= size_;
+      if (resp.reduce == ReduceKind::AVERAGE) post /= m;
       if (post != 1.0)
         ScaleBuffer(fusion_buffer_.data(), total, resp.dtype, post);
       off = 0;
@@ -930,7 +1120,8 @@ void Engine::ExecuteResponse(const Response& resp,
           CachedParams p{resp.op,      resp.reduce,    resp.dtype,
                          entries[i]->shape, resp.root, resp.prescale,
                          resp.postscale, entries[i]->splits};
-          if (!join_pending_ && resp.group_id < 0)
+          if (!join_pending_ && resp.group_id < 0 &&
+              resp.members.empty())
             cache_.Insert(resp.names[i], p);
           CompleteEntry(entries[i], Status::OK());
         }
@@ -942,7 +1133,7 @@ void Engine::ExecuteResponse(const Response& resp,
     case OpType::ALLGATHER: {
       auto e = take(resp.names[0]);
       std::vector<int64_t> rows(resp.rows_flat.begin(),
-                                resp.rows_flat.begin() + size_);
+                                resp.rows_flat.begin() + m);
       // per-row element count from the coordinator (identical on every
       // rank, including joined ranks with no local entry)
       int64_t row_bytes = resp.trailing * static_cast<int64_t>(el);
@@ -953,7 +1144,8 @@ void Engine::ExecuteResponse(const Response& resp,
       std::vector<uint8_t> out(static_cast<size_t>(total_rows) * row_bytes);
       const void* in = e ? static_cast<const void*>(e->input.data())
                          : static_cast<const void*>(out.data());
-      data_->Allgatherv(in, my_rows, rows, row_bytes, out.data());
+      data_->AllgathervGroup(in, my_rows, rows, row_bytes, out.data(),
+                             grp);
       if (e) {
         e->output = std::move(out);
         e->recv_splits = rows;
@@ -967,7 +1159,8 @@ void Engine::ExecuteResponse(const Response& resp,
       size_t bytes = static_cast<size_t>(resp.numels[0]) * el;
       std::vector<uint8_t> buf(bytes, 0);
       if (e) memcpy(buf.data(), e->input.data(), bytes);
-      data_->Broadcast(buf.data(), static_cast<int64_t>(bytes), resp.root);
+      data_->BroadcastGroup(buf.data(), static_cast<int64_t>(bytes),
+                            resp.root, grp);
       if (e) {
         e->output = std::move(buf);
         CompleteEntry(e, Status::OK());
@@ -977,14 +1170,14 @@ void Engine::ExecuteResponse(const Response& resp,
 
     case OpType::ALLTOALL: {
       auto e = take(resp.names[0]);
-      // rows_flat: sender-major size x size matrix
-      std::vector<int64_t> send_rows(size_, 0), recv_rows(size_, 0);
-      for (int d = 0; d < size_; ++d)
+      // rows_flat: sender-POSITION-major m x m matrix
+      std::vector<int64_t> send_rows(m, 0), recv_rows(m, 0);
+      for (int d = 0; d < m; ++d)
         send_rows[d] =
-            resp.rows_flat[static_cast<size_t>(rank_) * size_ + d];
-      for (int s = 0; s < size_; ++s)
+            resp.rows_flat[static_cast<size_t>(my_pos) * m + d];
+      for (int s = 0; s < m; ++s)
         recv_rows[s] =
-            resp.rows_flat[static_cast<size_t>(s) * size_ + rank_];
+            resp.rows_flat[static_cast<size_t>(s) * m + my_pos];
       int64_t my_rows = 0;
       for (auto r : send_rows) my_rows += r;
       int64_t row_bytes = resp.trailing * static_cast<int64_t>(el);
@@ -993,7 +1186,8 @@ void Engine::ExecuteResponse(const Response& resp,
       std::vector<uint8_t> out(static_cast<size_t>(total_recv) * row_bytes);
       const void* in = e ? static_cast<const void*>(e->input.data())
                          : static_cast<const void*>(out.data());
-      data_->Alltoallv(in, send_rows, row_bytes, out.data(), recv_rows);
+      data_->AlltoallvGroup(in, send_rows, row_bytes, out.data(),
+                            recv_rows, grp);
       if (e) {
         e->output = std::move(out);
         e->recv_splits = recv_rows;
@@ -1009,23 +1203,24 @@ void Engine::ExecuteResponse(const Response& resp,
       if (e) memcpy(buf.data(), e->input.data(), buf.size());
       if (resp.prescale != 1.0)
         ScaleBuffer(buf.data(), numel, resp.dtype, resp.prescale);
-      data_->Allreduce(buf.data(), numel, resp.dtype,
-                       resp.reduce == ReduceKind::AVERAGE
-                           ? ReduceKind::SUM
-                           : resp.reduce);
+      data_->AllreduceGroup(buf.data(), numel, resp.dtype,
+                            resp.reduce == ReduceKind::AVERAGE
+                                ? ReduceKind::SUM
+                                : resp.reduce,
+                            grp);
       double rs_post = resp.postscale;
-      if (resp.reduce == ReduceKind::AVERAGE) rs_post /= size_;
+      if (resp.reduce == ReduceKind::AVERAGE) rs_post /= m;
       if (rs_post != 1.0)
         ScaleBuffer(buf.data(), numel, resp.dtype, rs_post);
       if (e) {
         int64_t rows = e->shape.dims.empty() ? 1 : e->shape.dims[0];
         int64_t row_bytes = (e->shape.num_elements() / rows) *
                             static_cast<int64_t>(el);
-        int64_t chunk_rows = rows / size_;
+        int64_t chunk_rows = rows / m;
         size_t chunk_bytes = static_cast<size_t>(chunk_rows) * row_bytes;
         e->output.assign(
-            buf.data() + static_cast<size_t>(rank_) * chunk_bytes,
-            buf.data() + static_cast<size_t>(rank_ + 1) * chunk_bytes);
+            buf.data() + static_cast<size_t>(my_pos) * chunk_bytes,
+            buf.data() + static_cast<size_t>(my_pos + 1) * chunk_bytes);
         CompleteEntry(e, Status::OK());
       }
       return;

@@ -295,26 +295,29 @@ void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
   AllreduceGroup(buf, count, dtype, red, all);
 }
 
-void DataPlane::Allgatherv(const void* in, int64_t my_rows,
-                           const std::vector<int64_t>& rows,
-                           int64_t row_bytes, void* out) {
+void DataPlane::AllgathervGroup(const void* in, int64_t my_rows,
+                                const std::vector<int64_t>& rows,
+                                int64_t row_bytes, void* out,
+                                const std::vector<int>& group) {
+  const int m = static_cast<int>(group.size());
+  const int idx = GroupIndexOf(group, rank_);
   auto* dst = static_cast<uint8_t*>(out);
-  std::vector<int64_t> offs(size_ + 1, 0);
-  for (int i = 0; i < size_; ++i) offs[i + 1] = offs[i] + rows[i];
+  std::vector<int64_t> offs(m + 1, 0);
+  for (int i = 0; i < m; ++i) offs[i + 1] = offs[i] + rows[i];
   // place own rows
-  memcpy(dst + offs[rank_] * row_bytes, in,
+  memcpy(dst + offs[idx] * row_bytes, in,
          static_cast<size_t>(my_rows) * row_bytes);
-  if (size_ == 1) return;
-  const int next = (rank_ + 1) % size_;
-  const int prev = (rank_ + size_ - 1) % size_;
-  // ring rotation: at step s, send the block originally from
-  // (rank - s) % n, receive the block from (rank - s - 1) % n
-  for (int step = 0; step < size_ - 1; ++step) {
-    int send_blk = (rank_ - step + size_) % size_;
-    int recv_blk = (rank_ - step - 1 + size_) % size_;
+  if (m == 1) return;
+  const int next = group[(idx + 1) % m];
+  const int prev = group[(idx + m - 1) % m];
+  // ring rotation: at step s, send the block originally from position
+  // (idx - s) % m, receive the block from (idx - s - 1) % m
+  for (int step = 0; step < m - 1; ++step) {
+    int send_blk = (idx - step + m) % m;
+    int recv_blk = (idx - step - 1 + m) % m;
     size_t send_bytes = static_cast<size_t>(rows[send_blk]) * row_bytes;
     size_t recv_bytes = static_cast<size_t>(rows[recv_blk]) * row_bytes;
-    if (rank_ % 2 == 0) {
+    if (idx % 2 == 0) {
       peer(next).SendAll(dst + offs[send_blk] * row_bytes, send_bytes);
       peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
     } else {
@@ -324,10 +327,19 @@ void DataPlane::Allgatherv(const void* in, int64_t my_rows,
   }
 }
 
-void DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
-  if (size_ == 1 || bytes == 0) return;
+void DataPlane::Allgatherv(const void* in, int64_t my_rows,
+                           const std::vector<int64_t>& rows,
+                           int64_t row_bytes, void* out) {
+  std::vector<int> all(size_);
+  for (int i = 0; i < size_; ++i) all[i] = i;
+  AllgathervGroup(in, my_rows, rows, row_bytes, out, all);
+}
+
+void DataPlane::BroadcastGroup(void* buf, int64_t bytes, int root,
+                               const std::vector<int>& group) {
+  if (group.size() == 1 || bytes == 0) return;
   if (rank_ == root) {
-    for (int r = 0; r < size_; ++r) {
+    for (int r : group) {
       if (r == root) continue;
       peer(r).SendAll(buf, static_cast<size_t>(bytes));
     }
@@ -336,33 +348,53 @@ void DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
   }
 }
 
-void DataPlane::Alltoallv(const void* in,
-                          const std::vector<int64_t>& send_rows,
-                          int64_t row_bytes, void* out,
-                          const std::vector<int64_t>& recv_rows) {
+void DataPlane::Broadcast(void* buf, int64_t bytes, int root) {
+  if (size_ == 1) return;
+  std::vector<int> all(size_);
+  for (int i = 0; i < size_; ++i) all[i] = i;
+  BroadcastGroup(buf, bytes, root, all);
+}
+
+void DataPlane::AlltoallvGroup(const void* in,
+                               const std::vector<int64_t>& send_rows,
+                               int64_t row_bytes, void* out,
+                               const std::vector<int64_t>& recv_rows,
+                               const std::vector<int>& group) {
+  const int m = static_cast<int>(group.size());
+  const int idx = GroupIndexOf(group, rank_);
   auto* src = static_cast<const uint8_t*>(in);
   auto* dst = static_cast<uint8_t*>(out);
-  std::vector<int64_t> soff(size_ + 1, 0), roff(size_ + 1, 0);
-  for (int i = 0; i < size_; ++i) {
+  std::vector<int64_t> soff(m + 1, 0), roff(m + 1, 0);
+  for (int i = 0; i < m; ++i) {
     soff[i + 1] = soff[i] + send_rows[i];
     roff[i + 1] = roff[i] + recv_rows[i];
   }
   // self block
-  memcpy(dst + roff[rank_] * row_bytes, src + soff[rank_] * row_bytes,
-         static_cast<size_t>(send_rows[rank_]) * row_bytes);
-  // pairwise exchange, lower rank sends first
-  for (int other = 0; other < size_; ++other) {
-    if (other == rank_) continue;
-    size_t sb = static_cast<size_t>(send_rows[other]) * row_bytes;
-    size_t rb = static_cast<size_t>(recv_rows[other]) * row_bytes;
-    if (rank_ < other) {
-      if (sb) peer(other).SendAll(src + soff[other] * row_bytes, sb);
-      if (rb) peer(other).RecvAll(dst + roff[other] * row_bytes, rb);
+  memcpy(dst + roff[idx] * row_bytes, src + soff[idx] * row_bytes,
+         static_cast<size_t>(send_rows[idx]) * row_bytes);
+  // pairwise exchange, lower group position sends first
+  for (int opos = 0; opos < m; ++opos) {
+    if (opos == idx) continue;
+    int other = group[opos];
+    size_t sb = static_cast<size_t>(send_rows[opos]) * row_bytes;
+    size_t rb = static_cast<size_t>(recv_rows[opos]) * row_bytes;
+    if (idx < opos) {
+      if (sb) peer(other).SendAll(src + soff[opos] * row_bytes, sb);
+      if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
     } else {
-      if (rb) peer(other).RecvAll(dst + roff[other] * row_bytes, rb);
-      if (sb) peer(other).SendAll(src + soff[other] * row_bytes, sb);
+      if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
+      if (sb) peer(other).SendAll(src + soff[opos] * row_bytes, sb);
     }
   }
+}
+
+void DataPlane::Alltoallv(const void* in,
+                          const std::vector<int64_t>& send_rows,
+                          int64_t row_bytes, void* out,
+                          const std::vector<int64_t>& recv_rows) {
+  std::vector<int> all(size_);
+  for (int i = 0; i < size_; ++i) all[i] = i;
+  AlltoallvGroup(in, send_rows, row_bytes, out, recv_rows, all);
 }
 
 }  // namespace hvt

@@ -64,12 +64,25 @@ class DataPlane {
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,
                   void* out);
+  // Subgroup variant: rows indexed by group POSITION; this rank must be
+  // in `group` (ascending global ranks).
+  void AllgathervGroup(const void* in, int64_t my_rows,
+                       const std::vector<int64_t>& rows, int64_t row_bytes,
+                       void* out, const std::vector<int>& group);
   void Broadcast(void* buf, int64_t bytes, int root);
+  // root is a GLOBAL rank and must be in `group`.
+  void BroadcastGroup(void* buf, int64_t bytes, int root,
+                      const std::vector<int>& group);
   // send_rows[r] rows go to rank r; returns recv rows from each rank in
   // recv_rows; out must hold sum(recv_rows)*row_bytes.
   void Alltoallv(const void* in, const std::vector<int64_t>& send_rows,
                  int64_t row_bytes, void* out,
                  const std::vector<int64_t>& recv_rows);
+  // Subgroup variant: send/recv rows indexed by group POSITION.
+  void AlltoallvGroup(const void* in, const std::vector<int64_t>& send_rows,
+                      int64_t row_bytes, void* out,
+                      const std::vector<int64_t>& recv_rows,
+                      const std::vector<int>& group);
 
  private:
   Sock& peer(int r) { return peers_[static_cast<size_t>(r)]; }

@@ -27,6 +27,8 @@ struct Request {
   // semantics); -1 → ungrouped
   int32_t group_id = -1;
   int32_t group_size = 0;
+  // process set (ascending global ranks; empty → global)
+  std::vector<int64_t> members;
 };
 
 struct Response {
@@ -53,6 +55,9 @@ struct Response {
   // fusion-group id the member(s) came from; workers use it to skip the
   // response cache for grouped tensors (groups renegotiate as a unit)
   int32_t group_id = -1;
+  // process set the collective runs over (empty → global); non-member
+  // ranks skip the response entirely
+  std::vector<int64_t> members;
 };
 
 class Writer {
@@ -121,6 +126,7 @@ inline void EncodeRequest(Writer& w, const Request& r) {
   w.i64vec(r.splits);
   w.i32(r.group_id);
   w.i32(r.group_size);
+  w.i64vec(r.members);
 }
 
 inline Request DecodeRequest(Reader& rd) {
@@ -137,6 +143,7 @@ inline Request DecodeRequest(Reader& rd) {
   r.splits = rd.i64vec();
   r.group_id = rd.i32();
   r.group_size = rd.i32();
+  r.members = rd.i64vec();
   return r;
 }
 
@@ -167,6 +174,7 @@ inline void EncodeResponse(Writer& w, const Response& r) {
   w.i64vec(r.rows_flat);
   w.i64(r.trailing);
   w.i32(r.group_id);
+  w.i64vec(r.members);
 }
 
 inline Response DecodeResponse(Reader& rd) {
@@ -186,6 +194,7 @@ inline Response DecodeResponse(Reader& rd) {
   r.rows_flat = rd.i64vec();
   r.trailing = rd.i64();
   r.group_id = rd.i32();
+  r.members = rd.i64vec();
   return r;
 }
 

@@ -307,10 +307,13 @@ def alltoall(tensor, splits=None, name=None,
     native = _require_multiproc_engine()
     if splits is None:
         n = _nprocs()
+        if process_set is not None and getattr(process_set, "ranks",
+                                               None) is not None:
+            n = len(process_set.ranks)
         if arr.shape[0] % n != 0:
             raise ValueError(
                 f"alltoall without splits requires dim 0 ({arr.shape[0]}) "
-                f"divisible by the number of processes ({n})")
+                f"divisible by the number of participants ({n})")
         splits = [arr.shape[0] // n] * n
     h = native.submit("alltoall", arr, kind,
                       name=_auto_name("alltoall", name), splits=splits,

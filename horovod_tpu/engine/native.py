@@ -65,7 +65,8 @@ def _load():
             ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
             ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
             ctypes.c_double, ctypes.c_double, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int]
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_longlong)]
         lib.hvt_result_bytes.restype = ctypes.c_longlong
         if getattr(lib, "hvt_data_ops", None) is not None:
             # introspection symbol; a stale .so without it must not break
@@ -142,12 +143,13 @@ class NativeHandle:
     """Async handle over the C++ engine (reference handle_manager.h)."""
 
     def __init__(self, handle, op, arr, kind, trailing_shape, dtype,
-                 orig_shape=None):
+                 orig_shape=None, n_participants=None):
         self._h = handle
         self._op = op
         self._kind = kind
         self._trailing = trailing_shape
         self._dtype = dtype
+        self._nparts = n_participants  # process-set size (None → world)
         self._shape = arr.shape if arr is not None else ()
         # 0-d inputs are sent as (1,); restore the caller's shape on output
         # so np=1 and np>1 agree
@@ -216,7 +218,7 @@ class NativeHandle:
                 rows = int(splits.sum()) if splits is not None else 0
                 out = out.reshape((rows,) + tuple(self._trailing))
             elif self._op == "reducescatter":
-                rows = self._shape[0] // engine_size()
+                rows = self._shape[0] // (self._nparts or engine_size())
                 out = out.reshape((rows,) + tuple(self._trailing))
             else:
                 out = out.reshape(
@@ -237,11 +239,20 @@ def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
         raise HorovodInternalError(
             "hvt engine is not running; multi-process eager collectives "
             "require hvt.init() under the hvtrun launcher")
+    members = []
     if process_set is not None and getattr(process_set, "ranks",
                                            None) is not None:
-        raise NotImplementedError(
-            "engine-path process sets beyond the global set are not yet "
-            "supported; use the traced path")
+        members = sorted(int(r) for r in process_set.ranks)
+        if len(set(members)) != len(members):
+            raise ValueError(f"process set has duplicate ranks: {members}")
+        if members == list(range(engine_size())):
+            members = []  # exactly the full world == global set
+        elif engine_rank() not in members:
+            # reference semantics: a rank outside the set must not call
+            # the collective (its peers would never pair the tensor)
+            raise ValueError(
+                f"rank {engine_rank()} is not in process set "
+                f"{members}; only member ranks may call this collective")
     orig_shape = None
     if arr is None:
         arr = np.zeros((0,), np.uint8)
@@ -264,6 +275,7 @@ def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
     splits_list = [] if splits is None else [int(s) for s in splits]
     splits_arr = (ctypes.c_longlong * max(len(splits_list), 1))(
         *splits_list)
+    members_arr = (ctypes.c_longlong * max(len(members), 1))(*members)
     h = _lib.hvt_submit(
         name.encode(), _OP[op], _RED[op_kind],
         _np_dtype_id(arr) if arr.size or op not in ("join", "barrier")
@@ -271,9 +283,11 @@ def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
         len(dims), dims_arr,
         arr.ctypes.data_as(ctypes.c_void_p) if arr.size else None,
         ctypes.c_longlong(arr.nbytes), root_rank, prescale, postscale,
-        len(splits_list), splits_arr, int(group_id), int(group_size))
+        len(splits_list), splits_arr, int(group_id), int(group_size),
+        len(members), members_arr)
     if h < 0:
         raise HorovodInternalError("hvt engine rejected submission "
                                    "(not initialized)")
     return NativeHandle(h, op, arr, kind, tuple(arr.shape[1:]), dtype,
-                        orig_shape=orig_shape)
+                        orig_shape=orig_shape,
+                        n_participants=len(members) or None)

@@ -68,11 +68,16 @@ def MetricAverageCallback():
     return _make_callback(_M())
 
 
-def DistributedOptimizer(*args, **kwargs):
-    _require_keras()
-    raise NotImplementedError(
-        "Keras-graph DistributedOptimizer is not provided; use "
-        "horovod_tpu.jax.DistributedOptimizer for TPU training")
+def DistributedOptimizer(optimizer, *args, **kwargs):
+    """Wrap a Keras optimizer so ``apply_gradients`` exchanges gradients
+    across workers (reference ``keras/__init__.py:36`` — the reference
+    subclasses to override ``get_gradients``/``_aggregate_gradients``;
+    Keras 3 routes everything through ``apply_gradients``, which the
+    eager TF wrapper intercepts). Accepts the TF wrapper's kwargs
+    (compression, backward_passes_per_step, op, ...)."""
+    from horovod_tpu import tensorflow as hvt_tf
+
+    return hvt_tf.DistributedOptimizer(optimizer, *args, **kwargs)
 
 
 def broadcast_global_variables(root_rank=0, model=None, variables=None):

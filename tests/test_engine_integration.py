@@ -354,6 +354,109 @@ def test_grouped_member_mismatch_poisons_group_2proc():
     """)
 
 
+def test_process_sets_4proc():
+    """Eager collectives over process subsets (later-lineage horovod
+    ProcessSet semantics on the engine path): disjoint sets run
+    concurrently; allgather/broadcast/alltoall/reducescatter follow the
+    set's positional layout; non-members must not call."""
+    out = run_workers("""
+        from horovod_tpu.common.process_sets import ProcessSet
+        evens = ProcessSet([0, 2])
+        odds = ProcessSet([1, 3])
+        mine = evens if r % 2 == 0 else odds
+
+        # disjoint subset allreduces proceed concurrently
+        x = np.full((4,), float(r + 1), np.float32)
+        res = np.asarray(hvt.allreduce(x, op=hvt.Sum, name="ps",
+                                       process_set=mine))
+        expect = (1 + 3) if r % 2 == 0 else (2 + 4)
+        np.testing.assert_allclose(res, float(expect))
+
+        # average divides by the SET size, not the world size
+        avg = np.asarray(hvt.allreduce(x, name="psavg", process_set=mine))
+        np.testing.assert_allclose(avg, expect / 2.0)
+
+        # broadcast from a set-internal root (global rank id)
+        root = 2 if r % 2 == 0 else 1
+        b = np.full((3,), float(r), np.float32)
+        bres = np.asarray(hvt.broadcast(b, root_rank=root, name="psb",
+                                        process_set=mine))
+        np.testing.assert_allclose(bres, float(root))
+
+        # uneven allgather within the set (rows by set position)
+        rows = (r // 2) + 1 if r % 2 == 0 else (r // 2) + 2
+        g = np.full((rows, 2), float(r), np.float32)
+        gres = np.asarray(hvt.allgather(g, name="psg", process_set=mine))
+        if r % 2 == 0:
+            assert gres.shape == (3, 2)   # ranks 0 (1 row) + 2 (2 rows)
+            np.testing.assert_allclose(gres[:1], 0.0)
+            np.testing.assert_allclose(gres[1:], 2.0)
+        else:
+            assert gres.shape == (5, 2)   # ranks 1 (2 rows) + 3 (3 rows)
+            np.testing.assert_allclose(gres[:2], 1.0)
+            np.testing.assert_allclose(gres[2:], 3.0)
+
+        # non-member call is a loud local error
+        other = odds if r % 2 == 0 else evens
+        try:
+            hvt.allreduce(x, name="bad", process_set=other)
+            raise SystemExit("expected ValueError for non-member")
+        except ValueError as e:
+            assert "not in process set" in str(e)
+        print(f"PS-OK-{r}", flush=True)
+    """, np=4)
+    for i in range(4):
+        assert f"PS-OK-{i}" in out
+
+
+def test_process_set_mismatch_errors_4proc():
+    """Ranks disagreeing on a tensor's process set get a per-tensor
+    ERROR (consistency check), not a hang. Sets [0,1,2] vs [1,2,3]
+    overlap, so neither negotiation can ever complete — the conflict
+    check must fire deterministically."""
+    run_workers("""
+        from horovod_tpu.common.process_sets import ProcessSet
+        ps = ProcessSet([0, 1, 2]) if r < 2 else ProcessSet([1, 2, 3])
+        try:
+            hvt.allreduce(np.ones((2,), np.float32), name="mm",
+                          process_set=ps)
+            raise SystemExit("expected ValueError")
+        except ValueError as e:
+            assert "process set" in str(e), e
+    """, np=4)
+
+
+def test_process_set_conflict_spares_disjoint_set_5proc():
+    """A cross-set conflict errors exactly its participants; a disjoint
+    set legitimately reusing the tensor name completes normally."""
+    out = run_workers("""
+        from horovod_tpu.common.process_sets import ProcessSet
+        if r == 0:
+            ps = ProcessSet([0, 1])
+        elif r == 1:
+            ps = ProcessSet([1, 2])
+        elif r == 2:
+            ps = ProcessSet([0, 2])
+        else:
+            ps = ProcessSet([3, 4])
+        if r < 3:
+            try:
+                hvt.allreduce(np.ones((2,), np.float32), name="t",
+                              process_set=ps)
+                raise SystemExit("expected ValueError")
+            except ValueError as e:
+                assert "conflicting process sets" in str(e), e
+        else:
+            res = np.asarray(hvt.allreduce(
+                np.full((2,), float(r), np.float32), op=hvt.Sum,
+                name="t", process_set=ps))
+            np.testing.assert_allclose(res, 7.0)  # 3 + 4
+        print(f"SPARE-OK-{r}", flush=True)
+    """, np=5)
+    for i in range(5):
+        assert f"SPARE-OK-{i}" in out
+
+
 def test_tf_binding_tape_and_optimizer_2proc():
     """The TF binding's gradient plumbing over the real engine: tape
     gradients average across ranks; the optimizer wrapper applies reduced

@@ -125,3 +125,17 @@ def test_compression_fp16_roundtrip_and_passthrough():
     ints = np.arange(4, dtype=np.int64)
     comp, ctx = c.compress(ints)
     assert comp.dtype == np.int64 and ctx is None
+
+
+def test_keras_distributed_optimizer_delegates():
+    """keras.DistributedOptimizer routes through the eager TF wrapper
+    (Keras 3 drives updates through apply_gradients)."""
+    import horovod_tpu.keras as hvt_keras
+
+    inner = FakeOptimizer()
+    opt = hvt_keras.DistributedOptimizer(inner, backward_passes_per_step=2)
+    g = np.ones((2,), np.float32)
+    assert opt.apply_gradients([(g, "v")]) is None
+    opt.apply_gradients([(g, "v")])
+    (applied,) = inner.applied
+    np.testing.assert_allclose(applied[0][0], 2.0)
