@@ -88,6 +88,76 @@ def calibrate_matmul_tflops(platform):
     return best / 1e12
 
 
+def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
+                dtype_name, seq_len=1024):
+    """GPT train-step throughput on a dp mesh (tokens/sec/chip) — the
+    flagship-model counterpart of the ResNet measurement. FLOPs/token by
+    the standard training estimate 6N + 12·L·d_model·seq (dense matmuls
+    fwd+bwd plus attention score/value matmuls)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.models import GPT, GPTConfig
+    from horovod_tpu.parallel.mesh import make_parallel_mesh
+
+    n = len(devices)
+    mesh = make_parallel_mesh(devices=devices, dp=n)
+    dtype = jnp.float32 if dtype_name == "fp32" else jnp.bfloat16
+    cfg = GPTConfig(vocab_size=32768, n_layers=12, d_model=768, n_heads=12,
+                    d_ff=3072, max_seq_len=seq_len, dtype=dtype)
+    model = GPT(cfg)
+    global_batch = per_chip_batch * n
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                     (global_batch, seq_len)))
+    tokens = jax.device_put(tokens, NamedSharding(mesh, P("dp")))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, seq_len), jnp.int32))["params"]
+    repl = NamedSharding(mesh, P())
+    params = jax.device_put(params, repl)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    tx = optax.adamw(1e-4)
+    opt_state = jax.device_put(tx.init(params), repl)
+
+    def loss_fn(params):
+        logits = model.apply({"params": params}, tokens)
+        targets = jnp.roll(tokens, -1, axis=-1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], targets[:, :-1]).mean()
+
+    def train_step(carry, _):
+        params, opt_state = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    def block_fn(params, opt_state):
+        (params, opt_state), loss = lax.fori_loop(
+            0, num_batches_per_iter, lambda i, c: train_step(c[0], None),
+            ((params, opt_state), jnp.float32(0)))
+        return params, opt_state, loss
+
+    block = jax.jit(block_fn, donate_argnums=(0, 1))
+    params, opt_state, loss = block(params, opt_state)
+    jax.block_until_ready(loss)  # warmup/compile
+    tok_secs = []
+    for _ in range(num_iters):
+        t0 = time.perf_counter()
+        params, opt_state, loss = block(params, opt_state)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        tok_secs.append(
+            global_batch * seq_len * num_batches_per_iter / dt)
+    tok_mean = float(np.mean(tok_secs))
+    flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * seq_len
+    return (tok_mean / n, tok_mean, float(np.std(tok_secs)),
+            flops_per_token, None, float(loss))
+
+
 def measure(model_name, devices, per_chip_batch, num_iters,
             num_batches_per_iter, dtype_name, image_size=224):
     """Train-step throughput on a dp mesh over ``devices``.
@@ -196,11 +266,15 @@ def measure(model_name, devices, per_chip_batch, num_iters,
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet101"])
-    p.add_argument("--batch-size", type=int, default=256,
-                   help="per-chip batch size (256 measured best on v5-lite:"
-                        " MFU 0.38 vs 0.34 at 128; BN statistics passes "
-                        "are the residual non-conv cost — see docstring)")
+                   choices=["resnet50", "resnet101", "gpt"])
+    p.add_argument("--seq-len", type=int, default=1024,
+                   help="sequence length for --model gpt")
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="per-chip batch size. Defaults per model: 256 for "
+                        "resnet (measured best on v5-lite: MFU 0.38 vs "
+                        "0.34 at 128; BN statistics passes are the "
+                        "residual non-conv cost — see docstring), 8 for "
+                        "gpt (8x1024 tokens/chip/step)")
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--fp32", action="store_true",
@@ -210,9 +284,22 @@ def main():
                         "smaller for CPU harness validation)")
     p.add_argument("--no-scaling", action="store_true",
                    help="skip the 1→N chip scaling sweep")
+    p.add_argument("--force-cpu", action="store_true",
+                   help="run on a 2-device virtual CPU mesh (harness "
+                        "validation; the JAX_PLATFORMS env var alone does "
+                        "not override platform-pinning site plugins)")
     args = p.parse_args()
 
+    if args.force_cpu:
+        import os
+
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=2")
+
     import jax
+
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     import horovod_tpu as hvt
 
@@ -222,22 +309,34 @@ def main():
     platform = devices[0].platform
     dtype_name = "fp32" if args.fp32 else "bf16"
 
-    (per_chip, img_sec_mean, img_sec_std, flops_per_img, xla_flops_per_img,
-     loss) = measure(
-        args.model, devices, args.batch_size, args.num_iters,
-        args.num_batches_per_iter, dtype_name, args.image_size)
-    print(f"# {args.model} bs={args.batch_size}/chip chips={n} "
+    gpt = args.model == "gpt"
+    unit_item = "tok" if gpt else "img"
+
+    def run_measure(devs, iters, bs):
+        if gpt:
+            return measure_gpt(devs, bs, iters, args.num_batches_per_iter,
+                               dtype_name, args.seq_len)
+        return measure(args.model, devs, bs, iters,
+                       args.num_batches_per_iter, dtype_name,
+                       args.image_size)
+
+    bs = args.batch_size
+    if bs is None:
+        bs = 8 if gpt else 256  # per-model default; user values win
+    (per_chip, rate_mean, rate_std, flops_per_item, xla_flops_per_img,
+     loss) = run_measure(devices, args.num_iters, bs)
+    print(f"# {args.model} bs={bs}/chip chips={n} "
           f"dtype={dtype_name}: "
-          f"{img_sec_mean:.1f} +- {img_sec_std:.1f} img/sec total, "
-          f"{per_chip:.1f} img/sec/chip, final loss {loss:.3f}",
+          f"{rate_mean:.1f} +- {rate_std:.1f} {unit_item}/sec total, "
+          f"{per_chip:.1f} {unit_item}/sec/chip, final loss {loss:.3f}",
           file=sys.stderr)
 
     calib_tflops = calibrate_matmul_tflops(platform)
-    achieved_tflops = per_chip * flops_per_img / 1e12
+    achieved_tflops = per_chip * flops_per_item / 1e12
     mfu = achieved_tflops / calib_tflops if calib_tflops else None
     print(f"# calib {calib_tflops:.1f} TFLOP/s/chip (in-harness matmul "
           f"ceiling), achieved {achieved_tflops:.2f} TFLOP/s/chip "
-          f"({flops_per_img / 1e9:.2f} GFLOP/img), MFU {mfu:.3f}",
+          f"({flops_per_item / 1e9:.2f} GFLOP/{unit_item}), MFU {mfu:.3f}",
           file=sys.stderr)
 
     # 1→N scaling sweep — metric of record (BASELINE.md): per-chip
@@ -256,25 +355,26 @@ def main():
                 # headline measurement above already covers all chips
                 per_chip_at[k] = per_chip
                 continue
-            pc = measure(
-                args.model, devices[:k], args.batch_size,
-                max(2, args.num_iters // 2), args.num_batches_per_iter,
-                dtype_name, args.image_size)[0]
+            pc = run_measure(devices[:k], max(2, args.num_iters // 2),
+                             bs)[0]
             per_chip_at[k] = pc
-            print(f"# scaling: {k} chips → {pc:.1f} img/sec/chip",
+            print(f"# scaling: {k} chips → {pc:.1f} {unit_item}/sec/chip",
                   file=sys.stderr)
         sweep_eff = [round(per_chip_at[k] / per_chip_at[1], 4)
                      for k in sweep_n]
 
     print(json.dumps({
-        "metric": f"{args.model}_synthetic_img_sec_per_chip",
+        "metric": f"{args.model}_synthetic_{unit_item}_sec_per_chip",
         "value": round(per_chip, 2),
-        "unit": "img/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_SEC_PER_DEVICE, 3),
+        "unit": f"{unit_item}/sec/chip",
+        # GPT has no reference-published absolute number; the ResNet
+        # baseline stays the reference's 103.55 img/s/device
+        "vs_baseline": (round(per_chip / BASELINE_IMG_SEC_PER_DEVICE, 3)
+                        if not gpt else None),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "calib_tflops": round(calib_tflops, 2),
         "achieved_tflops": round(achieved_tflops, 3),
-        "flops_per_img": round(flops_per_img / 1e9, 3),
+        f"flops_per_{unit_item}": round(flops_per_item / 1e9, 3),
         "xla_flops_per_img": (round(xla_flops_per_img / 1e9, 3)
                               if xla_flops_per_img is not None else None),
         "scaling": {"n": sweep_n, "efficiency": sweep_eff},

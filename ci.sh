@@ -31,7 +31,10 @@ python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK'
 
 if [[ "$FAST" == "0" ]]; then
   echo "=== [4/4] bench smoke (CPU harness validation) ==="
-  JAX_PLATFORMS=cpu python bench.py --model resnet50 --batch-size 2 \
+  # --force-cpu applies the in-process platform override; the env var
+  # alone does not beat platform-pinning site plugins, and CI must never
+  # depend on (or collide over) the single-process TPU tunnel
+  python bench.py --force-cpu --model resnet50 --batch-size 2 \
     --num-iters 1 --num-batches-per-iter 2 --image-size 32 --no-scaling
 else
   echo "=== [4/4] bench smoke skipped (--fast) ==="

@@ -1,0 +1,47 @@
+"""TF eager training with DistributedGradientTape (the reference's
+``examples/tensorflow2/tensorflow2_mnist.py`` pattern).
+
+Requires a TensorFlow install; launch one process per slot:
+
+    hvtrun -np 4 python examples/tensorflow/tf_tape_train.py
+
+The binding bridges tensors through numpy (see README "Known limits") —
+compiled TPU training belongs to horovod_tpu.jax; this surface exists
+for porting eager TF code with minimal changes.
+"""
+
+import numpy as np
+
+import horovod_tpu.tensorflow as hvt_tf
+
+
+def main():
+    import tensorflow as tf
+
+    hvt_tf.init()
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = tf.keras.optimizers.SGD(0.05)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    rs = np.random.RandomState(hvt_tf.rank())
+    for step in range(200):
+        x = tf.constant(rs.randn(64, 20), tf.float32)
+        y = tf.constant(rs.randint(0, 10, (64,)))
+        with hvt_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = loss_fn(y, model(x, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if step == 0:
+            # rank 0's initial weights everywhere (reference
+            # BroadcastGlobalVariablesCallback)
+            hvt_tf.broadcast_variables(model.variables, root_rank=0)
+        if step % 50 == 0 and hvt_tf.rank() == 0:
+            print(f"step {step:4d}  loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -558,7 +558,34 @@ std::vector<Response> Engine::Coordinate(
         ce.members.assign(uni.begin(), uni.end());
       errs.push_back(std::move(ce));
     }
-    for (auto& k : conflicted) counts_.erase(k);
+    for (auto& k : conflicted) {
+      // a conflicted member of a fusion group poisons the group —
+      // sibling members held in groups_ must error out, not starve
+      const Request& cq = counts_[k].requests[0];
+      if (cq.group_id >= 0 && cq.group_size > 0) {
+        auto& gs = groups_[cq.group_id];
+        gs.expected = cq.group_size;
+        if (!gs.poisoned) {
+          gs.poisoned = true;
+          gs.error = "tensor '" + cq.name + "' was submitted with "
+                     "conflicting process sets across ranks (fusion "
+                     "group " + std::to_string(cq.group_id) + " aborted)";
+        }
+        for (auto& [n2, r2] : gs.held) {
+          Response err;
+          err.kind = Response::Kind::ERROR;
+          err.names = r2.names;
+          err.members = r2.members;
+          err.error = gs.error;
+          out.push_back(std::move(err));
+          gs.released++;
+        }
+        gs.held.clear();
+        gs.released++;  // the conflicted tensor itself (errored below)
+        if (gs.released >= gs.expected) groups_.erase(cq.group_id);
+      }
+      counts_.erase(k);
+    }
     for (auto& ce : errs) {
       Response err;
       err.kind = Response::Kind::ERROR;
@@ -651,10 +678,13 @@ std::vector<Response> Engine::Coordinate(
     }
     if (gs.poisoned) {
       // dissolve: error out held members and every later-arriving member
+      // (use the held response's plain names + member targeting — the
+      // map key may be the internal (name, set) negotiation key)
       for (auto& [n2, r2] : gs.held) {
         Response err;
         err.kind = Response::Kind::ERROR;
-        err.names = {n2};
+        err.names = r2.names;
+        err.members = r2.members;
         err.error = gs.error;
         out.push_back(std::move(err));
         gs.released++;
@@ -690,6 +720,20 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
   const Request& a = reqs[0];
   Response resp;
   resp.names = {a.name};
+  // ERROR responses must be member-targeted from the start: an
+  // untargeted error would take a DISJOINT same-name set's pending
+  // entries on innocent ranks and silently corrupt their collective
+  // (zero stand-ins). Target the union of the submitting requests'
+  // members — mismatched-membership errors must reach every submitter.
+  {
+    std::set<int64_t> uni;
+    bool global = false;
+    for (auto& q : reqs) {
+      if (q.members.empty()) global = true;
+      for (auto mr : q.members) uni.insert(mr);
+    }
+    if (!global) resp.members.assign(uni.begin(), uni.end());
+  }
   auto fail = [&](const std::string& why) {
     resp.kind = Response::Kind::ERROR;
     resp.error = why;

@@ -457,6 +457,54 @@ def test_process_set_conflict_spares_disjoint_set_5proc():
         assert f"SPARE-OK-{i}" in out
 
 
+def test_intra_set_error_spares_disjoint_set_4proc():
+    """A consistency ERROR inside one process set (shape mismatch) must
+    be member-targeted: a disjoint set reusing the name completes with
+    correct data — regression for the untargeted-ERROR corruption."""
+    out = run_workers("""
+        import time
+        from horovod_tpu.common.process_sets import ProcessSet
+        if r < 2:
+            ps = ProcessSet([0, 1])
+            try:
+                # shapes differ across ranks 0/1 → per-tensor ERROR
+                hvt.allreduce(np.zeros((r + 2,), np.float32), name="t",
+                              process_set=ps)
+                raise SystemExit("expected ValueError")
+            except ValueError as e:
+                assert "mismatched shape" in str(e), e
+        else:
+            ps = ProcessSet([2, 3])
+            if r == 3:
+                time.sleep(0.3)   # straggler: entry pends while the
+                                  # other set errors
+            res = np.asarray(hvt.allreduce(
+                np.full((2,), float(r), np.float32), op=hvt.Sum,
+                name="t", process_set=ps))
+            np.testing.assert_allclose(res, 5.0)  # 2 + 3, NOT zeroed
+        print(f"SPARED-{r}", flush=True)
+    """, np=4)
+    for i in range(4):
+        assert f"SPARED-{i}" in out
+
+
+def test_grouped_conflicted_process_set_errors_not_hangs_4proc():
+    """A fusion group containing a tensor with conflicting process sets
+    must dissolve with errors on every member, not hold siblings
+    forever."""
+    run_workers("""
+        from horovod_tpu.common.process_sets import ProcessSet
+        ps = ProcessSet([0, 1, 2]) if r < 2 else ProcessSet([1, 2, 3])
+        try:
+            hvt.grouped_allreduce(
+                [np.ones((2,), np.float32), np.ones((3,), np.float32)],
+                op=hvt.Sum, name="gg", process_set=ps)
+            raise SystemExit("expected ValueError")
+        except ValueError as e:
+            assert "process set" in str(e) or "aborted" in str(e), e
+    """, np=4, timeout=60)
+
+
 def test_tf_binding_tape_and_optimizer_2proc():
     """The TF binding's gradient plumbing over the real engine: tape
     gradients average across ranks; the optimizer wrapper applies reduced

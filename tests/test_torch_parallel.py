@@ -177,3 +177,16 @@ def test_torch_elastic_sampler_shards_across_ranks():
         all_idx = hvd.allgather(mine, name="idx")
         assert sorted(all_idx.tolist()) == list(range(12)), all_idx
     """)
+
+
+def test_torch_allreduce_process_set_4proc():
+    """Torch collectives honor process subsets end to end over the
+    engine (per-set negotiation + sub-ring data plane)."""
+    run_torch_workers("""
+        from horovod_tpu.common.process_sets import ProcessSet
+        mine = ProcessSet([0, 2]) if r % 2 == 0 else ProcessSet([1, 3])
+        x = torch.full((3,), float(r + 1))
+        y = hvd.allreduce(x, name="pst", op=hvd.Sum, process_set=mine)
+        expect = (1 + 3) if r % 2 == 0 else (2 + 4)
+        assert torch.allclose(y, torch.full((3,), float(expect))), y
+    """, np=4)
