@@ -558,33 +558,42 @@ std::vector<Response> Engine::Coordinate(
         ce.members.assign(uni.begin(), uni.end());
       errs.push_back(std::move(ce));
     }
+    // a conflicted member of a fusion group poisons the group — sibling
+    // members held in groups_ must error out, not starve. Aggregate by
+    // group first: a conflicted NAME appears under one key per
+    // disagreeing set, but occupies only ONE group slot.
+    std::map<int32_t, std::pair<int, std::set<std::string>>> gconf;
     for (auto& k : conflicted) {
-      // a conflicted member of a fusion group poisons the group —
-      // sibling members held in groups_ must error out, not starve
       const Request& cq = counts_[k].requests[0];
       if (cq.group_id >= 0 && cq.group_size > 0) {
-        auto& gs = groups_[cq.group_id];
-        gs.expected = cq.group_size;
-        if (!gs.poisoned) {
-          gs.poisoned = true;
-          gs.error = "tensor '" + cq.name + "' was submitted with "
-                     "conflicting process sets across ranks (fusion "
-                     "group " + std::to_string(cq.group_id) + " aborted)";
-        }
-        for (auto& [n2, r2] : gs.held) {
-          Response err;
-          err.kind = Response::Kind::ERROR;
-          err.names = r2.names;
-          err.members = r2.members;
-          err.error = gs.error;
-          out.push_back(std::move(err));
-          gs.released++;
-        }
-        gs.held.clear();
-        gs.released++;  // the conflicted tensor itself (errored below)
-        if (gs.released >= gs.expected) groups_.erase(cq.group_id);
+        auto& e = gconf[cq.group_id];
+        e.first = cq.group_size;
+        e.second.insert(cq.name);
       }
       counts_.erase(k);
+    }
+    for (auto& [gid, info] : gconf) {
+      auto& gs = groups_[gid];
+      gs.expected = info.first;
+      if (!gs.poisoned) {
+        gs.poisoned = true;
+        gs.error = "a member of fusion group " + std::to_string(gid) +
+                   " was submitted with conflicting process sets across "
+                   "ranks (group aborted)";
+      }
+      for (auto& [n2, r2] : gs.held) {
+        Response err;
+        err.kind = Response::Kind::ERROR;
+        err.names = r2.names;
+        err.members = r2.members;
+        err.error = gs.error;
+        out.push_back(std::move(err));
+        gs.released++;
+      }
+      gs.held.clear();
+      // one slot per conflicted tensor name (errored via errs below)
+      gs.released += static_cast<int>(info.second.size());
+      if (gs.released >= gs.expected) groups_.erase(gid);
     }
     for (auto& ce : errs) {
       Response err;
@@ -723,17 +732,9 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
   // ERROR responses must be member-targeted from the start: an
   // untargeted error would take a DISJOINT same-name set's pending
   // entries on innocent ranks and silently corrupt their collective
-  // (zero stand-ins). Target the union of the submitting requests'
-  // members — mismatched-membership errors must reach every submitter.
-  {
-    std::set<int64_t> uni;
-    bool global = false;
-    for (auto& q : reqs) {
-      if (q.members.empty()) global = true;
-      for (auto mr : q.members) uni.insert(mr);
-    }
-    if (!global) resp.members.assign(uni.begin(), uni.end());
-  }
+  // (zero stand-ins). All requests in one negotiation entry share the
+  // same member list by construction — the counts key encodes it.
+  resp.members = a.members;
   auto fail = [&](const std::string& why) {
     resp.kind = Response::Kind::ERROR;
     resp.error = why;
@@ -754,6 +755,8 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
       return fail("mismatched fusion group for tensor '" + a.name +
                   "' (all ranks must submit grouped collectives with "
                   "identical membership)");
+    // invariant guard — the negotiation key encodes the member list, so
+    // per-entry requests cannot differ unless the keying changes
     if (q.members != a.members)
       return fail("mismatched process set for tensor '" + a.name +
                   "' (every participant must pass the same set)");
@@ -780,7 +783,7 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
   resp.prescale = a.prescale;
   resp.postscale = a.postscale;
   resp.numels = {a.shape.num_elements()};
-  resp.members = a.members;
+  // resp.members already assigned at the top (error targeting)
 
   // participant count + rank → position map (identity for the global set)
   const int m = a.members.empty() ? size_

@@ -68,6 +68,95 @@ def MetricAverageCallback():
     return _make_callback(_M())
 
 
+def _make_lr_callback(jax_cb):
+    """Adapt an hvt.jax LR-schedule callback: sets the model optimizer's
+    learning rate at each epoch boundary (the reference's
+    ``LearningRateScheduleCallbackImpl`` assigns ``model.optimizer.lr``;
+    Keras 3 spells it ``learning_rate``)."""
+    _require_keras()
+
+    class _LrAdapter(_keras.callbacks.Callback):
+        def on_epoch_begin(self, epoch, logs=None):
+            jax_cb.on_epoch_begin(epoch)
+            # epoch granularity: evaluate the schedule at this epoch's
+            # first step (the non-staircase path derives the fractional
+            # epoch from step/steps_per_epoch, so step must track epochs)
+            lr = jax_cb.learning_rate(
+                step=epoch * (jax_cb.steps_per_epoch or 0))
+            if lr is None:
+                return
+            opt = self.model.optimizer
+            attr = ("learning_rate" if hasattr(opt, "learning_rate")
+                    else "lr")
+            try:
+                getattr(opt, attr).assign(lr)   # tf.Variable lr
+            except AttributeError:
+                setattr(opt, attr, lr)
+
+        def on_epoch_end(self, epoch, logs=None):
+            out = jax_cb.on_epoch_end(epoch, logs)
+            if out and logs is not None:
+                logs.update(out)
+
+    return _LrAdapter()
+
+
+def LearningRateScheduleCallback(initial_lr, multiplier, start_epoch=0,
+                                 end_epoch=None, staircase=True,
+                                 steps_per_epoch=None):
+    """Reference ``_keras/callbacks.py`` LearningRateScheduleCallback."""
+    from horovod_tpu.jax.callbacks import \
+        LearningRateScheduleCallback as _S
+
+    return _make_lr_callback(_S(initial_lr, multiplier,
+                                start_epoch=start_epoch,
+                                end_epoch=end_epoch, staircase=staircase,
+                                steps_per_epoch=steps_per_epoch))
+
+
+def LearningRateWarmupCallback(initial_lr, warmup_epochs=5,
+                               steps_per_epoch=None, verbose=False):
+    """Reference ``_keras/callbacks.py`` LearningRateWarmupCallback
+    ("Accurate Large Minibatch SGD" gradual warmup)."""
+    from horovod_tpu.jax.callbacks import LearningRateWarmupCallback as _W
+
+    return _make_lr_callback(_W(initial_lr, warmup_epochs=warmup_epochs,
+                                steps_per_epoch=steps_per_epoch,
+                                verbose=verbose))
+
+
+def CommitStateCallback(state, batches_per_commit=1):
+    """Commit elastic state every N batches (reference
+    ``_keras/elastic.py`` CommitStateCallbackImpl): a host failure rolls
+    back at most ``batches_per_commit`` batches."""
+    _require_keras()
+
+    class _Commit(_keras.callbacks.Callback):
+        def on_train_batch_end(self, batch, logs=None):
+            if (batch + 1) % batches_per_commit == 0:
+                state.commit()
+
+    return _Commit()
+
+
+def UpdateBatchStateCallback(state):
+    """Track epoch/batch position in elastic state so a restarted worker
+    resumes mid-epoch (reference ``_keras/elastic.py``
+    UpdateBatchStateCallbackImpl). ``state`` needs ``batch``/``epoch``
+    attributes (e.g. ``ObjectState(batch=0, epoch=0)``)."""
+    _require_keras()
+
+    class _Update(_keras.callbacks.Callback):
+        def on_train_batch_end(self, batch, logs=None):
+            state.batch = batch + 1
+
+        def on_epoch_end(self, epoch, logs=None):
+            state.epoch = epoch + 1
+            state.batch = 0
+
+    return _Update()
+
+
 def DistributedOptimizer(optimizer, *args, **kwargs):
     """Wrap a Keras optimizer so ``apply_gradients`` exchanges gradients
     across workers (reference ``keras/__init__.py:36`` — the reference

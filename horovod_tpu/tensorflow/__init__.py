@@ -88,11 +88,13 @@ def broadcast(tensor, root_rank=0, name=None, process_set=None):
 
 
 def broadcast_variables(variables, root_rank=0):
-    """Assign every tf.Variable the root rank's value (reference
-    ``tensorflow/functions.py`` broadcast_variables)."""
+    """Assign every variable the root rank's value (reference
+    ``tensorflow/functions.py`` broadcast_variables). Handles both
+    tf.Variable (``.value()`` method) and Keras 3 variables (``.value``
+    property) by reading through numpy."""
     _require_tf()
     for i, v in enumerate(variables):
-        v.assign(broadcast(v.value(), root_rank=root_rank,
+        v.assign(broadcast(np.asarray(v), root_rank=root_rank,
                            name=f"bcast_var_{i}"))
 
 

@@ -539,6 +539,32 @@ def test_tf_binding_tape_and_optimizer_2proc():
     assert "TF-OK-0" in out and "TF-OK-1" in out
 
 
+def test_tf_real_tape_2proc():
+    """Real tf.GradientTape through DistributedGradientTape over the
+    engine: gradients average across ranks (requires tensorflow)."""
+    import importlib.util
+
+    if importlib.util.find_spec("tensorflow") is None:
+        import pytest
+
+        pytest.skip("tensorflow not installed")
+    out = run_workers("""
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvt_tf
+
+        w = tf.Variable([1.0, 2.0])
+        with hvt_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(w * w) * float(r + 1)
+        (g,) = tape.gradient(loss, [w])
+        # local grad = 2w(r+1); average over ranks = 2w * mean(r+1)
+        np.testing.assert_allclose(
+            np.asarray(g), 2 * np.array([1.0, 2.0]) * (1 + n) / 2.0,
+            rtol=1e-6)
+        print(f"TFREAL-OK-{r}", flush=True)
+    """, timeout=180)
+    assert "TFREAL-OK-0" in out and "TFREAL-OK-1" in out
+
+
 def test_sparse_allreduce_unequal_nnz_2proc():
     """Regression: average must divide by world size on every rank even
     when ranks contribute different row counts (allgatherv)."""

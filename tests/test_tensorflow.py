@@ -1,7 +1,9 @@
-"""TF-binding tests with numpy-level fakes (TF is absent in this image —
-the same gated-fake pattern as the Ray/Spark suites; reference API under
-test: ``tensorflow/__init__.py:396-742`` DistributedOptimizer /
-_DistributedGradientTape)."""
+"""TF-binding tests with numpy-level fakes — the binding's core is
+framework-agnostic, so these run even without a TF install (the gated
+pattern the Ray/Spark suites use). Real-TF coverage lives in
+``test_tensorflow_real.py``. Reference API under test:
+``tensorflow/__init__.py:396-742`` DistributedOptimizer /
+_DistributedGradientTape."""
 
 import numpy as np
 
