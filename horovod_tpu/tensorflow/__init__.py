@@ -35,7 +35,14 @@ import numpy as np
 from horovod_tpu.common.basics import (cross_rank, cross_size,  # noqa: F401
                                        init, is_initialized, local_rank,
                                        local_size, rank, shutdown, size)
+# object collectives are framework-neutral (pickle → bytes → engine);
+# re-exported here for reference API parity (tensorflow/functions.py:
+# allgather_object / broadcast_object)
+from horovod_tpu.ops.functions import (allgather_object,  # noqa: F401
+                                       broadcast_object)
 from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
+from horovod_tpu.tensorflow.sync_batch_norm import \
+    SyncBatchNormalization  # noqa: F401
 
 
 def _require_tf():

@@ -1,0 +1,170 @@
+"""Cross-replica synchronized batch normalization for TF/Keras
+(reference ``horovod/tensorflow/sync_batch_norm.py:22``
+SyncBatchNormalization: batch statistics are combined across all
+workers, so normalization sees the GLOBAL batch).
+
+The reference subclasses BatchNormalization and overrides its private
+moment computation — brittle across Keras versions. This implementation
+is a self-contained Keras layer. Ranks exchange the count-weighted
+triple (count, sum, sum_sq) — uneven per-rank batches combine correctly
+— through ``tf.py_function`` (works eagerly and inside ``model.fit``'s
+compiled step). Gradient flow through the statistics is preserved by
+the surrogate
+
+    g_stat = (global_sum + local_sum - stop_gradient(local_sum)) / N
+
+whose value is the global statistic and whose gradient w.r.t. the local
+batch is exactly the global-batch gradient (other ranks' contributions
+are constants here).
+
+On the compiled JAX path use ``horovod_tpu.jax.sync_batch_norm`` (one
+``axis_name`` flag — the collective compiles into the program)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+_cls_cache = {}
+
+
+def _allreduce_stats_np(stacked: "np.ndarray", name: str) -> "np.ndarray":
+    """Sum [3, C] local (count, sum, sum_sq) rows across ranks —
+    count-weighted, so uneven per-rank batch sizes combine correctly
+    (the torch sibling exchanges the same triple,
+    torch/sync_batch_norm.py)."""
+    from horovod_tpu.engine import api as engine
+    from horovod_tpu.ops import collective_ops as C
+
+    h = engine.allreduce(stacked, op=C.Sum, name=name)
+    return np.asarray(h.wait(), dtype=stacked.dtype)
+
+
+def _build_class():
+    import tensorflow as tf
+
+    if "cls" in _cls_cache:
+        return _cls_cache["cls"]
+
+    class SyncBatchNormalization(tf.keras.layers.Layer):
+        """Self-contained synced BN layer (serializable: get_config /
+        from_config round-trip)."""
+
+        def __init__(self, axis=-1, momentum=0.99, epsilon=1e-3,
+                     center=True, scale=True,
+                     beta_initializer="zeros", gamma_initializer="ones",
+                     moving_mean_initializer="zeros",
+                     moving_variance_initializer="ones", **kwargs):
+            # reference accepts the full BatchNormalization signature;
+            # GPU-specific knobs are meaningless here and ignored
+            for ignored in ("fused", "renorm", "renorm_clipping",
+                            "renorm_momentum", "virtual_batch_size",
+                            "adjustment", "synchronized"):
+                kwargs.pop(ignored, None)
+            super().__init__(**kwargs)
+            self.axis = axis
+            self.momentum = momentum
+            self.epsilon = epsilon
+            self.center = center
+            self.scale = scale
+            init_get = tf.keras.initializers.get
+            self.beta_initializer = init_get(beta_initializer)
+            self.gamma_initializer = init_get(gamma_initializer)
+            self.moving_mean_initializer = init_get(
+                moving_mean_initializer)
+            self.moving_variance_initializer = init_get(
+                moving_variance_initializer)
+            self._call_seq = 0  # per-call collective-name sequence
+
+        def get_config(self):
+            cfg = super().get_config()
+            ser = tf.keras.initializers.serialize
+            cfg.update(dict(
+                axis=self.axis, momentum=self.momentum,
+                epsilon=self.epsilon, center=self.center,
+                scale=self.scale,
+                beta_initializer=ser(self.beta_initializer),
+                gamma_initializer=ser(self.gamma_initializer),
+                moving_mean_initializer=ser(self.moving_mean_initializer),
+                moving_variance_initializer=ser(
+                    self.moving_variance_initializer)))
+            return cfg
+
+        def build(self, input_shape):
+            dim = int(input_shape[self.axis])
+            self.gamma = self.add_weight(
+                name="gamma", shape=(dim,),
+                initializer=self.gamma_initializer, trainable=self.scale)
+            self.beta = self.add_weight(
+                name="beta", shape=(dim,),
+                initializer=self.beta_initializer, trainable=self.center)
+            self.moving_mean = self.add_weight(
+                name="moving_mean", shape=(dim,),
+                initializer=self.moving_mean_initializer, trainable=False)
+            self.moving_variance = self.add_weight(
+                name="moving_variance", shape=(dim,),
+                initializer=self.moving_variance_initializer,
+                trainable=False)
+
+        def call(self, x, training=False):
+            ndims = len(x.shape)
+            ch_axis = self.axis % ndims
+            reduce_axes = [d for d in range(ndims) if d != ch_axis]
+            if training:
+                count = tf.cast(
+                    tf.reduce_prod([tf.shape(x)[d] for d in reduce_axes]),
+                    x.dtype)
+                s1 = tf.reduce_sum(x, axis=reduce_axes)
+                s2 = tf.reduce_sum(tf.square(x), axis=reduce_axes)
+                stacked = tf.stack(
+                    [tf.fill(tf.shape(s1), count), s1, s2])
+                # collective names must be identical across ranks and
+                # unique among concurrently-pending tensors → key on the
+                # layer's (deterministic, SPMD-identical) name plus a
+                # per-call sequence (shared/Siamese reuse in one step)
+                coll_name = f"tf.syncbn.{self.name}.{self._call_seq}"
+                self._call_seq += 1
+                reduced = tf.py_function(
+                    lambda s: _allreduce_stats_np(s.numpy(), coll_name),
+                    inp=[tf.stop_gradient(stacked)], Tout=stacked.dtype)
+                reduced.set_shape(stacked.shape)
+                # count-weighted global stats; the surrogate keeps the
+                # local contribution differentiable: value = global sum /
+                # global count, gradient = d(local sum)/dx / global count
+                tot_n = reduced[0]
+                g_mean = (reduced[1] + s1 - tf.stop_gradient(s1)) / tot_n
+                g_msq = (reduced[2] + s2 - tf.stop_gradient(s2)) / tot_n
+                g_var = g_msq - tf.square(g_mean)
+                self.moving_mean.assign(
+                    self.momentum * self.moving_mean
+                    + (1.0 - self.momentum) * tf.stop_gradient(g_mean))
+                self.moving_variance.assign(
+                    self.momentum * self.moving_variance
+                    + (1.0 - self.momentum) * tf.stop_gradient(g_var))
+            else:
+                g_mean = self.moving_mean
+                g_var = self.moving_variance
+            shape = [1] * ndims
+            shape[ch_axis] = -1
+            g_mean = tf.reshape(g_mean, shape)
+            g_var = tf.reshape(g_var, shape)
+            out = (x - g_mean) * tf.math.rsqrt(g_var + self.epsilon)
+            if self.scale:
+                out = out * tf.reshape(self.gamma, shape)
+            if self.center:
+                out = out + tf.reshape(self.beta, shape)
+            return out
+
+    _cls_cache["cls"] = SyncBatchNormalization
+    return SyncBatchNormalization
+
+
+def SyncBatchNormalization(*args, **kwargs):
+    """Factory returning the Keras layer (import-gated; the class itself
+    is cached so isinstance/serialization round-trips work)."""
+    try:
+        import tensorflow  # noqa: F401
+    except ImportError as e:  # pragma: no cover - env without TF
+        raise ImportError(
+            "SyncBatchNormalization requires TensorFlow; the compiled "
+            "TPU path is horovod_tpu.jax.sync_batch_norm") from e
+    return _build_class()(*args, **kwargs)

@@ -565,6 +565,34 @@ def test_tf_real_tape_2proc():
     assert "TFREAL-OK-0" in out and "TFREAL-OK-1" in out
 
 
+def test_tf_sync_batch_norm_global_stats_2proc():
+    """TF SyncBatchNormalization over the engine: each rank's
+    normalization must use the GLOBAL batch statistics (requires
+    tensorflow)."""
+    import importlib.util
+
+    if importlib.util.find_spec("tensorflow") is None:
+        import pytest
+
+        pytest.skip("tensorflow not installed")
+    out = run_workers("""
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvt_tf
+
+        # UNEVEN batches: rank 0 has 2 rows of 0, rank 1 has 6 rows of
+        # 8 → count-weighted global mean 6, var = 48/8·... E[x²]=48 →
+        # var = 48 - 36 = 12 (equal-weight averaging would give mean 4)
+        rows = 2 if r == 0 else 6
+        x = tf.constant(np.full((rows, 3), float(r * 8), np.float32))
+        bn = hvt_tf.SyncBatchNormalization(epsilon=1e-5)
+        y = bn(x, training=True)
+        expect = (r * 8 - 6.0) / np.sqrt(12.0 + 1e-5)
+        np.testing.assert_allclose(y.numpy(), expect, rtol=1e-4)
+        print(f"SBN-OK-{r}", flush=True)
+    """, timeout=180)
+    assert "SBN-OK-0" in out and "SBN-OK-1" in out
+
+
 def test_sparse_allreduce_unequal_nnz_2proc():
     """Regression: average must divide by world size on every rank even
     when ranks contribute different row counts (allgatherv)."""
