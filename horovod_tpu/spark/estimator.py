@@ -4,15 +4,22 @@ DataFrame → distributed fit → Spark Transformer, with ``Store``-backed
 checkpointing and callbacks plumbed into the executor training loop —
 reference ``spark/keras/remote.py`` / ``spark/torch/remote.py``).
 
-Two flavors:
+Three flavors:
 
 - :class:`JaxEstimator` — wraps a user ``train_fn`` (the JAX-native
-  analog of the reference's Keras flavor); the loop is the user's.
+  flavor); the loop is the user's.
+- :class:`KerasEstimator` — owns an epoch-structured Keras loop (model
+  shipped as ``.keras`` bytes, gradients through
+  ``DistributedGradientTape``) — the reference's
+  ``spark/keras/estimator.py:106``.
 - :class:`TorchEstimator` — owns an epoch-structured torch training loop
   (module + optimizer factory + loss), gradients combined through
-  ``horovod_tpu.torch.DistributedOptimizer``, per-epoch checkpoints
-  published to the store via the local-scratch-dir + sync contract, and
-  ``callbacks`` with ``on_epoch_end(epoch, logs)`` invoked on rank 0.
+  ``horovod_tpu.torch.DistributedOptimizer`` — the reference's
+  ``spark/torch/estimator.py:91``.
+
+Both owned loops publish per-epoch checkpoints to the store via the
+local-scratch-dir + sync contract and invoke ``callbacks``
+(``on_epoch_end(epoch, logs)``) on rank 0.
 
 The reference materializes DataFrames through Petastorm stores
 (``spark/common/store.py``); TPU-natively the estimator converts the
@@ -50,6 +57,45 @@ def _pickle_dumps(obj) -> bytes:
 def _local_run(worker, num_proc=None, **_kw):
     """In-process run_fn used by the fake test rig (world size 1)."""
     return [worker()]
+
+
+def _steps_per_epoch(global_rows: int, n_procs: int, batch_size: int
+                     ) -> int:
+    """Identical step count on every rank (largest shard, rounded up) —
+    per-step gradient collectives must stay in lockstep even when shard
+    sizes differ by one."""
+    shard_max = (global_rows + n_procs - 1) // max(n_procs, 1)
+    return max(1, (shard_max + batch_size - 1) // batch_size)
+
+
+def _spark_transform(df, predict, feature_cols, output_col):
+    """Shared Transformer body: mapPartitions batched inference appending
+    ``output_col`` (used by Jax/Keras/Torch models alike)."""
+    from horovod_tpu.spark.runner import _require_pyspark
+
+    _require_pyspark()
+    import numpy as np
+    from pyspark.sql import Row
+    from pyspark.sql.types import DoubleType, StructField, StructType
+
+    def infer(rows_iter):
+        rows = list(rows_iter)
+        if not rows:
+            return
+        Xp = np.asarray([[rw[c] for c in feature_cols] for rw in rows],
+                        dtype=np.float32)
+        for rw, pv in zip(rows, np.asarray(predict(Xp)).reshape(-1)
+                          .tolist()):
+            d = rw.asDict()
+            d[output_col] = float(pv)
+            yield Row(**d)
+
+    # explicit schema: inference from an empty RDD fails, and the
+    # empty-input case must still yield the prediction column
+    schema = StructType(df.schema.fields
+                        + [StructField(output_col, DoubleType())])
+    return df.sparkSession.createDataFrame(
+        df.rdd.mapPartitions(infer), schema)
 
 
 def _collect_xy(df, feature_cols, label_col):
@@ -122,7 +168,9 @@ class JaxEstimator(_EstimatorBase):
             import horovod_tpu as hvt
 
             bx, by = bc.value if bc is not None else (X, y)
-            n, r = hvt.size(), hvt.rank()
+            # shard by PROCESS: the estimator loop is per-worker-process
+            # (a process may drive several chips; hvt.size() counts chips)
+            n, r = hvt.process_size(), hvt.process_rank()
             return train_fn(bx[r::n], by[r::n], epochs)
 
         results = run_fn(worker, num_proc=self.num_proc,
@@ -168,35 +216,9 @@ class JaxModel:
         return np.asarray(self.predict_fn(self.params, X))
 
     def transform(self, df):
-        from horovod_tpu.spark.runner import _require_pyspark
-
-        _require_pyspark()
-        import numpy as np
-        from pyspark.sql import Row
-        from pyspark.sql.types import DoubleType, StructField, StructType
-
-        params, predict_fn = self.params, self.predict_fn
-        feature_cols, output_col = self.feature_cols, self.output_col
-
-        def infer(rows_iter):
-            rows = list(rows_iter)
-            if not rows:
-                return
-            X = np.asarray([[r[c] for c in feature_cols] for r in rows],
-                           dtype=np.float32)
-            preds = np.asarray(predict_fn(params, X)).tolist()
-            for r, p in zip(rows, preds):
-                d = r.asDict()
-                d[output_col] = float(p)
-                yield Row(**d)
-
-        # explicit schema: inference from an empty RDD fails, and the
-        # empty-input case must still yield a DataFrame with the
-        # prediction column
-        schema = StructType(df.schema.fields
-                            + [StructField(output_col, DoubleType())])
-        return df.sparkSession.createDataFrame(
-            df.rdd.mapPartitions(infer), schema)
+        return _spark_transform(df, self._predict_arrays,
+                                self.feature_cols,
+                                self.output_col)
 
 
 class TorchEstimator(_EstimatorBase):
@@ -258,7 +280,9 @@ class TorchEstimator(_EstimatorBase):
             import horovod_tpu.torch as hvt_torch
 
             bx, by = bc.value if bc is not None else (X, y)
-            n, r = hvt.size(), hvt.rank()
+            # shard by PROCESS: the estimator loop is per-worker-process
+            # (a process may drive several chips; hvt.size() counts chips)
+            n, r = hvt.process_size(), hvt.process_rank()
             sx = torch.from_numpy(np.ascontiguousarray(bx[r::n]))
             sy = torch.from_numpy(np.ascontiguousarray(by[r::n]))
             model = pickle.loads(model_blob)
@@ -267,16 +291,22 @@ class TorchEstimator(_EstimatorBase):
                 named_parameters=model.named_parameters())
             hvt_torch.broadcast_parameters(model.state_dict(), root_rank=0)
             lf = loss_fn or torch.nn.functional.mse_loss
+            # equal step count on every rank (see _steps_per_epoch): the
+            # per-step gradient collectives must stay in lockstep
+            steps = _steps_per_epoch(len(bx), n, batch_size)
 
             def train_epochs(ckpt_dir=None, on_epoch=None):
                 history = []
                 for epoch in range(epochs):
-                    perm = torch.randperm(
-                        len(sx), generator=torch.Generator().manual_seed(
-                            1000 + epoch))
+                    perm = torch.from_numpy(np.resize(
+                        torch.randperm(
+                            len(sx),
+                            generator=torch.Generator().manual_seed(
+                                1000 + epoch)).numpy(),
+                        steps * batch_size))
                     total, batches = 0.0, 0
-                    for i in range(0, len(sx), batch_size):
-                        idx = perm[i:i + batch_size]
+                    for s in range(steps):
+                        idx = perm[s * batch_size:(s + 1) * batch_size]
                         opt.zero_grad()
                         pred = model(sx[idx])
                         loss = lf(pred.reshape(-1), sy[idx].reshape(-1))
@@ -327,6 +357,203 @@ class TorchEstimator(_EstimatorBase):
         return TorchModel(model, self.feature_cols)
 
 
+class KerasEstimator(_EstimatorBase):
+    """Keras-flavor estimator (reference ``spark/keras/estimator.py:106``
+    KerasEstimator + the executor loop in ``spark/keras/remote.py``).
+
+    The model ships to workers as serialized ``.keras`` bytes; each
+    worker rebuilds it, broadcasts rank 0's initial weights, and runs an
+    epoch-structured loop with gradients exchanged through
+    ``DistributedGradientTape``. Checkpoints/callbacks follow the same
+    Store contract as :class:`TorchEstimator`.
+    """
+
+    def __init__(self, model, feature_cols: List[str], label_col: str,
+                 optimizer="sgd", loss="mse",
+                 num_proc: Optional[int] = None, epochs: int = 1,
+                 batch_size: int = 32, master_port: int = 29577,
+                 store=None, run_id: Optional[str] = None,
+                 callbacks: Optional[list] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_col = label_col
+        self.num_proc = num_proc
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.master_port = master_port
+        self.store = store
+        self.run_id = run_id or f"keras-{uuid.uuid4().hex[:8]}"
+        self.callbacks = list(callbacks or [])
+
+    @staticmethod
+    def _model_to_bytes(model) -> bytes:
+        import os
+        import tempfile
+
+        fd, path = tempfile.mkstemp(suffix=".keras")
+        os.close(fd)
+        try:
+            model.save(path)
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
+
+    @staticmethod
+    def _model_from_bytes(blob: bytes):
+        import os
+        import tempfile
+
+        import tensorflow as tf
+
+        fd, path = tempfile.mkstemp(suffix=".keras")
+        os.close(fd)
+        try:
+            with open(path, "wb") as f:
+                f.write(blob)
+            return tf.keras.models.load_model(path)
+        finally:
+            os.unlink(path)
+
+    def _fit_arrays(self, X, y, run_fn=None, broadcast=None
+                    ) -> "KerasModel":
+        import tensorflow as tf
+
+        run_fn = run_fn or _local_run
+        model_blob = self._model_to_bytes(self.model)
+        # ship the optimizer as CONFIG: Keras 3 optimizers bind to the
+        # variables they are first built against, so sharing an instance
+        # across fits/workers breaks
+        opt_cfg = tf.keras.optimizers.serialize(
+            tf.keras.optimizers.get(self.optimizer))
+        loss = self.loss
+        epochs, batch_size = self.epochs, self.batch_size
+        store, run_id = self.store, self.run_id
+        callbacks = self.callbacks
+        bc = broadcast
+
+        def worker():
+            import numpy as np
+            import tensorflow as tf
+
+            import horovod_tpu as hvt
+            import horovod_tpu.tensorflow as hvt_tf
+
+            bx, by = bc.value if bc is not None else (X, y)
+            # shard by PROCESS: the estimator loop is per-worker-process
+            # (a process may drive several chips; hvt.size() counts chips)
+            n, r = hvt.process_size(), hvt.process_rank()
+            sx = np.ascontiguousarray(bx[r::n])
+            sy = np.ascontiguousarray(by[r::n])
+            model = KerasEstimator._model_from_bytes(model_blob)
+            opt = tf.keras.optimizers.deserialize(opt_cfg)
+            loss_fn = tf.keras.losses.get(loss)
+            model(tf.constant(sx[:1]))  # build weights
+            hvt_tf.broadcast_variables(model.weights, root_rank=0)
+            # every rank must run the SAME number of steps per epoch —
+            # uneven shards would desynchronize the per-step gradient
+            # collectives (wrap-around padding; global row count is
+            # known to all ranks)
+            steps = _steps_per_epoch(len(bx), n, batch_size)
+
+            def train_epochs(ckpt_dir=None, on_epoch=None):
+                history = []
+                for epoch in range(epochs):
+                    perm = np.resize(
+                        np.random.RandomState(1000 + epoch).permutation(
+                            len(sx)), steps * batch_size)
+                    total, batches = 0.0, 0
+                    for s in range(steps):
+                        idx = perm[s * batch_size:(s + 1) * batch_size]
+                        xb = tf.constant(sx[idx])
+                        yb = tf.constant(sy[idx])
+                        with hvt_tf.DistributedGradientTape(
+                                tf.GradientTape()) as tape:
+                            pred = model(xb, training=True)
+                            lv = tf.reduce_mean(loss_fn(
+                                tf.reshape(yb, [-1]),
+                                tf.reshape(pred, [-1])))
+                        grads = tape.gradient(
+                            lv, model.trainable_variables)
+                        opt.apply_gradients(
+                            zip(grads, model.trainable_variables))
+                        total += float(lv)
+                        batches += 1
+                    logs = {"loss": total / max(batches, 1)}
+                    history.append(logs)
+                    if r == 0:
+                        for cb in callbacks:
+                            cb.on_epoch_end(epoch, dict(logs))
+                        if ckpt_dir is not None:
+                            model.save_weights(
+                                f"{ckpt_dir}/checkpoint-{epoch}"
+                                f".weights.h5")
+                            if on_epoch is not None:
+                                on_epoch()
+                return history
+
+            if store is not None and r == 0:
+                sync = store.sync_fn(run_id)
+                with store.get_local_output_dir_fn(run_id)() as d:
+                    history = train_epochs(ckpt_dir=d,
+                                           on_epoch=lambda: sync(d))
+            else:
+                history = train_epochs()
+            return KerasEstimator._model_to_bytes(model), history
+
+        results = run_fn(worker, num_proc=self.num_proc,
+                         master_port=self.master_port)
+        final_blob, history = results[0]
+        model = self._model_from_bytes(final_blob)
+        if store is not None:
+            store.write(store.get_checkpoint_path(run_id), final_blob)
+            store.write(
+                store.get_run_path(run_id) + "/meta.json",
+                json.dumps({"feature_cols": self.feature_cols,
+                            "label_col": self.label_col}).encode())
+            store.write(
+                store.get_logs_path(run_id) + "/history.json",
+                json.dumps(history).encode())
+        return KerasModel(model, self.feature_cols)
+
+
+class KerasModel:
+    """Transformer produced by ``KerasEstimator.fit`` (reference
+    ``spark/keras`` KerasModel)."""
+
+    def __init__(self, model, feature_cols: List[str],
+                 output_col: str = "prediction"):
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.output_col = output_col
+
+    @classmethod
+    def load(cls, store, run_id: str, feature_cols=None,
+             output_col: str = "prediction") -> "KerasModel":
+        blob = store.read(store.get_checkpoint_path(run_id))
+        model = KerasEstimator._model_from_bytes(blob)
+        if feature_cols is None:
+            meta = json.loads(store.read(
+                store.get_run_path(run_id) + "/meta.json"))
+            feature_cols = meta["feature_cols"]
+        return cls(model, feature_cols=list(feature_cols),
+                   output_col=output_col)
+
+    def _predict_arrays(self, X):
+        import numpy as np
+
+        out = self.model.predict(
+            np.ascontiguousarray(np.asarray(X, np.float32)), verbose=0)
+        return np.asarray(out).reshape(len(X), -1).squeeze(-1)
+
+    def transform(self, df):
+        return _spark_transform(df, self._predict_arrays,
+                                self.feature_cols,
+                                self.output_col)
+
+
 class TorchModel:
     """Transformer produced by ``TorchEstimator.fit``."""
 
@@ -364,28 +591,6 @@ class TorchModel:
         return out.reshape(len(X), -1).squeeze(-1).numpy()
 
     def transform(self, df):
-        from horovod_tpu.spark.runner import _require_pyspark
-
-        _require_pyspark()
-        import numpy as np
-        from pyspark.sql import Row
-        from pyspark.sql.types import DoubleType, StructField, StructType
-
-        feature_cols, output_col = self.feature_cols, self.output_col
-        predict = self._predict_arrays
-
-        def infer(rows_iter):
-            rows = list(rows_iter)
-            if not rows:
-                return
-            X = np.asarray([[r[c] for c in feature_cols] for r in rows],
-                           dtype=np.float32)
-            for r, p in zip(rows, predict(X).tolist()):
-                d = r.asDict()
-                d[output_col] = float(p)
-                yield Row(**d)
-
-        schema = StructType(df.schema.fields
-                            + [StructField(output_col, DoubleType())])
-        return df.sparkSession.createDataFrame(
-            df.rdd.mapPartitions(infer), schema)
+        return _spark_transform(df, self._predict_arrays,
+                                self.feature_cols,
+                                self.output_col)

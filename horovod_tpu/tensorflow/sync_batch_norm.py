@@ -22,20 +22,35 @@ On the compiled JAX path use ``horovod_tpu.jax.sync_batch_norm`` (one
 
 from __future__ import annotations
 
+import collections
+import threading
+
 import numpy as np
 
 _cls_cache = {}
+_seq_lock = threading.Lock()
+_seq_counters = collections.defaultdict(int)
 
 
-def _allreduce_stats_np(stacked: "np.ndarray", name: str) -> "np.ndarray":
+def _allreduce_stats_np(stacked: "np.ndarray", layer_name: str
+                        ) -> "np.ndarray":
     """Sum [3, C] local (count, sum, sum_sq) rows across ranks —
     count-weighted, so uneven per-rank batch sizes combine correctly
     (the torch sibling exchanges the same triple,
-    torch/sync_batch_norm.py)."""
+    torch/sync_batch_norm.py).
+
+    The collective name carries a RUNTIME per-layer sequence number:
+    ranks pair the i-th invocation of a layer with peers' i-th
+    invocation, which follows data-flow order (trace-time counters would
+    diverge across ranks under unequal retracing)."""
     from horovod_tpu.engine import api as engine
     from horovod_tpu.ops import collective_ops as C
 
-    h = engine.allreduce(stacked, op=C.Sum, name=name)
+    with _seq_lock:
+        seq = _seq_counters[layer_name]
+        _seq_counters[layer_name] += 1
+    h = engine.allreduce(stacked, op=C.Sum,
+                         name=f"tf.syncbn.{layer_name}.{seq}")
     return np.asarray(h.wait(), dtype=stacked.dtype)
 
 
@@ -45,9 +60,11 @@ def _build_class():
     if "cls" in _cls_cache:
         return _cls_cache["cls"]
 
+    @tf.keras.utils.register_keras_serializable(package="horovod_tpu")
     class SyncBatchNormalization(tf.keras.layers.Layer):
         """Self-contained synced BN layer (serializable: get_config /
-        from_config round-trip)."""
+        from_config round-trip; registered so load_model needs no
+        custom_objects)."""
 
         def __init__(self, axis=-1, momentum=0.99, epsilon=1e-3,
                      center=True, scale=True,
@@ -73,7 +90,7 @@ def _build_class():
                 moving_mean_initializer)
             self.moving_variance_initializer = init_get(
                 moving_variance_initializer)
-            self._call_seq = 0  # per-call collective-name sequence
+
 
         def get_config(self):
             cfg = super().get_config()
@@ -117,14 +134,14 @@ def _build_class():
                 s2 = tf.reduce_sum(tf.square(x), axis=reduce_axes)
                 stacked = tf.stack(
                     [tf.fill(tf.shape(s1), count), s1, s2])
-                # collective names must be identical across ranks and
-                # unique among concurrently-pending tensors → key on the
-                # layer's (deterministic, SPMD-identical) name plus a
-                # per-call sequence (shared/Siamese reuse in one step)
-                coll_name = f"tf.syncbn.{self.name}.{self._call_seq}"
-                self._call_seq += 1
+                # the exchange sequences itself at RUNTIME per layer name
+                # (see _allreduce_stats_np); note for exotic graphs that
+                # invoke the SAME instance concurrently on independent
+                # branches: use separate instances so pairing order is
+                # data-flow-determined
+                layer_name = self.name
                 reduced = tf.py_function(
-                    lambda s: _allreduce_stats_np(s.numpy(), coll_name),
+                    lambda s: _allreduce_stats_np(s.numpy(), layer_name),
                     inp=[tf.stop_gradient(stacked)], Tout=stacked.dtype)
                 reduced.set_shape(stacked.shape)
                 # count-weighted global stats; the surrogate keeps the
@@ -158,13 +175,14 @@ def _build_class():
     return SyncBatchNormalization
 
 
-def SyncBatchNormalization(*args, **kwargs):
-    """Factory returning the Keras layer (import-gated; the class itself
-    is cached so isinstance/serialization round-trips work)."""
-    try:
-        import tensorflow  # noqa: F401
-    except ImportError as e:  # pragma: no cover - env without TF
+try:
+    import tensorflow as _tf_present  # noqa: F401
+
+    # real class export: isinstance(layer, SyncBatchNormalization) works
+    # and the keras serialization registry knows it
+    SyncBatchNormalization = _build_class()
+except ImportError:  # pragma: no cover - env without TF
+    def SyncBatchNormalization(*args, **kwargs):
         raise ImportError(
             "SyncBatchNormalization requires TensorFlow; the compiled "
-            "TPU path is horovod_tpu.jax.sync_batch_norm") from e
-    return _build_class()(*args, **kwargs)
+            "TPU path is horovod_tpu.jax.sync_batch_norm")

@@ -241,3 +241,48 @@ def test_torch_estimator_fit_checkpoints_callbacks_load(tmp_path):
                              feature_cols=["a", "b", "c"])
     np.testing.assert_allclose(loaded._predict_arrays(X), preds,
                                rtol=1e-6)
+
+
+def test_keras_estimator_fit_checkpoints_load(tmp_path):
+    import pytest
+
+    tf = pytest.importorskip("tensorflow")
+    import numpy as np
+
+    from horovod_tpu.spark import KerasEstimator, KerasModel, Store
+
+    rs = np.random.RandomState(9)
+    X = rs.randn(128, 3).astype(np.float32)
+    y = (X @ np.asarray([1.0, -0.5, 2.0], np.float32))
+    store = Store.create(str(tmp_path / "st"))
+    rec = _EpochRecorder()
+    model = tf.keras.Sequential(
+        [tf.keras.Input((3,)), tf.keras.layers.Dense(1, use_bias=False)])
+    est = KerasEstimator(model, feature_cols=["a", "b", "c"],
+                         label_col="y",
+                         optimizer=tf.keras.optimizers.SGD(0.1),
+                         loss="mse", epochs=10, batch_size=16,
+                         store=store, run_id="krun", callbacks=[rec])
+    fitted = est._fit_arrays(X, y)
+    assert [e for e, _ in rec.epochs] == list(range(10))
+    assert rec.epochs[-1][1] < rec.epochs[0][1]
+    preds = fitted._predict_arrays(X)
+    assert np.mean((preds - y) ** 2) < 0.1
+    for ep in range(10):
+        assert store.exists(store.get_run_path("krun")
+                            + f"/checkpoint-{ep}.weights.h5")
+    loaded = KerasModel.load(store, "krun")
+    assert loaded.feature_cols == ["a", "b", "c"]
+    np.testing.assert_allclose(loaded._predict_arrays(X), preds,
+                               rtol=1e-5)
+
+
+def test_steps_per_epoch_lockstep():
+    from horovod_tpu.spark.estimator import _steps_per_epoch
+
+    # 33 rows over 2 procs, batch 16: shards are 17/16 rows — both ranks
+    # must run ceil(17/16) = 2 steps
+    assert _steps_per_epoch(33, 2, 16) == 2
+    assert _steps_per_epoch(32, 2, 16) == 1
+    assert _steps_per_epoch(5, 8, 4) == 1     # more procs than rows
+    assert _steps_per_epoch(100, 1, 10) == 10
