@@ -68,6 +68,20 @@ def _steps_per_epoch(global_rows: int, n_procs: int, batch_size: int
     return max(1, (shard_max + batch_size - 1) // batch_size)
 
 
+def _shard_rows(global_rows: int, r: int, n: int):
+    """Row indices of rank ``r``'s shard (strided, like the reference's
+    Petastorm row-group sharding). Every rank must come back non-empty —
+    a rank with no rows could not run the lockstep per-step collectives —
+    so when there are fewer rows than ranks the tail ranks wrap around
+    (sampling with replacement on tiny datasets)."""
+    import numpy as np
+
+    rows = np.arange(global_rows)[r::n]
+    if rows.size == 0 and global_rows > 0:
+        rows = np.asarray([r % global_rows])
+    return rows
+
+
 def _spark_transform(df, predict, feature_cols, output_col):
     """Shared Transformer body: mapPartitions batched inference appending
     ``output_col`` (used by Jax/Keras/Torch models alike)."""
@@ -171,7 +185,8 @@ class JaxEstimator(_EstimatorBase):
             # shard by PROCESS: the estimator loop is per-worker-process
             # (a process may drive several chips; hvt.size() counts chips)
             n, r = hvt.process_size(), hvt.process_rank()
-            return train_fn(bx[r::n], by[r::n], epochs)
+            rows = _shard_rows(len(bx), r, n)
+            return train_fn(bx[rows], by[rows], epochs)
 
         results = run_fn(worker, num_proc=self.num_proc,
                          master_port=self.master_port)
@@ -283,8 +298,9 @@ class TorchEstimator(_EstimatorBase):
             # shard by PROCESS: the estimator loop is per-worker-process
             # (a process may drive several chips; hvt.size() counts chips)
             n, r = hvt.process_size(), hvt.process_rank()
-            sx = torch.from_numpy(np.ascontiguousarray(bx[r::n]))
-            sy = torch.from_numpy(np.ascontiguousarray(by[r::n]))
+            rows = _shard_rows(len(bx), r, n)
+            sx = torch.from_numpy(np.ascontiguousarray(bx[rows]))
+            sy = torch.from_numpy(np.ascontiguousarray(by[rows]))
             model = pickle.loads(model_blob)
             opt = hvt_torch.DistributedOptimizer(
                 optimizer_fn(model.parameters()),
@@ -445,8 +461,9 @@ class KerasEstimator(_EstimatorBase):
             # shard by PROCESS: the estimator loop is per-worker-process
             # (a process may drive several chips; hvt.size() counts chips)
             n, r = hvt.process_size(), hvt.process_rank()
-            sx = np.ascontiguousarray(bx[r::n])
-            sy = np.ascontiguousarray(by[r::n])
+            rows = _shard_rows(len(bx), r, n)
+            sx = np.ascontiguousarray(bx[rows])
+            sy = np.ascontiguousarray(by[rows])
             model = KerasEstimator._model_from_bytes(model_blob)
             opt = tf.keras.optimizers.deserialize(opt_cfg)
             loss_fn = tf.keras.losses.get(loss)

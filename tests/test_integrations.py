@@ -286,3 +286,20 @@ def test_steps_per_epoch_lockstep():
     assert _steps_per_epoch(32, 2, 16) == 1
     assert _steps_per_epoch(5, 8, 4) == 1     # more procs than rows
     assert _steps_per_epoch(100, 1, 10) == 10
+
+
+def test_shard_rows_never_empty():
+    # every rank must get >=1 row or the lockstep per-step collectives
+    # desynchronize (ranks with empty shards would crash out of the loop)
+    import numpy as np
+
+    from horovod_tpu.spark.estimator import _shard_rows
+
+    for total, n in [(5, 8), (1, 4), (8, 8), (33, 2), (3, 3)]:
+        for r in range(n):
+            rows = _shard_rows(total, r, n)
+            assert rows.size >= 1, (total, r, n)
+            assert (rows < total).all()
+    # normal case unchanged: strided, disjoint, complete
+    got = np.sort(np.concatenate([_shard_rows(33, r, 2) for r in range(2)]))
+    np.testing.assert_array_equal(got, np.arange(33))
