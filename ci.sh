@@ -22,6 +22,7 @@ FAST=0
 
 echo "=== [1/4] build C++ engine ==="
 make -C horovod_tpu/csrc -j
+make -C horovod_tpu/csrc tf_ops   # no-op when TF is not importable
 
 echo "=== [2/4] test suite ==="
 python -m pytest tests/ -x -q

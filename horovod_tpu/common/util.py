@@ -1,0 +1,19 @@
+"""Small shared helpers (reference ``horovod/common/util.py``)."""
+
+from __future__ import annotations
+
+
+def split_list(xs, num_parts):
+    """Near-equal contiguous split into at most ``num_parts`` non-empty
+    chunks (reference ``common/util.py`` split_list; used for
+    ``num_groups`` gradient grouping in the torch and mxnet bindings)."""
+    if not xs:
+        return []
+    num_parts = min(num_parts, len(xs))
+    base, extra = divmod(len(xs), num_parts)
+    out, i = [], 0
+    for p in range(num_parts):
+        n = base + (1 if p < extra else 0)
+        out.append(xs[i:i + n])
+        i += n
+    return out

@@ -1,0 +1,445 @@
+// TensorFlow custom-op library — the native analog of the reference's
+// tensorflow/mpi_ops.cc (HorovodAllreduceOp:374 AsyncOpKernel,
+// HorovodAllgatherOp:571, HorovodBroadcastOp:642, HorovodAlltoallOp:873,
+// scalar Size/Rank ops :758-856). Each op enqueues the tensor into the
+// background engine and defers the TF `done` callback until the collective
+// completes, so TF executor threads are never blocked on the network.
+//
+// Linkage: this library talks to the engine ONLY through the extern "C"
+// surface (c_api.cc) and links against libhvt_core.so with an $ORIGIN
+// rpath. That keeps one Engine singleton per process (the ctypes bridge
+// dlopens the same path) and makes the boundary immune to whatever
+// C++ ABI flags TensorFlow was compiled with.
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tensorflow/core/framework/common_shape_fns.h"
+#include "tensorflow/core/framework/op.h"
+#include "tensorflow/core/framework/op_kernel.h"
+#include "tensorflow/core/framework/shape_inference.h"
+
+extern "C" {
+// mirrors c_api.cc; wire ids match csrc/common.h enums
+int hvt_initialized();
+int hvt_rank();
+int hvt_size();
+int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,
+               const long long* dims, const void* data, long long nbytes,
+               int root_rank, double prescale, double postscale,
+               int nsplits, const long long* splits, int group_id,
+               int group_size, int n_members, const long long* members);
+int hvt_wait(int handle);
+long long hvt_result_bytes(int handle);
+void hvt_result_read(int handle, void* dst, long long nbytes);
+int hvt_result_recv_splits(int handle, long long* dst, int max_n);
+void hvt_release(int handle);
+int hvt_error_message(char* dst, int max_n);
+}
+
+namespace hvt_tf {
+
+using namespace tensorflow;  // NOLINT
+
+enum WireOp { OP_ALLREDUCE = 0, OP_ALLGATHER = 1, OP_BROADCAST = 2,
+              OP_ALLTOALL = 3 };
+
+static int WireDType(DataType dt) {
+  switch (dt) {
+    case DT_UINT8: return 0;
+    case DT_INT8: return 1;
+    case DT_INT32: return 4;
+    case DT_INT64: return 5;
+    case DT_HALF: return 6;
+    case DT_FLOAT: return 7;
+    case DT_DOUBLE: return 8;
+    case DT_BOOL: return 9;
+    case DT_BFLOAT16: return 10;
+    default: return -1;
+  }
+}
+
+// One dedicated waiter thread serves all outstanding collectives:
+// hvt_wait stores its result in C thread-locals, so wait + result reads
+// must happen on one thread (same contract the ctypes bridge documents).
+// The engine executes fused responses serially anyway, so a single waiter
+// does not reduce parallelism.
+class Waiter {
+ public:
+  static Waiter& Get() {
+    // Intentionally leaked: exit() must not run ~Waiter while the detached
+    // thread still waits on the condition variable (destroying a cv in use
+    // deadlocks glibc — observed as workers hanging after main returns).
+    static Waiter* w = new Waiter();
+    return *w;
+  }
+
+  void Enqueue(std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> l(mu_);
+      queue_.push_back(std::move(fn));
+    }
+    cv_.notify_one();
+  }
+
+ private:
+  Waiter() {
+    thread_ = std::thread([this] { Loop(); });
+    thread_.detach();  // process-lifetime singleton
+  }
+
+  void Loop() {
+    for (;;) {
+      std::function<void()> fn;
+      {
+        std::unique_lock<std::mutex> l(mu_);
+        cv_.wait(l, [this] { return !queue_.empty(); });
+        fn = std::move(queue_.front());
+        queue_.pop_front();
+      }
+      fn();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<std::function<void()>> queue_;
+  std::thread thread_;
+};
+
+static std::string LastError() {
+  char buf[1024];
+  hvt_error_message(buf, sizeof(buf));
+  return std::string(buf);
+}
+
+// Shared submit → wait → allocate-output plumbing for the collective
+// kernels. `name` keys cross-rank matching (the engine's tensor table
+// dedups and negotiates by name), so it must be identical across ranks —
+// callers default it to the TF node name, which SPMD graphs replicate.
+struct SubmitArgs {
+  std::string name;
+  int op = OP_ALLREDUCE;
+  int reduce = 0;
+  int root_rank = 0;
+  double prescale = 1.0, postscale = 1.0;
+  std::vector<long long> splits;
+  int group_id = -1, group_size = 0;
+  std::vector<long long> members;
+};
+
+class HvtAsyncOpBase : public AsyncOpKernel {
+ public:
+  explicit HvtAsyncOpBase(OpKernelConstruction* ctx) : AsyncOpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &tensor_name_));
+    if (ctx->HasAttr("process_set_ranks")) {
+      std::vector<int64_t> ranks;
+      OP_REQUIRES_OK(ctx, ctx->GetAttr("process_set_ranks", &ranks));
+      members_.assign(ranks.begin(), ranks.end());
+    }
+  }
+
+ protected:
+  std::string Key(OpKernelContext* ctx) const {
+    if (!tensor_name_.empty()) return tensor_name_;
+    return std::string(ctx->op_kernel().name());
+  }
+
+  // Submits and schedules completion. `fill` runs on the waiter thread
+  // after a successful wait; it must allocate + fill the outputs.
+  void SubmitAndDefer(OpKernelContext* ctx, DoneCallback done,
+                      const Tensor& input, const SubmitArgs& args,
+                      std::function<Status(int handle)> fill) {
+    if (!hvt_initialized()) {
+      ctx->CtxFailure(errors::FailedPrecondition(
+          "hvt engine not initialized — call horovod_tpu.init() under the "
+          "hvtrun launcher (multi-process) before using native TF ops"));
+      done();
+      return;
+    }
+    int wire_dtype = WireDType(input.dtype());
+    if (wire_dtype < 0) {
+      ctx->CtxFailure(errors::InvalidArgument(
+          "unsupported dtype for hvt collective: ",
+          DataTypeString(input.dtype())));
+      done();
+      return;
+    }
+    std::vector<long long> dims;
+    for (int i = 0; i < input.dims(); ++i) dims.push_back(input.dim_size(i));
+    auto data = input.tensor_data();
+    int handle = hvt_submit(
+        args.name.c_str(), args.op, args.reduce, wire_dtype,
+        static_cast<int>(dims.size()), dims.data(), data.data(),
+        static_cast<long long>(data.size()), args.root_rank, args.prescale,
+        args.postscale, static_cast<int>(args.splits.size()),
+        args.splits.empty() ? nullptr : args.splits.data(), args.group_id,
+        args.group_size, static_cast<int>(args.members.size()),
+        args.members.empty() ? nullptr : args.members.data());
+    if (handle < 0) {
+      ctx->CtxFailure(errors::Internal("hvt_submit failed for ", args.name));
+      done();
+      return;
+    }
+    Waiter::Get().Enqueue([ctx, done, handle, fill, name = args.name] {
+      int rc = hvt_wait(handle);
+      if (rc != 0) {
+        ctx->CtxFailure(errors::Internal(
+            "hvt collective '", name, "' failed: ", LastError()));
+      } else {
+        Status s = fill(handle);
+        if (!s.ok()) ctx->CtxFailure(s);
+      }
+      hvt_release(handle);
+      done();
+    });
+  }
+
+  std::string tensor_name_;
+  std::vector<long long> members_;
+};
+
+class HvtAllreduceOp : public HvtAsyncOpBase {
+ public:
+  explicit HvtAllreduceOp(OpKernelConstruction* ctx) : HvtAsyncOpBase(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("reduce_op", &reduce_op_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("prescale_factor", &prescale_));
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("postscale_factor", &postscale_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    SubmitArgs a;
+    a.name = Key(ctx);
+    a.op = OP_ALLREDUCE;
+    a.reduce = reduce_op_;
+    a.prescale = prescale_;
+    a.postscale = postscale_;
+    a.members = members_;
+    TensorShape shape = input.shape();
+    SubmitAndDefer(ctx, done, input, a, [ctx, shape](int handle) -> Status {
+      Tensor* out = nullptr;
+      TF_RETURN_IF_ERROR(ctx->allocate_output(0, shape, &out));
+      auto dst = out->tensor_data();
+      hvt_result_read(handle, const_cast<char*>(dst.data()),
+                      static_cast<long long>(dst.size()));
+      return Status();
+    });
+  }
+
+ private:
+  int reduce_op_ = 0;
+  float prescale_ = 1.0f, postscale_ = 1.0f;
+};
+
+class HvtAllgatherOp : public HvtAsyncOpBase {
+ public:
+  explicit HvtAllgatherOp(OpKernelConstruction* ctx) : HvtAsyncOpBase(ctx) {}
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    OP_REQUIRES_ASYNC(ctx, input.dims() >= 1,
+                      errors::InvalidArgument("allgather needs rank>=1"),
+                      done);
+    SubmitArgs a;
+    a.name = Key(ctx);
+    a.op = OP_ALLGATHER;
+    a.members = members_;
+    TensorShape shape = input.shape();
+    DataType dt = input.dtype();
+    SubmitAndDefer(ctx, done, input, a,
+                   [ctx, shape, dt](int handle) -> Status {
+      long long nbytes = hvt_result_bytes(handle);
+      TensorShape out_shape = shape;
+      int64_t row_elems = 1;
+      for (int i = 1; i < shape.dims(); ++i) row_elems *= shape.dim_size(i);
+      int64_t row_bytes = row_elems * DataTypeSize(dt);
+      out_shape.set_dim(0, row_bytes > 0 ? nbytes / row_bytes : 0);
+      Tensor* out = nullptr;
+      TF_RETURN_IF_ERROR(ctx->allocate_output(0, out_shape, &out));
+      auto dst = out->tensor_data();
+      hvt_result_read(handle, const_cast<char*>(dst.data()),
+                      static_cast<long long>(dst.size()));
+      return Status();
+    });
+  }
+};
+
+class HvtBroadcastOp : public HvtAsyncOpBase {
+ public:
+  explicit HvtBroadcastOp(OpKernelConstruction* ctx) : HvtAsyncOpBase(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("root_rank", &root_rank_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    SubmitArgs a;
+    a.name = Key(ctx);
+    a.op = OP_BROADCAST;
+    a.root_rank = root_rank_;
+    a.members = members_;
+    TensorShape shape = input.shape();
+    SubmitAndDefer(ctx, done, input, a, [ctx, shape](int handle) -> Status {
+      Tensor* out = nullptr;
+      TF_RETURN_IF_ERROR(ctx->allocate_output(0, shape, &out));
+      auto dst = out->tensor_data();
+      hvt_result_read(handle, const_cast<char*>(dst.data()),
+                      static_cast<long long>(dst.size()));
+      return Status();
+    });
+  }
+
+ private:
+  int root_rank_ = 0;
+};
+
+class HvtAlltoallOp : public HvtAsyncOpBase {
+ public:
+  explicit HvtAlltoallOp(OpKernelConstruction* ctx) : HvtAsyncOpBase(ctx) {}
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    const Tensor& splits = ctx->input(1);
+    OP_REQUIRES_ASYNC(ctx, input.dims() >= 1,
+                      errors::InvalidArgument("alltoall needs rank>=1"),
+                      done);
+    SubmitArgs a;
+    a.name = Key(ctx);
+    a.op = OP_ALLTOALL;
+    a.members = members_;
+    auto flat = splits.flat<int32>();
+    for (int i = 0; i < flat.size(); ++i) a.splits.push_back(flat(i));
+    TensorShape shape = input.shape();
+    DataType dt = input.dtype();
+    SubmitAndDefer(ctx, done, input, a,
+                   [ctx, shape, dt](int handle) -> Status {
+      long long nbytes = hvt_result_bytes(handle);
+      // sized by world size: the engine returns one split per member
+      std::vector<long long> rsp(hvt_size() > 0 ? hvt_size() : 1);
+      int n = hvt_result_recv_splits(handle, rsp.data(),
+                                     static_cast<int>(rsp.size()));
+      n = n < static_cast<int>(rsp.size()) ? n
+                                           : static_cast<int>(rsp.size());
+      TensorShape out_shape = shape;
+      int64_t row_elems = 1;
+      for (int i = 1; i < shape.dims(); ++i) row_elems *= shape.dim_size(i);
+      int64_t row_bytes = row_elems * DataTypeSize(dt);
+      out_shape.set_dim(0, row_bytes > 0 ? nbytes / row_bytes : 0);
+      Tensor* out = nullptr;
+      TF_RETURN_IF_ERROR(ctx->allocate_output(0, out_shape, &out));
+      auto dst = out->tensor_data();
+      hvt_result_read(handle, const_cast<char*>(dst.data()),
+                      static_cast<long long>(dst.size()));
+      Tensor* rs = nullptr;
+      TF_RETURN_IF_ERROR(
+          ctx->allocate_output(1, TensorShape({n}), &rs));
+      auto rflat = rs->flat<int32>();
+      for (int i = 0; i < n; ++i) rflat(i) = static_cast<int32>(rsp[i]);
+      return Status();
+    });
+  }
+};
+
+// Scalar topology ops — graph-time *dynamic* values so elastic jobs pick
+// up rescaled worlds without retracing (reference mpi_ops.cc:758-856).
+// Stateful so constant folding cannot freeze them into the graph.
+template <int (*Fn)()>
+class HvtScalarOp : public OpKernel {
+ public:
+  explicit HvtScalarOp(OpKernelConstruction* ctx) : OpKernel(ctx) {}
+  void Compute(OpKernelContext* ctx) override {
+    Tensor* out = nullptr;
+    OP_REQUIRES_OK(ctx, ctx->allocate_output(0, TensorShape({}), &out));
+    out->scalar<int32>()() = Fn();
+  }
+};
+
+static int SizeOrOne() { return hvt_initialized() ? hvt_size() : 1; }
+static int RankOrZero() { return hvt_initialized() ? hvt_rank() : 0; }
+
+#define HVT_DTYPES \
+  "{uint8, int8, int32, int64, half, bfloat16, float, double, bool}"
+
+REGISTER_OP("HvtAllreduce")
+    .Attr("T: " HVT_DTYPES)
+    .Attr("tensor_name: string = ''")
+    .Attr("reduce_op: int = 1")  // wire ReduceKind; 1 = AVERAGE
+    .Attr("prescale_factor: float = 1.0")
+    .Attr("postscale_factor: float = 1.0")
+    .Attr("process_set_ranks: list(int) = []")
+    .Input("tensor: T")
+    .Output("sum: T")
+    .SetShapeFn([](shape_inference::InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return Status();
+    });
+
+REGISTER_OP("HvtAllgather")
+    .Attr("T: " HVT_DTYPES)
+    .Attr("tensor_name: string = ''")
+    .Attr("process_set_ranks: list(int) = []")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](shape_inference::InferenceContext* c) {
+      shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(c->input(0), 0, c->UnknownDim(),
+                                       &out));
+      c->set_output(0, out);
+      return Status();
+    });
+
+REGISTER_OP("HvtBroadcast")
+    .Attr("T: " HVT_DTYPES)
+    .Attr("tensor_name: string = ''")
+    .Attr("root_rank: int = 0")
+    .Attr("process_set_ranks: list(int) = []")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](shape_inference::InferenceContext* c) {
+      c->set_output(0, c->input(0));
+      return Status();
+    });
+
+REGISTER_OP("HvtAlltoall")
+    .Attr("T: " HVT_DTYPES)
+    .Attr("tensor_name: string = ''")
+    .Attr("process_set_ranks: list(int) = []")
+    .Input("tensor: T")
+    .Input("splits: int32")
+    .Output("output: T")
+    .Output("received_splits: int32")
+    .SetShapeFn([](shape_inference::InferenceContext* c) {
+      shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(c->input(0), 0, c->UnknownDim(),
+                                       &out));
+      c->set_output(0, out);
+      c->set_output(1, c->Vector(c->UnknownDim()));
+      return Status();
+    });
+
+REGISTER_OP("HvtSize").Output("size: int32").SetIsStateful().SetShapeFn(
+    shape_inference::ScalarShape);
+REGISTER_OP("HvtRank").Output("rank: int32").SetIsStateful().SetShapeFn(
+    shape_inference::ScalarShape);
+
+REGISTER_KERNEL_BUILDER(Name("HvtAllreduce").Device(DEVICE_CPU),
+                        HvtAllreduceOp);
+REGISTER_KERNEL_BUILDER(Name("HvtAllgather").Device(DEVICE_CPU),
+                        HvtAllgatherOp);
+REGISTER_KERNEL_BUILDER(Name("HvtBroadcast").Device(DEVICE_CPU),
+                        HvtBroadcastOp);
+REGISTER_KERNEL_BUILDER(
+    Name("HvtAlltoall").Device(DEVICE_CPU).HostMemory("splits"),
+    HvtAlltoallOp);
+REGISTER_KERNEL_BUILDER(Name("HvtSize").Device(DEVICE_CPU),
+                        HvtScalarOp<SizeOrOne>);
+REGISTER_KERNEL_BUILDER(Name("HvtRank").Device(DEVICE_CPU),
+                        HvtScalarOp<RankOrZero>);
+
+}  // namespace hvt_tf

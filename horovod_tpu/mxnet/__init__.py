@@ -1,21 +1,176 @@
-"""MXNet compatibility stub.
+"""MXNet binding (reference ``horovod/mxnet/__init__.py``:
+DistributedOptimizer:40, Gluon DistributedTrainer:102,
+broadcast_parameters:191, plus the ``mpi_ops`` collective surface).
 
-The reference binds MXNet (``horovod/mxnet``: DistributedOptimizer,
-Gluon DistributedTrainer, broadcast_parameters). MXNet is end-of-life
-(retired from Apache incubation) and is not part of the TPU-native
-target; training paths are ``horovod_tpu.jax`` (compiled) and
-``horovod_tpu.torch`` (eager/hooks). This module exists so
-``import horovod_tpu.mxnet`` fails with guidance rather than
-AttributeError deep in user code."""
+MXNet is end-of-life (retired from Apache incubation) and not installed
+in TPU images, so this binding is **gated** the same way as the Ray/Spark
+integrations: the collective plumbing, optimizer wrapper, and parameter
+broadcast are framework-agnostic (duck-typed NDArrays — anything with
+``.asnumpy()``; plain numpy passes through) and fully tested with fakes,
+while the Gluon ``DistributedTrainer`` subclass materializes only when
+``import mxnet`` succeeds. First-class TPU training lives in
+``horovod_tpu.jax``; ``horovod_tpu.torch`` is the eager analog.
+"""
 
 from __future__ import annotations
 
-_MSG = ("horovod_tpu does not bind MXNet; use horovod_tpu.jax "
-        "(TPU-compiled) or horovod_tpu.torch (eager). The reference's "
-        "MXNet API maps 1:1: DistributedOptimizer → "
-        "hvt.jax.DistributedOptimizer / hvt.torch.DistributedOptimizer, "
-        "broadcast_parameters → hvt.torch.broadcast_parameters.")
+from horovod_tpu.common.basics import (cross_rank, cross_size,  # noqa: F401
+                                       init, is_initialized, local_rank,
+                                       local_size, rank, shutdown, size)
+from horovod_tpu.mxnet.mpi_ops import (_MX_AVAILABLE, allgather,  # noqa: F401
+                                       allreduce, allreduce_, alltoall,
+                                       broadcast, broadcast_,
+                                       grouped_allreduce,
+                                       grouped_allreduce_)
 
 
-def __getattr__(name):
-    raise NotImplementedError(_MSG)
+from horovod_tpu.common.util import split_list as _split_list
+
+
+class DistributedOptimizer:
+    """Wrap an MXNet-style optimizer: every ``update`` first sums the
+    gradient across workers in place (reference ``mxnet/__init__.py:40``).
+
+    Averaging is folded into the optimizer's ``rescale_grad`` (scaled by
+    ``gradient_predivide_factor / size()``) instead of an explicit
+    postscale — the reference does the same for performance. ``num_groups``
+    > 0 batches gradients into grouped (engine-fused) allreduces.
+
+    Duck-typed: the inner optimizer needs ``rescale_grad`` and
+    ``update(index, weight, grad, state)`` (+ optional
+    ``update_multi_precision``); gradients need ``.asnumpy()`` or to be
+    numpy arrays.
+    """
+
+    def __init__(self, optimizer, gradient_predivide_factor=1.0,
+                 num_groups=0):
+        self._optimizer = optimizer
+        self._optimizer.rescale_grad *= gradient_predivide_factor / size()
+        self._gradient_predivide_factor = gradient_predivide_factor
+        self._num_groups = num_groups
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _do_allreduce(self, index, grad):
+        # no size()==1 shortcut: the 1/predivide prescale must still apply
+        # to compensate the predivide folded into rescale_grad (the
+        # single-process eager path applies prescale locally)
+        pre = 1.0 / self._gradient_predivide_factor
+        if isinstance(index, (tuple, list)):
+            if self._num_groups > 0:
+                for i, (grads, indices) in enumerate(zip(
+                        _split_list(grad, self._num_groups),
+                        _split_list(index, self._num_groups))):
+                    grouped_allreduce_(
+                        tensors=grads, average=False,
+                        name=f"mx.{indices[0]}:{indices[-1]}", priority=-i,
+                        prescale_factor=pre)
+            else:
+                for i in range(len(index)):
+                    allreduce_(grad[i], average=False,
+                               name=f"mx.{index[i]}", priority=-i,
+                               prescale_factor=pre)
+        else:
+            allreduce_(grad, average=False, name=f"mx.{index}",
+                       prescale_factor=pre)
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+
+def _allreduce_trainer_grads(params, gradient_predivide_factor=1.0,
+                             num_groups=0, prefix=""):
+    """Core of ``DistributedTrainer._allreduce_grads`` (reference
+    ``mxnet/__init__.py:147``): in-place SUM over every trainable
+    parameter's gradient, named by position (MXNet 2.0 parameter names
+    are not unique), grouped when ``num_groups`` > 0.
+
+    ``params``: iterable of objects with ``grad_req`` and ``list_grad()``
+    (Gluon Parameters or the fakes in the gated tests). Runs even at
+    size()==1 so the 1/predivide prescale always compensates the
+    predivide folded into the trainer's ``_scale``."""
+    pre = 1.0 / gradient_predivide_factor
+    entries = [(i, p.list_grad()[0]) for i, p in enumerate(params)
+               if p.grad_req != "null"]
+    if num_groups > 0:
+        for gi, group in enumerate(_split_list(entries, num_groups)):
+            idxs = [i for i, _ in group]
+            grouped_allreduce_(
+                tensors=[g for _, g in group], average=False,
+                name=f"{prefix}{idxs[0]}:{idxs[-1]}", priority=-gi,
+                prescale_factor=pre)
+    else:
+        for i, g in entries:
+            allreduce_(g, average=False, name=f"{prefix}{i}", priority=-i,
+                       prescale_factor=pre)
+
+
+if _MX_AVAILABLE:
+    import mxnet as _mx
+
+    class DistributedTrainer(_mx.gluon.Trainer):
+        """Gluon trainer whose gradient exchange is the engine allreduce
+        instead of kvstore push/pull (reference ``mxnet/__init__.py:102``;
+        summation here, averaging folded into ``_scale``)."""
+
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     gradient_predivide_factor=1.0, prefix=None,
+                     num_groups=0):
+            if isinstance(optimizer, DistributedOptimizer):
+                optimizer = optimizer._optimizer
+            super().__init__(params, optimizer, optimizer_params,
+                             kvstore=None)
+            self._scale *= gradient_predivide_factor / size()
+            self._gradient_predivide_factor = gradient_predivide_factor
+            self._hvt_prefix = prefix or ""
+            self._num_groups = num_groups
+
+        def _allreduce_grads(self):
+            _allreduce_trainer_grads(
+                self._params,
+                gradient_predivide_factor=self._gradient_predivide_factor,
+                num_groups=self._num_groups, prefix=self._hvt_prefix)
+else:
+    class DistributedTrainer:  # pragma: no cover - gated surface
+        """Unavailable without MXNet; raises with migration guidance."""
+
+        def __init__(self, *a, **kw):
+            raise ImportError(
+                "mxnet is not installed; DistributedTrainer requires "
+                "Gluon. Use horovod_tpu.jax.DistributedOptimizer "
+                "(TPU-compiled) or horovod_tpu.torch.DistributedOptimizer "
+                "(eager). The gradient-exchange core is available as "
+                "horovod_tpu.mxnet._allreduce_trainer_grads.")
+
+
+def broadcast_parameters(params, root_rank=0, prefix=None):
+    """Broadcast a dict of parameters from ``root_rank`` (reference
+    ``mxnet/__init__.py:191`` — typical input is
+    ``Block.collect_params()``). Entries may be Gluon Parameters
+    (``.data()`` / ``.set_data``), NDArray-likes, or numpy arrays;
+    results are written back in place. ``prefix`` namespaces tensor
+    names when called more than once."""
+    if size() == 1:
+        return
+    prefix = prefix or ""
+    for name in sorted(params):
+        p = params[name]
+        if hasattr(p, "data") and callable(p.data):
+            tensor = p.data()
+            out = broadcast(tensor, root_rank=root_rank,
+                            name=f"{prefix}{name}")
+            if hasattr(p, "set_data"):
+                p.set_data(out)
+            else:  # NDArray-style in-place
+                tensor[:] = out
+        else:
+            broadcast_(p, root_rank=root_rank, name=f"{prefix}{name}")

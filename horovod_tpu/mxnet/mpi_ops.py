@@ -1,0 +1,141 @@
+"""MXNet collective surface (reference ``horovod/mxnet/mpi_ops.py``:
+allreduce:56, allreduce_:101, grouped_allreduce:140, allgather:232,
+broadcast:272, broadcast_:315, alltoall:348 — each takes a ``priority``
+hint for MXNet's async engine).
+
+Transport: the engine data plane through the framework-neutral numpy
+bridge (``ops.collective_ops``), the same layering as the TF binding's
+fallback path. MXNet NDArrays are duck-typed — anything exposing
+``.asnumpy()`` (real ``mx.nd.NDArray`` or the fakes in the gated tests)
+round-trips; plain numpy arrays pass straight through. ``priority`` is
+accepted for API compatibility; the engine's cycle negotiation replaces
+MXNet's priority-queued async engine, so it is advisory only.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import mxnet as _mx
+    _MX_AVAILABLE = True
+except ImportError:
+    _mx = None
+    _MX_AVAILABLE = False
+
+
+def _to_numpy(tensor):
+    if hasattr(tensor, "asnumpy"):
+        return tensor.asnumpy()
+    return np.asarray(tensor)
+
+
+def _like(arr, like):
+    """Rebuild the caller's tensor type around a numpy result."""
+    if _MX_AVAILABLE and isinstance(like, _mx.nd.NDArray):
+        return _mx.nd.array(arr, ctx=like.context, dtype=arr.dtype)
+    if hasattr(like, "asnumpy") and hasattr(type(like), "from_numpy"):
+        return type(like).from_numpy(arr)  # duck-typed fakes
+    return arr
+
+
+def _assign(dst, arr):
+    """In-place variants: write the result back into the caller's tensor."""
+    if hasattr(dst, "asnumpy") and hasattr(dst, "__setitem__"):
+        dst[:] = _like(arr, dst) if _MX_AVAILABLE and isinstance(
+            dst, _mx.nd.NDArray) else arr
+        return dst
+    np.copyto(dst, arr)
+    return dst
+
+
+def allreduce(tensor, average=True, name=None, priority=0,
+              prescale_factor=1.0, postscale_factor=1.0, process_set=None):
+    del priority
+    from horovod_tpu.ops import collective_ops as C
+
+    out = C.allreduce(_to_numpy(tensor),
+                      op=C.Average if average else C.Sum,
+                      name=name or "mx.allreduce",
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set or C.global_process_set)
+    return _like(np.asarray(out), tensor)
+
+
+def allreduce_(tensor, average=True, name=None, priority=0,
+               prescale_factor=1.0, postscale_factor=1.0,
+               process_set=None):
+    """In-place allreduce (reference ``mpi_ops.py:101``)."""
+    out = allreduce(tensor, average=average, name=name, priority=priority,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set)
+    return _assign(tensor, _to_numpy(out))
+
+
+def grouped_allreduce(tensors, average=True, name=None, priority=0,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None):
+    del priority
+    from horovod_tpu.ops import collective_ops as C
+
+    outs = C.grouped_allreduce(
+        [_to_numpy(t) for t in tensors],
+        op=C.Average if average else C.Sum,
+        name=name or "mx.grouped_allreduce",
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        process_set=process_set or C.global_process_set)
+    return [_like(np.asarray(o), t) for o, t in zip(outs, tensors)]
+
+
+def grouped_allreduce_(tensors, average=True, name=None, priority=0,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=None):
+    outs = grouped_allreduce(tensors, average=average, name=name,
+                             priority=priority,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             process_set=process_set)
+    for t, o in zip(tensors, outs):
+        _assign(t, _to_numpy(o))
+    return tensors
+
+
+def allgather(tensor, name=None, priority=0, process_set=None):
+    del priority
+    from horovod_tpu.ops import collective_ops as C
+
+    out = C.allgather(_to_numpy(tensor), name=name or "mx.allgather",
+                      process_set=process_set or C.global_process_set)
+    return _like(np.asarray(out), tensor)
+
+
+def broadcast(tensor, root_rank, name=None, priority=0, process_set=None):
+    del priority
+    from horovod_tpu.ops import collective_ops as C
+
+    out = C.broadcast(_to_numpy(tensor), root_rank=root_rank,
+                      name=name or "mx.broadcast",
+                      process_set=process_set or C.global_process_set)
+    return _like(np.asarray(out), tensor)
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0, process_set=None):
+    out = broadcast(tensor, root_rank, name=name, priority=priority,
+                    process_set=process_set)
+    return _assign(tensor, _to_numpy(out))
+
+
+def alltoall(tensor, splits=None, name=None, priority=0, process_set=None):
+    """Returns (output, received_splits)."""
+    del priority
+    from horovod_tpu.ops import collective_ops as C
+
+    out, recv = C.alltoall(
+        _to_numpy(tensor),
+        splits=None if splits is None else np.asarray(_to_numpy(splits)),
+        name=name or "mx.alltoall",
+        process_set=process_set or C.global_process_set)
+    return _like(np.asarray(out), tensor), np.asarray(recv)

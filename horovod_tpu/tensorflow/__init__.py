@@ -7,13 +7,22 @@ framework is TPU-native: the first-class training path is JAX
 strictly more capable than the out-of-graph TF custom-op design. A torch
 binding (``horovod_tpu.torch``) covers eager-style training.
 
-When TensorFlow is importable, this module exposes the eager-mode subset
-of the reference API: rank/size topology, allreduce/allgather/broadcast
-on ``tf.Tensor`` via numpy bridging, ``broadcast_variables``,
-``DistributedGradientTape`` (reference ``tensorflow/__init__.py:673``)
-and an eager ``DistributedOptimizer`` wrapping ``apply_gradients``
-(reference ``:396-568``). Graph-mode custom ops are not provided — use
-the JAX binding for compiled training on TPU.
+When TensorFlow is importable, this module exposes the reference API:
+rank/size topology, allreduce/allgather/broadcast/alltoall on
+``tf.Tensor``, ``broadcast_variables``, ``DistributedGradientTape``
+(reference ``tensorflow/__init__.py:673``) and a ``DistributedOptimizer``
+wrapping ``apply_gradients`` (reference ``:396-568``).
+
+Two transports, picked automatically per call:
+
+- **Native custom ops** (``csrc/tf_ops.cc`` → ``libhvt_tf_ops.so``, the
+  analog of reference ``tensorflow/mpi_ops.cc:374`` AsyncOpKernels): used
+  whenever the library is built and the multi-process engine is running.
+  The collectives are real TF graph ops — eager, ``tf.function`` graph
+  mode, and tape gradients all stay inside TF, with registered gradient
+  functions (reference ``tensorflow/mpi_ops.py:116``).
+- **Numpy bridge** fallback when the op library isn't built or the job is
+  single-process: correct but leaves the graph (no ``tf.function``).
 
 The gradient plumbing (reduce list-of-grads with compression, sparse
 allgather path, local aggregation) is numpy-level and framework-agnostic,
@@ -53,10 +62,33 @@ def _require_tf():
             "collectives); horovod_tpu.torch provides the eager path.")
 
 
+def _native():
+    """The native custom-op module when usable (library built AND the
+    multi-process engine is up), else None → numpy-bridge fallback."""
+    if not _TF_AVAILABLE:
+        return None
+    try:
+        from horovod_tpu.engine import native as _engine
+        from horovod_tpu.tensorflow import native_ops
+    except ImportError:  # pragma: no cover
+        return None
+    if native_ops.available() and _engine.engine_running():
+        return native_ops
+    return None
+
+
 def allreduce(tensor, name=None, average=True, prescale_factor=1.0,
               postscale_factor=1.0, process_set=None):
-    """Eager allreduce on a tf.Tensor through the engine data plane."""
+    """Allreduce on a tf.Tensor — native in-graph op when the engine is
+    running, numpy bridge otherwise."""
     _require_tf()
+    nat = _native()
+    if nat is not None:
+        return nat.allreduce(
+            _tf.convert_to_tensor(tensor), name=name,
+            op=nat.AVERAGE if average else nat.SUM,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)
     import numpy as np
 
     from horovod_tpu.ops import collective_ops as C
@@ -73,6 +105,10 @@ def allreduce(tensor, name=None, average=True, prescale_factor=1.0,
 
 def allgather(tensor, name=None, process_set=None):
     _require_tf()
+    nat = _native()
+    if nat is not None:
+        return nat.allgather(_tf.convert_to_tensor(tensor), name=name,
+                             process_set=process_set)
     import numpy as np
 
     from horovod_tpu.ops import collective_ops as C
@@ -84,6 +120,11 @@ def allgather(tensor, name=None, process_set=None):
 
 def broadcast(tensor, root_rank=0, name=None, process_set=None):
     _require_tf()
+    nat = _native()
+    if nat is not None:
+        return nat.broadcast(_tf.convert_to_tensor(tensor),
+                             root_rank=root_rank, name=name,
+                             process_set=process_set)
     import numpy as np
 
     from horovod_tpu.ops import collective_ops as C
@@ -92,6 +133,45 @@ def broadcast(tensor, root_rank=0, name=None, process_set=None):
                       name=name or "tf.broadcast",
                       process_set=process_set or C.global_process_set)
     return _tf.convert_to_tensor(np.asarray(out))
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """Alltoall on a tf.Tensor; returns (output, received_splits)
+    (reference ``tensorflow/mpi_ops.cc:873`` HorovodAlltoallOp)."""
+    _require_tf()
+    nat = _native()
+    if nat is not None:
+        return nat.alltoall(_tf.convert_to_tensor(tensor), splits=splits,
+                            name=name, process_set=process_set)
+    import numpy as np
+
+    from horovod_tpu.ops import collective_ops as C
+
+    out, recv = C.alltoall(
+        np.asarray(tensor),
+        splits=None if splits is None else np.asarray(splits),
+        name=name or "tf.alltoall",
+        process_set=process_set or C.global_process_set)
+    return (_tf.convert_to_tensor(np.asarray(out)),
+            _tf.convert_to_tensor(np.asarray(recv, np.int32)))
+
+
+def size_op():
+    """Graph-time dynamic world size (reference ``mpi_ops.cc:758`` — the
+    elastic-aware alternative to baking ``size()`` into the graph)."""
+    _require_tf()
+    from horovod_tpu.tensorflow import native_ops
+    if native_ops.available():
+        return native_ops.size_op()
+    return _tf.constant(size(), dtype=_tf.int32)
+
+
+def rank_op():
+    _require_tf()
+    from horovod_tpu.tensorflow import native_ops
+    if native_ops.available():
+        return native_ops.rank_op()
+    return _tf.constant(rank(), dtype=_tf.int32)
 
 
 def broadcast_variables(variables, root_rank=0):
@@ -125,24 +205,52 @@ def _to_framework(arr, like):
 
 def _allreduce_grads(grads, op=None, compression=Compression.none,
                      prescale_factor=1.0, postscale_factor=1.0,
-                     process_set=None, name_prefix="grad"):
+                     process_set=None, name_prefix="grad", names=None):
     """Reduce a list of gradients (None entries pass through; IndexedSlices
     take the sparse allgather path — reference
-    ``tensorflow/__init__.py:92-108``)."""
+    ``tensorflow/__init__.py:92-108``).
+
+    ``names`` (optional, parallel to ``grads``): stable per-gradient
+    collective names. Callers that may run under ``tf.function`` MUST pass
+    names derived from the source variables — a trace-time sequence counter
+    would bake diverging names when ranks retrace unequally (e.g. an uneven
+    final batch), deadlocking the engine's name-keyed negotiation."""
     from horovod_tpu.ops import collective_ops as C
     from horovod_tpu.ops.sparse import sparse_allreduce
 
     op = op or C.Average
     ps = process_set or C.global_process_set
+    nat = _native()
     outs = []
     for i, g in enumerate(grads):
         if g is None:
             outs.append(None)
             continue
+        if nat is not None and not _is_indexed_slices(g):
+            # native in-graph path: compression = dtype cast inside TF so
+            # tf.function tracing works (reference FP16Compressor is a
+            # cast too, tensorflow/compression.py:46)
+            gt = _tf.convert_to_tensor(g)
+            fp16 = compression is Compression.fp16 and \
+                gt.dtype in (_tf.float32, _tf.float64)
+            wire = _tf.cast(gt, _tf.float16) if fp16 else gt
+            wire_op = {C.Sum: nat.SUM, C.Average: nat.AVERAGE,
+                       C.Min: nat.MIN, C.Max: nat.MAX,
+                       C.Product: nat.PRODUCT,
+                       C.Adasum: nat.ADASUM}[op]
+            red = nat.allreduce(
+                wire, name=names[i] if names else f"{name_prefix}.{i}",
+                op=wire_op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, process_set=ps
+                if ps is not C.global_process_set else None)
+            outs.append(_tf.cast(red, gt.dtype) if fp16 else red)
+            continue
         if _is_indexed_slices(g):
             gi, gv = sparse_allreduce(
                 np.asarray(g.indices), np.asarray(g.values),
-                average=op is C.Average, name=f"{name_prefix}.{i}",
+                average=op is C.Average,
+                name=names[i] if names else f"{name_prefix}.{i}",
                 process_set=ps)
             gi, gv = np.asarray(gi), np.asarray(gv)
             if _TF_AVAILABLE and not isinstance(g.values, np.ndarray):
@@ -154,7 +262,8 @@ def _allreduce_grads(grads, op=None, compression=Compression.none,
                 outs.append(type(g)(gv, gi))
             continue
         arr, ctx = compression.compress(np.asarray(g))
-        red = C.allreduce(arr, op=op, name=f"{name_prefix}.{i}",
+        red = C.allreduce(arr, op=op,
+                          name=names[i] if names else f"{name_prefix}.{i}",
                           prescale_factor=prescale_factor,
                           postscale_factor=postscale_factor,
                           process_set=ps)
@@ -183,7 +292,6 @@ class DistributedGradientTape:
         self._prescale = prescale_factor
         self._postscale = postscale_factor
         self._process_set = process_set
-        self._name_seq = 0
 
     # context-manager + attribute passthrough (watch, stop_recording, ...)
     def __enter__(self):
@@ -200,13 +308,22 @@ class DistributedGradientTape:
         grads = self._tape.gradient(target, sources, output_gradients)
         single = not isinstance(grads, (list, tuple))
         glist = [grads] if single else list(grads)
-        self._name_seq += 1
+        slist = [sources] if single else list(sources)
+        # names keyed by source-variable identity, NOT a trace-time
+        # counter: ranks that retrace unequally (uneven final batch) must
+        # still bake identical collective names into their graphs
+        # index kept alongside the variable name: eager tf.Variables can
+        # share a default name ("Variable:0"), and in-flight engine names
+        # must be unique within one step
+        names = [f"DistributedGradientTape.{i}."
+                 f"{getattr(s, 'name', None) or 'grad'}"
+                 for i, s in enumerate(slist)]
         outs = _allreduce_grads(
             glist, op=self._op, compression=self._compression,
             prescale_factor=self._prescale,
             postscale_factor=self._postscale,
             process_set=self._process_set,
-            name_prefix=f"DistributedGradientTape.{self._name_seq}")
+            name_prefix="DistributedGradientTape", names=names)
         return outs[0] if single else outs
 
 
@@ -235,7 +352,6 @@ class _DistributedOptimizer:
         self._process_set = process_set
         self._agg = None       # list of numpy accumulators (None for None)
         self._agg_count = 0
-        self._apply_seq = 0
 
     def __getattr__(self, name):
         return getattr(self._opt, name)
@@ -266,7 +382,17 @@ class _DistributedOptimizer:
             raise ValueError(
                 "backward_passes_per_step > 1 does not support sparse "
                 "(IndexedSlices) gradients")
-        self._apply_seq += 1
+        if self.backward_passes_per_step > 1 and _TF_AVAILABLE and \
+                not _tf.executing_eagerly():
+            # the aggregation counter is Python state (numpy accumulators
+            # + data-dependent early return) — it cannot be traced into a
+            # graph. Fail at trace time with guidance instead of a cryptic
+            # np.asarray(symbolic) error mid-trace.
+            raise RuntimeError(
+                "backward_passes_per_step > 1 requires eager execution "
+                "(the local-aggregation counter is host-side state); call "
+                "apply_gradients outside @tf.function, or aggregate "
+                "in-graph and apply every step")
         if self.backward_passes_per_step > 1:
             self._aggregate(grads)
             if self._agg_count < self.backward_passes_per_step:
@@ -278,12 +404,17 @@ class _DistributedOptimizer:
                          for g in grads]
             self._agg = None
             self._agg_count = 0
+        # stable per-variable names (not the apply counter): identical
+        # across ranks even under unequal tf.function retracing
+        names = [f"DistributedOptimizer.{i}."
+                 f"{getattr(v, 'name', None) or 'grad'}"
+                 for i, v in enumerate(variables)]
         reduced = _allreduce_grads(
             grads, op=self._op, compression=self._compression,
             prescale_factor=self._prescale,
             postscale_factor=self._postscale,
             process_set=self._process_set,
-            name_prefix=f"DistributedOptimizer.{self._apply_seq}")
+            name_prefix="DistributedOptimizer", names=names)
         return self._opt.apply_gradients(zip(reduced, variables), **kwargs)
 
 

@@ -1,0 +1,223 @@
+"""Native TF custom-op path — loads ``libhvt_tf_ops.so`` (built by
+``make -C horovod_tpu/csrc tf_ops``) and exposes collective wrappers that
+run **inside** TF graphs: eager, ``tf.function`` graph mode, and
+``tf.GradientTape`` all work without leaving TF, matching the reference's
+custom-op design (``tensorflow/mpi_ops.cc:374`` AsyncOpKernel enqueue +
+deferred done; Python wrappers + gradient registrations
+``tensorflow/mpi_ops.py:95-160``).
+
+The ops submit into the same C++ engine singleton as the ctypes bridge
+(the .so links ``libhvt_core.so`` by path), so a process initialized via
+``horovod_tpu.init()`` under ``hvtrun`` serves both paths with one
+coordinator/data-plane.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_mod = None
+_load_attempted = False
+
+# wire ReduceKind ids (csrc/common.h)
+SUM, AVERAGE, MIN, MAX, PRODUCT, ADASUM = 0, 1, 2, 3, 4, 5
+
+
+def _lib_path():
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(os.path.dirname(here), "csrc", "build",
+                        "libhvt_tf_ops.so")
+
+
+def _load():
+    global _mod, _load_attempted
+    with _lock:
+        if _load_attempted:
+            return _mod
+        _load_attempted = True
+        path = _lib_path()
+        if not os.path.exists(path):
+            return None
+        try:
+            import tensorflow as tf
+            _mod = tf.load_op_library(path)
+        except Exception:
+            _mod = None
+        return _mod
+
+
+def available() -> bool:
+    """True when the native op library is built and loadable."""
+    return _load() is not None
+
+
+_name_seq = [0]
+
+
+def _auto_name(op, name):
+    """Default collective name.
+
+    Eager: a runtime counter — SPMD replicas issue eager collectives in
+    program order, so the sequence lines up across ranks (same contract as
+    ``engine/api.py`` ``_auto_name``).
+
+    Inside a ``tf.function`` trace: return '' so the kernel falls back to
+    its TF *node name* (``tf_ops.cc`` ``Key()``). Node names depend only
+    on graph structure, so a rank that retraces (e.g. uneven final batch)
+    bakes the SAME names again — a process-global counter would bake
+    diverged names and deadlock the engine's name-keyed negotiation.
+    """
+    if name:
+        return name
+    import tensorflow as tf
+    if not tf.executing_eagerly():
+        return ""
+    _name_seq[0] += 1
+    return f"hvt.tf.{op}.{_name_seq[0]}"
+
+
+def _grad_name(op, kind):
+    """Stable name for a backward collective: derived from the forward
+    op's name (explicit ``tensor_name`` attr or its graph node name), so
+    backward names diverge only if forward names do."""
+    try:
+        base = op.get_attr("tensor_name")
+        base = base.decode() if isinstance(base, bytes) else base
+    except Exception:
+        base = ""
+    if base:
+        return f"{base}.{kind}"
+    try:
+        node = op.name
+    except Exception:
+        node = ""
+    if node:
+        return f"{node}.{kind}"
+    return _auto_name(kind, None)
+
+
+def _members(process_set):
+    if process_set is None:
+        return []
+    ranks = getattr(process_set, "ranks", None)
+    return list(ranks) if ranks else []
+
+
+def allreduce(tensor, name=None, op=AVERAGE, prescale_factor=1.0,
+              postscale_factor=1.0, process_set=None):
+    """In-graph allreduce through the engine (native custom op)."""
+    mod = _load()
+    return mod.hvt_allreduce(
+        tensor, tensor_name=_auto_name("allreduce", name), reduce_op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set_ranks=_members(process_set))
+
+
+def allgather(tensor, name=None, process_set=None):
+    mod = _load()
+    return mod.hvt_allgather(tensor,
+                             tensor_name=_auto_name("allgather", name),
+                             process_set_ranks=_members(process_set))
+
+
+def broadcast(tensor, root_rank=0, name=None, process_set=None):
+    mod = _load()
+    return mod.hvt_broadcast(tensor, root_rank=root_rank,
+                             tensor_name=_auto_name("broadcast", name),
+                             process_set_ranks=_members(process_set))
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """Returns (output, received_splits)."""
+    import tensorflow as tf
+    mod = _load()
+    if splits is None:
+        splits = tf.zeros([0], dtype=tf.int32)
+    return mod.hvt_alltoall(tensor, tf.cast(splits, tf.int32),
+                            tensor_name=_auto_name("alltoall", name),
+                            process_set_ranks=_members(process_set))
+
+
+def size_op():
+    """Graph-time dynamic world size (reference mpi_ops.cc:758 — lets
+    elastic jobs see rescaled worlds without retracing)."""
+    return _load().hvt_size()
+
+
+def rank_op():
+    return _load().hvt_rank()
+
+
+def _register_gradients():
+    """Gradient registrations, mirroring reference tensorflow/mpi_ops.py:
+    allreduce grad = allreduce of the gradient (:116), broadcast grad =
+    reduce-to-root, allgather grad = reducescatter expressed as
+    allreduce + slice (the engine data plane fuses either way)."""
+    try:
+        import tensorflow as tf
+        from tensorflow.python.framework import ops as tf_ops
+    except Exception:  # pragma: no cover
+        return
+
+    @tf_ops.RegisterGradient("HvtAllreduce")
+    def _allreduce_grad(op, grad):  # noqa: ANN001
+        reduce_op = op.get_attr("reduce_op")
+        pre = op.get_attr("prescale_factor")
+        post = op.get_attr("postscale_factor")
+        members = list(op.get_attr("process_set_ranks"))
+        mod = _load()
+        return mod.hvt_allreduce(
+            grad, tensor_name=_grad_name(op, "grad"),
+            reduce_op=reduce_op, prescale_factor=pre, postscale_factor=post,
+            process_set_ranks=members)
+
+    @tf_ops.RegisterGradient("HvtBroadcast")
+    def _broadcast_grad(op, grad):
+        root = op.get_attr("root_rank")
+        members = list(op.get_attr("process_set_ranks"))
+        mod = _load()
+        summed = mod.hvt_allreduce(
+            grad, tensor_name=_grad_name(op, "grad"),
+            reduce_op=SUM, process_set_ranks=members)
+        r = mod.hvt_rank()
+        return tf.where(tf.equal(r, root), summed, tf.zeros_like(summed))
+
+    @tf_ops.RegisterGradient("HvtAllgather")
+    def _allgather_grad(op, grad):
+        # Sum the gathered gradient across the participating set, then
+        # slice out this rank's rows (reference torch/mpi_ops.py allgather
+        # backward: ctx-saved dims + reduce-scatter by slice).
+        members = list(op.get_attr("process_set_ranks"))
+        mod = _load()
+        summed = mod.hvt_allreduce(
+            grad, tensor_name=_grad_name(op, "grad"),
+            reduce_op=SUM, process_set_ranks=members)
+        my_rows = tf.shape(op.inputs[0])[0]
+        # set size / my index WITHIN the set (process subsets: global rank
+        # is not the row-block index)
+        if members:
+            set_size = tf.constant(len(members), tf.int32)
+            my_idx = tf.argmax(tf.cast(
+                tf.equal(tf.constant(members, tf.int32),
+                         tf.cast(mod.hvt_rank(), tf.int32)), tf.int32),
+                output_type=tf.int32)
+        else:
+            set_size = mod.hvt_size()
+            my_idx = mod.hvt_rank()
+        # rows contributed by set members before this one = exchange of
+        # row counts, cumulative-summed below our index
+        counts, _ = mod.hvt_alltoall(
+            tf.repeat(my_rows[None], set_size),
+            tf.ones([set_size], tf.int32),
+            tensor_name=_grad_name(op, "grad.rows"),
+            process_set_ranks=members)
+        start = tf.reduce_sum(counts[:my_idx])
+        return tf.slice(summed, tf.concat(
+            [[start], tf.zeros([tf.rank(grad) - 1], tf.int32)], 0),
+            tf.shape(op.inputs[0]))
+
+
+if available():  # pragma: no branch
+    _register_gradients()

@@ -31,17 +31,7 @@ from horovod_tpu.torch.mpi_ops import (Adasum, Average, Sum, allreduce_async,
                                        grouped_allreduce_async, synchronize)
 
 
-def _split_list(xs, num_parts):
-    """Near-equal contiguous split (reference ``common/util.py`` split_list,
-    used for num_groups at ``torch/optimizer.py:63-70``)."""
-    num_parts = min(num_parts, len(xs))
-    base, extra = divmod(len(xs), num_parts)
-    out, i = [], 0
-    for p in range(num_parts):
-        n = base + (1 if p < extra else 0)
-        out.append(xs[i:i + n])
-        i += n
-    return out
+from horovod_tpu.common.util import split_list as _split_list
 
 
 class _DistributedOptimizer(torch.optim.Optimizer):

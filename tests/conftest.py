@@ -38,6 +38,15 @@ def _ensure_engine_built():
         if result.returncode != 0:
             raise RuntimeError(
                 f"C++ engine build failed:\n{result.stdout}\n{result.stderr}")
+    # TF custom-op library (optional; skipped inside make when TF absent).
+    # Worth the one-time compile: it unlocks the in-graph TF parallel suite.
+    tf_lib = os.path.join(_CSRC, "build", "libhvt_tf_ops.so")
+    tf_src = os.path.join(_CSRC, "tf_ops.cc")
+    if os.path.exists(tf_src) and (
+            not os.path.exists(tf_lib)
+            or os.path.getmtime(tf_lib) < os.path.getmtime(tf_src)):
+        subprocess.run(["make", "-C", _CSRC, "tf_ops"],
+                       capture_output=True, text=True)
 
 
 _ensure_engine_built()

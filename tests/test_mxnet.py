@@ -1,0 +1,170 @@
+"""MXNet binding tests — the gated analog of reference
+``test/parallel/test_mxnet.py``. MXNet itself is absent from the image,
+so the duck-typed core (numpy NDArray stand-ins) is exercised
+single-process and over real multi-process engines, the same pattern as
+the Ray/Spark/TF gated suites."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tests.test_engine_integration import LIB, run_workers
+
+
+def test_split_list_shapes():
+    from horovod_tpu.mxnet import _split_list
+
+    assert _split_list(list(range(7)), 3) == [[0, 1, 2], [3, 4], [5, 6]]
+    assert _split_list(list(range(4)), 2) == [[0, 1], [2, 3]]
+    assert _split_list([1], 3) == [[1]]
+
+
+def test_single_process_allreduce_identity():
+    import horovod_tpu.mxnet as mx_hvt
+
+    x = np.arange(4, dtype=np.float32)
+    out = mx_hvt.allreduce(x, average=True, name="mx1")
+    np.testing.assert_allclose(np.asarray(out), x)
+    # in-place variant writes back
+    y = np.arange(4, dtype=np.float32)
+    mx_hvt.allreduce_(y, average=False, name="mx2")
+    np.testing.assert_allclose(y, np.arange(4))
+
+
+def test_ndarray_ducktype_roundtrip():
+    from horovod_tpu.mxnet.mpi_ops import _assign, _like, _to_numpy
+
+    class FakeND:
+        def __init__(self, arr):
+            self.arr = np.asarray(arr, np.float32)
+
+        def asnumpy(self):
+            return self.arr
+
+        @classmethod
+        def from_numpy(cls, arr):
+            return cls(arr)
+
+        def __setitem__(self, k, v):
+            self.arr[k] = v.arr if isinstance(v, FakeND) else v
+
+    t = FakeND([1.0, 2.0])
+    assert _to_numpy(t).tolist() == [1.0, 2.0]
+    back = _like(np.asarray([3.0, 4.0], np.float32), t)
+    assert isinstance(back, FakeND) and back.asnumpy().tolist() == [3.0, 4.0]
+    _assign(t, np.asarray([5.0, 6.0], np.float32))
+    assert t.asnumpy().tolist() == [5.0, 6.0]
+
+
+def test_distributed_optimizer_rescale_and_update_single():
+    import horovod_tpu.mxnet as mx_hvt
+
+    class FakeOpt:
+        def __init__(self):
+            self.rescale_grad = 1.0
+            self.updates = []
+
+        def update(self, index, weight, grad, state):
+            self.updates.append((index, np.array(grad, copy=True)))
+
+    inner = FakeOpt()
+    opt = mx_hvt.DistributedOptimizer(inner,
+                                      gradient_predivide_factor=2.0)
+    # rescale folds predivide / world size (8-chip test mesh)
+    assert inner.rescale_grad == pytest.approx(2.0 / mx_hvt.size())
+    g = np.ones(3, np.float32)
+    opt.update(0, np.zeros(3), g, None)
+    assert inner.updates[0][0] == 0
+    # passthrough of inner attributes
+    assert opt.updates is inner.updates
+
+
+def test_distributed_trainer_gated_message():
+    import horovod_tpu.mxnet as mx_hvt
+
+    if not mx_hvt._MX_AVAILABLE:
+        with pytest.raises(ImportError, match="mxnet is not installed"):
+            mx_hvt.DistributedTrainer([], None)
+
+
+_PAR = pytest.mark.skipif(
+    not os.path.exists(LIB),
+    reason="C++ engine not built (make -C horovod_tpu/csrc)")
+
+
+def run_mx_workers(body, np=2, **kw):
+    import textwrap
+
+    return run_workers("import horovod_tpu.mxnet as mx_hvt\n"
+                       + textwrap.dedent(body), np=np, **kw)
+
+
+@_PAR
+def test_mx_allreduce_inplace_2proc():
+    run_mx_workers("""
+        g = np.full((4,), float(r + 1), np.float32)
+        mx_hvt.allreduce_(g, average=False, name="mx.g")
+        np.testing.assert_allclose(g, sum(i + 1 for i in range(n)))
+    """)
+
+
+@_PAR
+def test_mx_optimizer_sums_grads_and_rescales_2proc():
+    # reference semantics: wire op is SUM; averaging folded into the
+    # inner optimizer's rescale_grad (gradient_predivide_factor / size)
+    run_mx_workers("""
+        class FakeOpt:
+            def __init__(self):
+                self.rescale_grad = 1.0
+                self.seen = None
+            def update(self, index, weight, grad, state):
+                self.seen = np.array(grad, copy=True)
+                weight -= self.rescale_grad * self.seen
+
+        inner = FakeOpt()
+        opt = mx_hvt.DistributedOptimizer(inner)
+        assert abs(inner.rescale_grad - 1.0 / n) < 1e-12
+        w = np.zeros(3, np.float32)
+        g = np.full(3, float(r + 1), np.float32)
+        opt.update(0, w, g, None)
+        total = sum(i + 1 for i in range(n))
+        np.testing.assert_allclose(inner.seen, total)      # summed
+        np.testing.assert_allclose(w, -total / n)          # averaged step
+        # list-of-grads path with grouped fusion
+        gs = [np.full(2, float(r), np.float32),
+              np.full(2, float(r) + 5, np.float32)]
+        opt2 = mx_hvt.DistributedOptimizer(FakeOpt(), num_groups=1)
+        opt2._do_allreduce([0, 1], gs)
+        np.testing.assert_allclose(gs[0], sum(range(n)))
+        np.testing.assert_allclose(gs[1], sum(i + 5 for i in range(n)))
+    """)
+
+
+@_PAR
+def test_mx_trainer_grads_and_broadcast_parameters_2proc():
+    run_mx_workers("""
+        class FakeParam:
+            def __init__(self, grad, grad_req="write"):
+                self.grad_req = grad_req
+                self._g = grad
+            def list_grad(self):
+                return [self._g]
+
+        from horovod_tpu.mxnet import _allreduce_trainer_grads
+        params = [FakeParam(np.full(2, float(r + 1), np.float32)),
+                  FakeParam(np.zeros(2, np.float32), grad_req="null"),
+                  FakeParam(np.full(2, float(10 * r), np.float32))]
+        _allreduce_trainer_grads(params, num_groups=2)
+        np.testing.assert_allclose(params[0].list_grad()[0],
+                                   sum(i + 1 for i in range(n)))
+        np.testing.assert_allclose(params[1].list_grad()[0], 0.0)  # null
+        np.testing.assert_allclose(params[2].list_grad()[0],
+                                   sum(10 * i for i in range(n)))
+
+        ps = {"w": np.full(3, float(r), np.float32),
+              "b": np.full(1, float(-r), np.float32)}
+        mx_hvt.broadcast_parameters(ps, root_rank=1)
+        np.testing.assert_allclose(ps["w"], 1.0)
+        np.testing.assert_allclose(ps["b"], -1.0)
+    """)
