@@ -193,3 +193,63 @@ def broadcast_global_variables(root_rank=0, model=None, variables=None):
                 "variable registry no longer exists); e.g. "
                 "broadcast_global_variables(0, model=my_model)")
     hvt_tf.broadcast_variables(variables, root_rank)
+
+
+def allreduce(value, name=None, average=True, prescale_factor=1.0,
+              postscale_factor=1.0):
+    """Allreduce a tensor-compatible value (reference
+    ``keras/__init__.py:100``)."""
+    from horovod_tpu import tensorflow as hvt_tf
+
+    return hvt_tf.allreduce(value, name=name, average=average,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor)
+
+
+def allgather(value, name=None):
+    """Allgather along dim 0 (reference ``keras/__init__.py:116``)."""
+    from horovod_tpu import tensorflow as hvt_tf
+
+    return hvt_tf.allgather(value, name=name)
+
+
+def broadcast(value, root_rank, name=None):
+    """Broadcast from ``root_rank`` (reference ``keras/__init__.py:131``)."""
+    from horovod_tpu import tensorflow as hvt_tf
+
+    return hvt_tf.broadcast(value, root_rank=root_rank, name=name)
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=None):
+    """Load a saved Keras model with its optimizer re-wrapped in
+    :func:`DistributedOptimizer` (reference ``keras/__init__.py:147``) so
+    retraining resumes distributed — optimizer slot state included.
+
+    Every optimizer class in ``keras.optimizers`` is supported out of the
+    box; pass ``custom_optimizers`` (classes) or ``custom_objects`` for
+    anything else."""
+    _require_keras()
+    from horovod_tpu.tensorflow.compression import Compression
+
+    compression = compression or Compression.none
+
+    def wrap_optimizer(cls):
+        return lambda **kw: DistributedOptimizer(cls(**kw),
+                                                 compression=compression)
+
+    objs = dict(custom_objects or {})
+    for c in custom_optimizers or []:
+        objs.setdefault(c.__name__, wrap_optimizer(c))
+    model = _keras.models.load_model(filepath, custom_objects=objs,
+                                     compile=True)
+    # Keras 3 deserializes built-in optimizers by module path, bypassing
+    # custom_objects — wrap after the fact so slot state (already restored
+    # into the inner optimizer's variables) is preserved.
+    from horovod_tpu.tensorflow import _DistributedOptimizer
+
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and not isinstance(opt, _DistributedOptimizer):
+        model.optimizer = DistributedOptimizer(opt,
+                                               compression=compression)
+    return model

@@ -126,6 +126,11 @@ if _MX_AVAILABLE:
                      gradient_predivide_factor=1.0, prefix=None,
                      num_groups=0):
             if isinstance(optimizer, DistributedOptimizer):
+                # unfold the averaging DistributedOptimizer.__init__ baked
+                # into rescale_grad — the trainer folds its own factor
+                # into _scale below; leaving both would divide by size²
+                optimizer._optimizer.rescale_grad /= (
+                    optimizer._gradient_predivide_factor / size())
                 optimizer = optimizer._optimizer
             super().__init__(params, optimizer, optimizer_params,
                              kvstore=None)

@@ -203,6 +203,14 @@ def _to_framework(arr, like):
     return arr
 
 
+def _scale_indexed_or_dense(g, factor):
+    if _is_indexed_slices(g):
+        return _tf.IndexedSlices(g.values * factor, g.indices,
+                                 dense_shape=getattr(g, "dense_shape",
+                                                     None))
+    return g * factor
+
+
 def _allreduce_grads(grads, op=None, compression=Compression.none,
                      prescale_factor=1.0, postscale_factor=1.0,
                      process_set=None, name_prefix="grad", names=None):
@@ -221,6 +229,24 @@ def _allreduce_grads(grads, op=None, compression=Compression.none,
     op = op or C.Average
     ps = process_set or C.global_process_set
     nat = _native()
+    # tf.function trace without the native ops: the numpy bridge cannot
+    # touch symbolic tensors. Single process needs no exchange — scale
+    # in-graph and pass through; multi-process graph mode requires the
+    # native op library.
+    symbolic = (_TF_AVAILABLE and not _tf.executing_eagerly()
+                and nat is None)
+    if symbolic:
+        from horovod_tpu.common.basics import process_size
+        if process_size() > 1:
+            raise RuntimeError(
+                "multi-process TF graph mode needs the native custom-op "
+                "library (make -C horovod_tpu/csrc tf_ops); the numpy "
+                "bridge only supports eager execution")
+        factor = prescale_factor * postscale_factor
+        return [None if g is None
+                else (g if factor == 1.0
+                      else _scale_indexed_or_dense(g, factor))
+                for g in grads]
     outs = []
     for i, g in enumerate(grads):
         if g is None:
@@ -247,6 +273,13 @@ def _allreduce_grads(grads, op=None, compression=Compression.none,
             outs.append(_tf.cast(red, gt.dtype) if fp16 else red)
             continue
         if _is_indexed_slices(g):
+            if _TF_AVAILABLE and not _tf.executing_eagerly():
+                # no in-graph sparse exchange yet: the allgather-of-
+                # (indices, values) path runs on the numpy bridge only
+                raise RuntimeError(
+                    "sparse (IndexedSlices) gradients are not supported "
+                    "inside tf.function; run the step eagerly, or "
+                    "densify (tf.convert_to_tensor) before reducing")
             gi, gv = sparse_allreduce(
                 np.asarray(g.indices), np.asarray(g.values),
                 average=op is C.Average,

@@ -1,0 +1,110 @@
+"""Elastic state for TF/Keras training (reference
+``horovod/tensorflow/elastic.py``: ``run:31``, ``TensorFlowKerasState:91``,
+``TensorFlowState:156``).
+
+Same commit/restore/sync contract as :class:`horovod_tpu.elastic.State`:
+weights snapshot to **host memory** on ``commit()`` (device state does not
+survive a peer failure), roll back on ``HorovodInternalError``, broadcast
+from the new coordinator on re-initialization. Duck-typed so the gated
+tests can drive fakes: a "model" is anything with ``get_weights`` /
+``set_weights``; an "optimizer" is anything exposing ``variables``
+(Keras 3) or ``get_weights``/``set_weights`` pairs.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from horovod_tpu.elastic.run import run  # noqa: F401  (reference :31)
+from horovod_tpu.elastic.state import ObjectState
+
+
+def _optimizer_vars(optimizer):
+    v = getattr(optimizer, "variables", None)
+    if v is None:
+        return []
+    return list(v() if callable(v) else v)
+
+
+class TensorFlowKerasState(ObjectState):
+    """State of a Keras model + optimizer (reference
+    ``tensorflow/elastic.py:91``). Scalars (epoch, batch, ...) ride along
+    as ObjectState attributes."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        self._saved_weights = None
+        self._saved_opt = None
+        super().__init__(**kwargs)
+
+    def _tracked(self):
+        # scalars only; model/optimizer snapshot separately
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")
+                and k not in ("model", "optimizer")}
+
+    def save(self):
+        self._saved_weights = [np.array(w, copy=True)
+                               for w in self.model.get_weights()]
+        self._saved_opt = [np.array(v, copy=True)
+                           for v in _optimizer_vars(self.optimizer)]
+        super().save()
+
+    def restore(self):
+        self.model.set_weights([np.array(w, copy=True)
+                                for w in self._saved_weights])
+        for var, val in zip(_optimizer_vars(self.optimizer),
+                            self._saved_opt):
+            var.assign(val)
+        super().restore()
+
+    def sync(self):
+        from horovod_tpu.ops.functions import broadcast_object
+
+        synced = broadcast_object(
+            {"weights": self.model.get_weights(),
+             "opt": [np.asarray(v) for v in
+                     _optimizer_vars(self.optimizer)]},
+            root_rank=0, name="elastic.TFKerasState")
+        self.model.set_weights(synced["weights"])
+        for var, val in zip(_optimizer_vars(self.optimizer),
+                            synced["opt"]):
+            var.assign(val)
+        super().sync()
+
+
+class TensorFlowState(ObjectState):
+    """State of an explicit list of tf.Variables (reference
+    ``tensorflow/elastic.py:156``) — for custom loops that do not go
+    through Keras."""
+
+    def __init__(self, variables, **kwargs):
+        self.variables = list(variables)
+        self._saved_vars = None
+        super().__init__(**kwargs)
+
+    def _tracked(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_") and k != "variables"}
+
+    def save(self):
+        self._saved_vars = [np.array(v, copy=True) for v in self.variables]
+        super().save()
+
+    def restore(self):
+        for var, val in zip(self.variables, self._saved_vars):
+            var.assign(copy.deepcopy(val))
+        super().restore()
+
+    def sync(self):
+        from horovod_tpu.ops.functions import broadcast_object
+
+        synced = broadcast_object(
+            [np.asarray(v) for v in self.variables], root_rank=0,
+            name="elastic.TFState")
+        for var, val in zip(self.variables, synced):
+            var.assign(val)
+        super().sync()

@@ -53,15 +53,15 @@ def available() -> bool:
     return _load() is not None
 
 
-_name_seq = [0]
-
-
 def _auto_name(op, name):
     """Default collective name.
 
-    Eager: a runtime counter — SPMD replicas issue eager collectives in
-    program order, so the sequence lines up across ranks (same contract as
-    ``engine/api.py`` ``_auto_name``).
+    Eager: one FIXED name per op kind. Eager collectives complete before
+    the call returns, so at most one is in flight per kind and ranks match
+    by program order (same SPMD contract as ``engine/api.py``). A per-call
+    counter would work too, but TF caches one kernel per distinct attr
+    set — unique ``tensor_name`` values per call grow the kernel cache
+    without bound over a long eager loop.
 
     Inside a ``tf.function`` trace: return '' so the kernel falls back to
     its TF *node name* (``tf_ops.cc`` ``Key()``). Node names depend only
@@ -74,8 +74,7 @@ def _auto_name(op, name):
     import tensorflow as tf
     if not tf.executing_eagerly():
         return ""
-    _name_seq[0] += 1
-    return f"hvt.tf.{op}.{_name_seq[0]}"
+    return f"hvt.tf.{op}.eager"
 
 
 def _grad_name(op, kind):

@@ -229,3 +229,66 @@ def test_sync_batch_norm_serialization_roundtrip():
     assert rebuilt.momentum == 0.9 and rebuilt.epsilon == 1e-4
     # full-kwarg reference calls are accepted (GPU knobs ignored)
     hvt_tf.SyncBatchNormalization(beta_initializer="zeros", fused=False)
+
+
+def test_tensorflow_keras_state_commit_restore_sync(tmp_path):
+    import horovod_tpu.tensorflow.elastic as tfe
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(3)])
+    model(tf.zeros([1, 4]))
+    opt = tf.keras.optimizers.SGD(0.1)
+    opt.build(model.trainable_variables)
+    state = tfe.TensorFlowKerasState(model, opt, epoch=0, batch=0)
+
+    committed = [np.array(w, copy=True) for w in model.get_weights()]
+    state.commit()
+    # mutate, then restore → back to the commit
+    model.set_weights([w + 1.0 for w in model.get_weights()])
+    state.epoch = 7
+    state.restore()
+    for a, b in zip(model.get_weights(), committed):
+        np.testing.assert_allclose(a, b)
+    assert state.epoch == 0
+    # sync (1 process): broadcast keeps values, save() refreshes commit
+    state.sync()
+    for a, b in zip(model.get_weights(), committed):
+        np.testing.assert_allclose(a, b)
+
+
+def test_tensorflow_state_variables_restore():
+    import horovod_tpu.tensorflow.elastic as tfe
+
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable(5.0)
+    state = tfe.TensorFlowState([v1, v2], step=3)
+    state.commit()
+    v1.assign([9.0, 9.0])
+    v2.assign(-1.0)
+    state.step = 99
+    state.restore()
+    np.testing.assert_allclose(v1.numpy(), [1.0, 2.0])
+    assert float(v2.numpy()) == 5.0 and state.step == 3
+
+
+def test_keras_load_model_rewraps_optimizer(tmp_path):
+    import horovod_tpu.keras as hvt_keras
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+    model.compile(optimizer=tf.keras.optimizers.Adam(1e-3), loss="mse")
+    model.fit(np.random.randn(8, 4).astype(np.float32),
+              np.random.randn(8, 2).astype(np.float32),
+              epochs=1, verbose=0)
+    path = str(tmp_path / "m.keras")
+    model.save(path)
+
+    loaded = hvt_keras.load_model(path)
+    # optimizer came back wrapped in the distributed wrapper around Adam
+    from horovod_tpu.tensorflow import _DistributedOptimizer
+    assert isinstance(loaded.optimizer, _DistributedOptimizer)
+    assert "adam" in type(loaded.optimizer._opt).__name__.lower()
+    pred = loaded.predict(np.zeros((1, 4), np.float32), verbose=0)
+    assert pred.shape == (1, 2)
+    # retraining through the wrapped optimizer still works under fit
+    loaded.fit(np.random.randn(8, 4).astype(np.float32),
+               np.random.randn(8, 2).astype(np.float32),
+               epochs=1, verbose=0)
