@@ -234,13 +234,12 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
 
     compression = compression or Compression.none
 
-    def wrap_optimizer(cls):
-        return lambda **kw: DistributedOptimizer(cls(**kw),
-                                                 compression=compression)
-
+    # register custom optimizer CLASSES for deserialization (Keras 3
+    # resolves custom_objects entries as the objects themselves, not
+    # factory callables); the distributed wrap happens post-load below
     objs = dict(custom_objects or {})
     for c in custom_optimizers or []:
-        objs.setdefault(c.__name__, wrap_optimizer(c))
+        objs.setdefault(c.__name__, c)
     model = _keras.models.load_model(filepath, custom_objects=objs,
                                      compile=True)
     # Keras 3 deserializes built-in optimizers by module path, bypassing

@@ -385,6 +385,7 @@ class _DistributedOptimizer:
         self._process_set = process_set
         self._agg = None       # list of numpy accumulators (None for None)
         self._agg_count = 0
+        self._graph_agg = None  # tf.function path: in-graph aggregation
 
     def __getattr__(self, name):
         return getattr(self._opt, name)
@@ -415,17 +416,33 @@ class _DistributedOptimizer:
             raise ValueError(
                 "backward_passes_per_step > 1 does not support sparse "
                 "(IndexedSlices) gradients")
+        # stable per-variable names (not the apply counter): identical
+        # across ranks even under unequal tf.function retracing
+        names = [f"DistributedOptimizer.{i}."
+                 f"{getattr(v, 'name', None) or 'grad'}"
+                 for i, v in enumerate(variables)]
         if self.backward_passes_per_step > 1 and _TF_AVAILABLE and \
                 not _tf.executing_eagerly():
-            # the aggregation counter is Python state (numpy accumulators
-            # + data-dependent early return) — it cannot be traced into a
-            # graph. Fail at trace time with guidance instead of a cryptic
-            # np.asarray(symbolic) error mid-trace.
-            raise RuntimeError(
-                "backward_passes_per_step > 1 requires eager execution "
-                "(the local-aggregation counter is host-side state); call "
-                "apply_gradients outside @tf.function, or aggregate "
-                "in-graph and apply every step")
+            # traced path: aggregation state must live in the graph
+            # (tf.Variables + tf.cond), not Python counters — reference
+            # tensorflow/gradient_aggregation.py:16
+            from horovod_tpu.tensorflow.gradient_aggregation import \
+                LocalGradientAggregationHelper
+
+            if self._graph_agg is None:
+                self._graph_agg = LocalGradientAggregationHelper(
+                    self.backward_passes_per_step,
+                    lambda gs: _allreduce_grads(
+                        gs, op=self._op, compression=self._compression,
+                        prescale_factor=self._prescale,
+                        postscale_factor=self._postscale,
+                        process_set=self._process_set,
+                        name_prefix="DistributedOptimizer", names=names),
+                    average_aggregated_gradients=self._average_aggregated)
+            return self._graph_agg.compute_and_apply(
+                grads,
+                lambda red: self._opt.apply_gradients(
+                    zip(red, variables), **kwargs))
         if self.backward_passes_per_step > 1:
             self._aggregate(grads)
             if self._agg_count < self.backward_passes_per_step:
@@ -437,11 +454,6 @@ class _DistributedOptimizer:
                          for g in grads]
             self._agg = None
             self._agg_count = 0
-        # stable per-variable names (not the apply counter): identical
-        # across ranks even under unequal tf.function retracing
-        names = [f"DistributedOptimizer.{i}."
-                 f"{getattr(v, 'name', None) or 'grad'}"
-                 for i, v in enumerate(variables)]
         reduced = _allreduce_grads(
             grads, op=self._op, compression=self._compression,
             prescale_factor=self._prescale,

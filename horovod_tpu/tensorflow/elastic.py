@@ -13,8 +13,6 @@ tests can drive fakes: a "model" is anything with ``get_weights`` /
 
 from __future__ import annotations
 
-import copy
-
 import numpy as np
 
 from horovod_tpu.elastic.run import run  # noqa: F401  (reference :31)
@@ -53,12 +51,24 @@ class TensorFlowKerasState(ObjectState):
                            for v in _optimizer_vars(self.optimizer)]
         super().save()
 
-    def restore(self):
-        self.model.set_weights([np.array(w, copy=True)
-                                for w in self._saved_weights])
-        for var, val in zip(_optimizer_vars(self.optimizer),
-                            self._saved_opt):
+    @staticmethod
+    def _assign_opt_vars(opt, values, what):
+        live = _optimizer_vars(opt)
+        if len(live) != len(values):
+            raise RuntimeError(
+                f"optimizer has {len(live)} variables but the {what} "
+                f"holds {len(values)} — build the optimizer "
+                f"(opt.build(model.trainable_variables)) before "
+                f"constructing/restoring TensorFlowKerasState, or slot "
+                f"state would be silently dropped")
+        for var, val in zip(live, values):
             var.assign(val)
+
+    def restore(self):
+        # set_weights/assign copy into the variable buffers; the snapshot
+        # arrays are never aliased
+        self.model.set_weights(self._saved_weights)
+        self._assign_opt_vars(self.optimizer, self._saved_opt, "snapshot")
         super().restore()
 
     def sync(self):
@@ -70,9 +80,7 @@ class TensorFlowKerasState(ObjectState):
                      _optimizer_vars(self.optimizer)]},
             root_rank=0, name="elastic.TFKerasState")
         self.model.set_weights(synced["weights"])
-        for var, val in zip(_optimizer_vars(self.optimizer),
-                            synced["opt"]):
-            var.assign(val)
+        self._assign_opt_vars(self.optimizer, synced["opt"], "broadcast")
         super().sync()
 
 
@@ -96,7 +104,7 @@ class TensorFlowState(ObjectState):
 
     def restore(self):
         for var, val in zip(self.variables, self._saved_vars):
-            var.assign(copy.deepcopy(val))
+            var.assign(val)
         super().restore()
 
     def sync(self):

@@ -1,0 +1,89 @@
+"""Graph-mode local gradient aggregation — ``backward_passes_per_step``
+inside ``tf.function`` (reference ``tensorflow/gradient_aggregation.py:16``
+``LocalGradientAggregationHelper``; the eager analog lives as numpy
+accumulators in ``_DistributedOptimizer``).
+
+State is TF graph state, not Python state: non-trainable accumulation
+variables plus a step counter, updated in-graph so a single traced step
+function can express "accumulate N-1 times, then allreduce + apply once"
+with ``tf.cond``.
+"""
+
+from __future__ import annotations
+
+
+class LocalGradientAggregationHelper:
+    """Accumulate dense gradients across ``backward_passes_per_step``
+    traced calls; every Nth call allreduces the totals and delegates to
+    the caller's apply function.
+
+    ``allreduce_func``: list-of-dense-tensors -> list-of-reduced-tensors
+    (must be graph-safe — the binding passes ``_allreduce_grads`` bound to
+    the native op path). Sparse (IndexedSlices) gradients are rejected:
+    the accumulators are dense variables.
+    """
+
+    def __init__(self, backward_passes_per_step, allreduce_func,
+                 average_aggregated_gradients=False):
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce = allreduce_func
+        self._average = average_aggregated_gradients
+        self._counter = None
+        self._accum = None  # parallel to grads; None where grad is None
+
+    def _build(self, grads):
+        import tensorflow as tf
+
+        # created under init_scope so first-trace variable creation is
+        # lifted out of the traced function (the standard lazy-variable
+        # pattern); gradient shapes are the variables' static shapes
+        with tf.init_scope():
+            self._counter = tf.Variable(0, dtype=tf.int64, trainable=False,
+                                        name="hvt_agg_counter")
+            self._accum = [
+                None if g is None else
+                tf.Variable(tf.zeros(g.shape, g.dtype), trainable=False,
+                            name=f"hvt_agg_{i}")
+                for i, g in enumerate(grads)]
+
+    def compute_and_apply(self, grads, apply_fn):
+        """Add ``grads`` into the accumulators; on the Nth call reduce and
+        run ``apply_fn(reduced_grads)``. Returns a scalar bool tensor:
+        True when this call applied an update."""
+        import tensorflow as tf
+
+        if self._counter is None:
+            self._build(grads)
+        if len(grads) != len(self._accum):
+            raise ValueError(
+                "compute_and_apply called with a different number of "
+                "gradients than the aggregation in flight")
+
+        updates = [acc.assign_add(tf.cast(g, acc.dtype))
+                   for acc, g in zip(self._accum, grads)
+                   if acc is not None and g is not None]
+        with tf.control_dependencies(updates):
+            count = self._counter.assign_add(1)
+        n = self.backward_passes_per_step
+
+        def _flush():
+            totals = [
+                None if acc is None else
+                (acc / float(n) if self._average else acc.read_value())
+                for acc in self._accum]
+            reduced = self._allreduce(totals)
+            applied = apply_fn(reduced)
+            deps = [] if applied is None else [applied]
+            with tf.control_dependencies(deps):
+                resets = [acc.assign(tf.zeros_like(acc))
+                          for acc in self._accum if acc is not None]
+                resets.append(self._counter.assign(0))
+            with tf.control_dependencies(resets):
+                return tf.constant(True)
+
+        def _skip():
+            return tf.constant(False)
+
+        return tf.cond(tf.equal(count % n, 0), _flush, _skip)

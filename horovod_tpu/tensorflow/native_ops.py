@@ -53,15 +53,17 @@ def available() -> bool:
     return _load() is not None
 
 
+_name_seq = [0]
+
+
 def _auto_name(op, name):
     """Default collective name.
 
-    Eager: one FIXED name per op kind. Eager collectives complete before
-    the call returns, so at most one is in flight per kind and ranks match
-    by program order (same SPMD contract as ``engine/api.py``). A per-call
-    counter would work too, but TF caches one kernel per distinct attr
-    set — unique ``tensor_name`` values per call grow the kernel cache
-    without bound over a long eager loop.
+    Eager: a ROTATING counter (mod 1024). Ranks match by program order
+    (same SPMD contract as ``engine/api.py``); the rotation keeps names
+    unique among concurrently in-flight collectives (async eager /
+    threaded callers) while bounding TF's attr-keyed kernel cache, which
+    an unbounded counter would grow forever.
 
     Inside a ``tf.function`` trace: return '' so the kernel falls back to
     its TF *node name* (``tf_ops.cc`` ``Key()``). Node names depend only
@@ -74,7 +76,8 @@ def _auto_name(op, name):
     import tensorflow as tf
     if not tf.executing_eagerly():
         return ""
-    return f"hvt.tf.{op}.eager"
+    _name_seq[0] = (_name_seq[0] + 1) % 1024
+    return f"hvt.tf.{op}.e{_name_seq[0]}"
 
 
 def _grad_name(op, kind):

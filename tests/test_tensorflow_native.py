@@ -150,3 +150,26 @@ def test_native_shape_mismatch_errors_not_hangs():
         else:
             raise AssertionError("mismatched allreduce did not error")
     """)
+
+
+def test_native_graph_backward_passes_per_step():
+    # in-graph aggregation (tf.Variables + tf.cond) composed with the
+    # native allreduce: 2 accumulation passes, then one averaged update
+    run_tf_workers("""
+        v = tf.Variable([0.0, 0.0])
+        opt = hvd.DistributedOptimizer(
+            tf.keras.optimizers.SGD(1.0), backward_passes_per_step=2)
+
+        @tf.function
+        def step(g):
+            return opt.apply_gradients([(g, v)])
+
+        a1 = step(tf.constant([float(r + 1), 1.0]))
+        assert not bool(a1)
+        np.testing.assert_allclose(v.numpy(), 0.0)   # accumulating
+        a2 = step(tf.constant([float(r + 1), 1.0]))
+        assert bool(a2)
+        # per-rank sum over 2 passes = 2*(r+1); averaged across ranks
+        exp0 = -2.0 * np.mean([i + 1 for i in range(n)])
+        np.testing.assert_allclose(v.numpy(), [exp0, -2.0], rtol=1e-6)
+    """)

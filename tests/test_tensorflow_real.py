@@ -292,3 +292,65 @@ def test_keras_load_model_rewraps_optimizer(tmp_path):
     loaded.fit(np.random.randn(8, 4).astype(np.float32),
                np.random.randn(8, 2).astype(np.float32),
                epochs=1, verbose=0)
+
+
+def test_graph_mode_backward_passes_per_step_single_process():
+    import horovod_tpu.tensorflow as hvt_tf2
+
+    v = tf.Variable([10.0, 20.0])
+    opt = hvt_tf2.DistributedOptimizer(
+        tf.keras.optimizers.SGD(1.0), backward_passes_per_step=2,
+        average_aggregated_gradients=True)
+
+    @tf.function
+    def step(g):
+        return opt.apply_gradients([(g, v)])
+
+    applied1 = step(tf.constant([1.0, 2.0]))
+    assert not bool(applied1)            # accumulation only
+    np.testing.assert_allclose(v.numpy(), [10.0, 20.0])
+    applied2 = step(tf.constant([3.0, 4.0]))
+    assert bool(applied2)                # flush: avg of the two grads
+    np.testing.assert_allclose(v.numpy(), [10.0 - 2.0, 20.0 - 3.0])
+    # next cycle starts clean
+    assert not bool(step(tf.constant([0.0, 0.0])))
+    np.testing.assert_allclose(v.numpy(), [8.0, 17.0])
+
+
+def test_tensorflow_keras_state_unbuilt_optimizer_errors():
+    import horovod_tpu.tensorflow.elastic as tfe
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+    model(tf.zeros([1, 3]))
+    opt = tf.keras.optimizers.SGD(0.1)
+    opt.build(model.trainable_variables)
+    state = tfe.TensorFlowKerasState(model, opt)
+    state.commit()
+    # a later restore against an optimizer whose variable count changed
+    # must fail loudly, not silently drop slot state
+    state._saved_opt = state._saved_opt[:-1]
+    with pytest.raises(RuntimeError, match="variables"):
+        state.restore()
+
+
+def test_keras_load_model_custom_optimizer_class(tmp_path):
+    import keras
+
+    import horovod_tpu.keras as hvt_keras
+
+    @keras.saving.register_keras_serializable(package="hvt_test")
+    class MySGD(tf.keras.optimizers.SGD):
+        pass
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+    model.compile(optimizer=MySGD(0.01), loss="mse")
+    model.fit(np.random.randn(4, 3).astype(np.float32),
+              np.random.randn(4, 2).astype(np.float32),
+              epochs=1, verbose=0)
+    path = str(tmp_path / "c.keras")
+    model.save(path)
+
+    loaded = hvt_keras.load_model(path, custom_optimizers=[MySGD])
+    from horovod_tpu.tensorflow import _DistributedOptimizer
+    assert isinstance(loaded.optimizer, _DistributedOptimizer)
+    assert isinstance(loaded.optimizer._opt, MySGD)
