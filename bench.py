@@ -89,7 +89,7 @@ def calibrate_matmul_tflops(platform):
 
 
 def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
-                dtype_name, seq_len=1024):
+                dtype_name, seq_len=1024, use_flash=False):
     """GPT train-step throughput on a dp mesh (tokens/sec/chip) — the
     flagship-model counterpart of the ResNet measurement. FLOPs/token by
     the standard training estimate 6N + 12·L·d_model·seq (dense matmuls
@@ -108,7 +108,8 @@ def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
     mesh = make_parallel_mesh(devices=devices, dp=n)
     dtype = jnp.float32 if dtype_name == "fp32" else jnp.bfloat16
     cfg = GPTConfig(vocab_size=32768, n_layers=12, d_model=768, n_heads=12,
-                    d_ff=3072, max_seq_len=seq_len, dtype=dtype)
+                    d_ff=3072, max_seq_len=seq_len, dtype=dtype,
+                    use_flash=use_flash)
     model = GPT(cfg)
     global_batch = per_chip_batch * n
     rng = np.random.RandomState(0)
@@ -284,6 +285,9 @@ def main():
                         "smaller for CPU harness validation)")
     p.add_argument("--no-scaling", action="store_true",
                    help="skip the 1→N chip scaling sweep")
+    p.add_argument("--flash", action="store_true",
+                   help="gpt: pallas fused attention instead of the "
+                        "einsum-softmax path")
     p.add_argument("--force-cpu", action="store_true",
                    help="run on a 2-device virtual CPU mesh (harness "
                         "validation; the JAX_PLATFORMS env var alone does "
@@ -315,7 +319,8 @@ def main():
     def run_measure(devs, iters, bs):
         if gpt:
             return measure_gpt(devs, bs, iters, args.num_batches_per_iter,
-                               dtype_name, args.seq_len)
+                               dtype_name, args.seq_len,
+                               use_flash=args.flash)
         return measure(args.model, devs, bs, iters,
                        args.num_batches_per_iter, dtype_name,
                        args.image_size)

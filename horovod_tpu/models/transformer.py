@@ -38,6 +38,12 @@ class GPTConfig:
     max_seq_len: int = 1024
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block (HBM ↔ FLOPs trade)
+    # pallas fused attention (ops/flash_attention.py) instead of the
+    # einsum-softmax path: O(seq) memory, no materialized score matrix.
+    # Requires the local sequence to be the full, contiguous sequence
+    # (its causal mask is positional-by-block) — leave False under
+    # sequence parallelism, where ring attention owns the schedule.
+    use_flash: bool = False
 
 
 def _rotary(x, positions):
@@ -80,6 +86,15 @@ class Attention(nn.Module):
         v = dense((cfg.n_heads, head_dim), "v")(x)
         q = _rotary(q, positions)
         k = _rotary(k, positions)
+
+        if cfg.use_flash:
+            from horovod_tpu.ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True,
+                                  scale=1.0 / np.sqrt(head_dim))
+            return nn.DenseGeneral(cfg.d_model, axis=(-2, -1),
+                                   use_bias=False, dtype=cfg.dtype,
+                                   param_dtype=jnp.float32, name="o")(out)
 
         scores = jnp.einsum("...qhd,...khd->...hqk", q, k,
                             preferred_element_type=jnp.float32)

@@ -29,6 +29,14 @@ _NEG_INF = -1e30
 
 
 def _interpret() -> bool:
+    import os
+
+    v = os.environ.get("HVT_FLASH_INTERPRET")
+    if v is not None:
+        return v.strip().lower() not in ("0", "false", "no", "off", "")
+    # interpret everywhere but real TPU backends (CPU test meshes run the
+    # same kernel code); TPU *plugin* platforms (e.g. tunneled rigs) vary
+    # in pallas support — force with HVT_FLASH_INTERPRET=0/1
     return jax.default_backend() != "tpu"
 
 

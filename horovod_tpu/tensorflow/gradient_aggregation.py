@@ -60,6 +60,14 @@ class LocalGradientAggregationHelper:
             raise ValueError(
                 "compute_and_apply called with a different number of "
                 "gradients than the aggregation in flight")
+        for acc, g in zip(self._accum, grads):
+            if acc is None and g is not None:
+                # slot layout is frozen at first build; silently dropping
+                # a newly-trainable gradient would be invisible data loss
+                raise ValueError(
+                    "a gradient that was None when aggregation started is "
+                    "now present (e.g. a layer was unfrozen) — recreate "
+                    "the DistributedOptimizer so accumulation slots match")
 
         updates = [acc.assign_add(tf.cast(g, acc.dtype))
                    for acc, g in zip(self._accum, grads)

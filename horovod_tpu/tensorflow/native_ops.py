@@ -76,8 +76,9 @@ def _auto_name(op, name):
     import tensorflow as tf
     if not tf.executing_eagerly():
         return ""
-    _name_seq[0] = (_name_seq[0] + 1) % 1024
-    return f"hvt.tf.{op}.e{_name_seq[0]}"
+    with _lock:
+        _name_seq[0] = (_name_seq[0] + 1) % 1024
+        return f"hvt.tf.{op}.e{_name_seq[0]}"
 
 
 def _grad_name(op, kind):
@@ -100,11 +101,19 @@ def _grad_name(op, kind):
     return _auto_name(kind, None)
 
 
-def _members(process_set):
+def _members(process_set, name=None):
     if process_set is None:
         return []
     ranks = getattr(process_set, "ranks", None)
-    return list(ranks) if ranks else []
+    members = list(ranks) if ranks else []
+    if members and not name:
+        # auto-names count on every rank advancing the sequence in the
+        # same global program order; subset collectives break that (the
+        # counter advances only on members), so they must be named
+        raise ValueError(
+            "process-set collectives need an explicit name= — auto-"
+            "generated names rely on globally identical program order")
+    return members
 
 
 def allreduce(tensor, name=None, op=AVERAGE, prescale_factor=1.0,
@@ -114,21 +123,21 @@ def allreduce(tensor, name=None, op=AVERAGE, prescale_factor=1.0,
     return mod.hvt_allreduce(
         tensor, tensor_name=_auto_name("allreduce", name), reduce_op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set_ranks=_members(process_set))
+        process_set_ranks=_members(process_set, name))
 
 
 def allgather(tensor, name=None, process_set=None):
     mod = _load()
     return mod.hvt_allgather(tensor,
                              tensor_name=_auto_name("allgather", name),
-                             process_set_ranks=_members(process_set))
+                             process_set_ranks=_members(process_set, name))
 
 
 def broadcast(tensor, root_rank=0, name=None, process_set=None):
     mod = _load()
     return mod.hvt_broadcast(tensor, root_rank=root_rank,
                              tensor_name=_auto_name("broadcast", name),
-                             process_set_ranks=_members(process_set))
+                             process_set_ranks=_members(process_set, name))
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
@@ -139,7 +148,7 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         splits = tf.zeros([0], dtype=tf.int32)
     return mod.hvt_alltoall(tensor, tf.cast(splits, tf.int32),
                             tensor_name=_auto_name("alltoall", name),
-                            process_set_ranks=_members(process_set))
+                            process_set_ranks=_members(process_set, name))
 
 
 def size_op():

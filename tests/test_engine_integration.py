@@ -612,3 +612,20 @@ def test_sparse_allreduce_unequal_nnz_2proc():
         print(f"UNEQ-OK-{r}", flush=True)
     """)
     assert "UNEQ-OK-0" in out and "UNEQ-OK-1" in out
+
+
+def test_stall_inspector_warns_then_recovers_2proc():
+    """Rank-0 stall watchdog (reference stall_inspector.h:30-96 /
+    test_stall.py intent): when one rank lags on a tensor past
+    HVT_STALL_WARN_SEC, rank 0 logs which ranks are missing; the
+    collective still completes once the laggard submits."""
+    out = run_workers("""
+        import time
+        if r == 1:
+            time.sleep(2.5)   # rank 0 announces; rank 1 lags past warn
+        res = np.asarray(hvt.allreduce(
+            np.full((3,), float(r + 1), np.float32), name="laggy"))
+        np.testing.assert_allclose(res, (1 + n) / 2.0)
+    """, launcher_args=("--stall-warning-sec", "1"))
+    assert "laggy" in out and "possible stall" in out, out[-2000:]
+    assert "not by ranks [ 1 ]" in out, out[-2000:]

@@ -108,3 +108,30 @@ def test_mesh_factors():
     for n in (1, 2, 4, 8, 16, 64, 256):
         dp, sp, tp = ge._mesh_factors(n)
         assert dp * sp * tp == n
+
+
+def test_gpt_flash_attention_matches_einsum_path():
+    """use_flash must be a pure performance switch: identical logits and
+    gradients (the pallas kernel runs in interpret mode on the CPU
+    mesh)."""
+    import dataclasses
+
+    from horovod_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=64, n_layers=2, d_model=32, n_heads=2,
+                    d_ff=64, dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(1).randint(0, 64, (2, 16)))
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    model_f = GPT(dataclasses.replace(cfg, use_flash=True))
+
+    def loss(m, p):
+        return (m.apply(p, tokens).astype(jnp.float32) ** 2).mean()
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(model, p))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss(model_f, p))(params)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                               rtol=2e-5, atol=2e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
