@@ -61,13 +61,15 @@ class LocalGradientAggregationHelper:
                 "compute_and_apply called with a different number of "
                 "gradients than the aggregation in flight")
         for acc, g in zip(self._accum, grads):
-            if acc is None and g is not None:
-                # slot layout is frozen at first build; silently dropping
-                # a newly-trainable gradient would be invisible data loss
+            if (acc is None) != (g is None):
+                # slot layout is frozen at first build; a None↔present
+                # flip would silently drop a newly-trainable gradient or
+                # keep feeding zeros for a newly-frozen one
                 raise ValueError(
-                    "a gradient that was None when aggregation started is "
-                    "now present (e.g. a layer was unfrozen) — recreate "
-                    "the DistributedOptimizer so accumulation slots match")
+                    "a gradient's None-ness changed after aggregation "
+                    "started (e.g. a layer was frozen/unfrozen) — "
+                    "recreate the DistributedOptimizer so accumulation "
+                    "slots match")
 
         updates = [acc.assign_add(tf.cast(g, acc.dtype))
                    for acc, g in zip(self._accum, grads)

@@ -107,12 +107,16 @@ def _members(process_set, name=None):
     ranks = getattr(process_set, "ranks", None)
     members = list(ranks) if ranks else []
     if members and not name:
-        # auto-names count on every rank advancing the sequence in the
-        # same global program order; subset collectives break that (the
-        # counter advances only on members), so they must be named
-        raise ValueError(
-            "process-set collectives need an explicit name= — auto-"
-            "generated names rely on globally identical program order")
+        import tensorflow as tf
+        if tf.executing_eagerly():
+            # eager auto-names count on every rank advancing the sequence
+            # in the same global program order; subset collectives break
+            # that (the counter advances only on members). Graph mode is
+            # fine — node names don't use the counter.
+            raise ValueError(
+                "eager process-set collectives need an explicit name= — "
+                "auto-generated names rely on globally identical program "
+                "order")
     return members
 
 
