@@ -321,12 +321,16 @@ def main():
                     [sys.executable, "-c",
                      "import jax; print(len(jax.devices()))"],
                     capture_output=True, text=True, timeout=120)
-                if probe.returncode == 0 and probe.stdout.strip().isdigit():
+                lines = probe.stdout.strip().splitlines()
+                if probe.returncode == 0 and lines and \
+                        lines[-1].strip().isdigit():
                     break
-                # deterministic failure (broken install, ImportError):
-                # retrying can't help — fail fast with the real cause
-                sys.exit("device probe failed (not a timeout): "
-                         + (probe.stderr or "").strip()[-500:])
+                err = (probe.stderr or "").strip()[-500:]
+                if "ModuleNotFoundError" in err or "ImportError" in err:
+                    # deterministic (broken install) — retrying can't help
+                    sys.exit("device probe failed: " + err)
+                # anything else (gRPC UNAVAILABLE, backend init error) is
+                # treated as transient like a timeout and retried
             except subprocess.TimeoutExpired:
                 err = "backend init timed out after 120 s"
             print(f"# device probe attempt {attempt + 1}/3 failed: {err}",

@@ -27,6 +27,8 @@ def main():
     p.add_argument("--num-iters", type=int, default=3)
     p.add_argument("--num-batches-per-iter", type=int, default=5)
     p.add_argument("--fp32", action="store_true")
+    p.add_argument("--image-size", type=int, default=224,
+                   help="square input resolution (small for CPU smoke)")
     args = p.parse_args()
 
     hvt.init()
@@ -37,7 +39,7 @@ def main():
     global_batch = args.batch_size * n_dev
     rs = np.random.RandomState(0)
     images = jnp.asarray(
-        rs.randn(global_batch, 224, 224, 3).astype(np.float32),
+        rs.randn(global_batch, args.image_size, args.image_size, 3).astype(np.float32),
         dtype=dtype)
     labels = jnp.asarray(rs.randint(0, 1000, (global_batch,)))
 

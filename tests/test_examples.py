@@ -1,0 +1,72 @@
+"""Example smoke tests — the analog of the reference CI running its
+examples as smoke jobs (``.buildkite/gen-pipeline.sh:135-173``). Each
+example runs as a real subprocess with tiny shapes on the CPU platform;
+the multi-process ones go through the actual ``hvtrun`` launcher."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_engine_integration import LIB, REPO, _PORT
+
+TF_OPS_LIB = os.path.join(REPO, "horovod_tpu", "csrc", "build",
+                          "libhvt_tf_ops.so")
+
+
+def _run_example(argv, timeout=300, np_procs=None, extra_env=None):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": "", "TF_CPP_MIN_LOG_LEVEL": "3",
+                "PYTHONPATH": REPO + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    env.update(extra_env or {})
+    if np_procs:
+        _PORT[0] += 1
+        cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+               "-np", str(np_procs), "--master-port", str(_PORT[0]),
+               sys.executable, *argv]
+    else:
+        cmd = [sys.executable, *argv]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=timeout)
+    assert proc.returncode == 0, \
+        f"{argv}\nrc={proc.returncode}\nstdout:\n{proc.stdout[-3000:]}\n" \
+        f"stderr:\n{proc.stderr[-3000:]}"
+    return proc.stdout + proc.stderr
+
+
+def test_jax_synthetic_benchmark_smoke():
+    out = _run_example(
+        ["examples/jax/jax_synthetic_benchmark.py", "--batch-size", "2",
+         "--num-iters", "1", "--num-batches-per-iter", "1",
+         "--image-size", "32", "--fp32"])
+    assert "img/sec" in out, out[-1000:]
+
+
+def test_jax_gpt_train_smoke_dp_tp():
+    out = _run_example(
+        ["examples/jax/jax_gpt_train.py", "--dp", "2", "--tp", "2",
+         "--steps", "2", "--batch", "2", "--seq", "32"],
+        extra_env={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=4"})
+    assert "loss" in out.lower(), out[-1000:]
+
+
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="C++ engine not built")
+def test_torch_synthetic_benchmark_smoke_2proc():
+    out = _run_example(
+        ["examples/torch/pytorch_synthetic_benchmark.py",
+         "--batch-size", "4", "--num-iters", "1",
+         "--num-batches-per-iter", "2"], np_procs=2)
+    assert "img/sec" in out or "sec" in out, out[-1000:]
+
+
+@pytest.mark.skipif(not os.path.exists(TF_OPS_LIB),
+                    reason="TF op library not built")
+def test_tf_function_train_smoke_2proc():
+    out = _run_example(["examples/tensorflow/tf_function_train.py"],
+                       np_procs=2, timeout=420)
+    assert "loss" in out, out[-1000:]
