@@ -166,11 +166,18 @@ def test_tensorflow_binding_gated():
             hvt_tf.allreduce(np.ones(3))
 
 
-def test_mxnet_binding_guidance():
+def test_mxnet_binding_surface():
+    # the binding is real (gated): collective surface + optimizer wrapper
+    # exist; only the Gluon trainer needs an actual mxnet install
     import horovod_tpu.mxnet as hvt_mx
 
-    with pytest.raises(NotImplementedError, match="horovod_tpu.jax"):
-        hvt_mx.DistributedOptimizer()
+    for fn in ("allreduce", "allreduce_", "grouped_allreduce", "allgather",
+               "broadcast", "broadcast_", "alltoall",
+               "broadcast_parameters", "DistributedOptimizer"):
+        assert hasattr(hvt_mx, fn), fn
+    if not hvt_mx._MX_AVAILABLE:
+        with pytest.raises(ImportError, match="horovod_tpu.jax"):
+            hvt_mx.DistributedTrainer([], None)
 
 
 def test_keras_binding_gated():

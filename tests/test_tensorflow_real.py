@@ -354,3 +354,30 @@ def test_keras_load_model_custom_optimizer_class(tmp_path):
     from horovod_tpu.tensorflow import _DistributedOptimizer
     assert isinstance(loaded.optimizer, _DistributedOptimizer)
     assert isinstance(loaded.optimizer._opt, MySGD)
+
+
+def test_keras_commit_state_callback_with_tf_keras_state():
+    """CommitStateCallback commits TensorFlowKerasState every N batches
+    during a real model.fit (reference _keras/elastic.py wiring)."""
+    import horovod_tpu.keras as hvt_keras
+    import horovod_tpu.tensorflow.elastic as tfe
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+    model.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+    model(tf.zeros([1, 3]))
+    state = tfe.TensorFlowKerasState(model, model.optimizer, batch=0,
+                                     epoch=0)
+    commits = []
+    orig_commit = state.commit
+    state.commit = lambda: (commits.append(1), orig_commit())[1]
+
+    X = np.random.RandomState(0).randn(32, 3).astype(np.float32)
+    y = np.zeros((32, 1), np.float32)
+    model.fit(X, y, epochs=1, batch_size=8, verbose=0,
+              callbacks=[hvt_keras.CommitStateCallback(
+                  state, batches_per_commit=2),
+                  hvt_keras.UpdateBatchStateCallback(state)])
+    assert len(commits) == 2          # 4 batches / commit every 2
+    assert state.batch == 0 and state.epoch == 1  # epoch rolled over
+    # the last commit snapshot restores cleanly
+    state.restore()
