@@ -111,12 +111,18 @@ def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None):
 
 
 def ulysses_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
-                            attn_fn=None):
+                            attn_fn=None, use_flash=False):
     """Ulysses (all-to-all) attention on per-device shards; under shard_map.
 
     Reshard [B, S/N, H, D] → all_to_all → [B, S, H/N, D], run dense local
     attention over the full sequence with a head subset, reshard back.
     ``heads`` must be divisible by the axis size.
+
+    After the head exchange the local problem IS full-sequence causal
+    attention, so ``use_flash=True`` runs it through the pallas fused
+    kernel (``ops/flash_attention.py``) — O(seq) memory where the dense
+    path materializes the [S × S] score matrix. ``attn_fn`` overrides
+    both.
     """
     n = lax.axis_size(axis_name)
     b, s, h, d = q.shape
@@ -134,6 +140,11 @@ def ulysses_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
                               concat_axis=concat, tiled=True)
 
     qg, kg, vg = a2a(q, True), a2a(k, True), a2a(v, True)  # [B, S, H/N, D]
+    if attn_fn is None and use_flash:
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = functools.partial(flash_attention, causal=causal,
+                                    scale=scale)
     if attn_fn is None:
         pos = jnp.arange(s * n)
         og, _, l = _local_attention(qg, kg, vg, pos, pos,
@@ -166,13 +177,13 @@ def ring_attention(q, k, v, *, mesh, axis_name="sp", seq_specs=None,
 
 
 def ulysses_attention(q, k, v, *, mesh, axis_name="sp", seq_specs=None,
-                      causal=True, scale=None):
+                      causal=True, scale=None, use_flash=False):
     """Global-array convenience wrapper for `ulysses_attention_shard`."""
     if seq_specs is None:
         seq_specs = _default_specs(mesh, axis_name)
     return _wrap(ulysses_attention_shard, q, k, v, mesh=mesh,
                  axis_name=axis_name, seq_specs=seq_specs,
-                 causal=causal, scale=scale)
+                 causal=causal, scale=scale, use_flash=use_flash)
 
 
 def _default_specs(mesh, axis_name):
