@@ -68,6 +68,28 @@ def _steps_per_epoch(global_rows: int, n_procs: int, batch_size: int
     return max(1, (shard_max + batch_size - 1) // batch_size)
 
 
+def _train_val_split(total: int, validation):
+    """Deterministic global train/validation split — identical on every
+    rank (seeded permutation; no coordination needed). ``validation`` is
+    None or a fraction in (0, 1). Each rank evaluates the FULL hold-out
+    (these estimators are in-memory; the reference shards validation
+    through Petastorm instead), which keeps ranks trivially in lockstep.
+    """
+    import numpy as np
+
+    if not validation:
+        return np.arange(total), np.asarray([], np.int64)
+    if not 0.0 < float(validation) < 1.0:
+        raise ValueError(
+            f"validation={validation} must be a fraction in (0, 1)")
+    n_val = max(1, int(total * float(validation)))
+    if n_val >= total:
+        raise ValueError(
+            f"validation={validation} leaves no training rows")
+    perm = np.random.RandomState(9172).permutation(total)
+    return np.sort(perm[n_val:]), np.sort(perm[:n_val])
+
+
 def _shard_rows(global_rows: int, r: int, n: int):
     """Row indices of rank ``r``'s shard (strided, like the reference's
     Petastorm row-group sharding). Every rank must come back non-empty —
@@ -261,7 +283,8 @@ class TorchEstimator(_EstimatorBase):
                  num_proc: Optional[int] = None, epochs: int = 1,
                  batch_size: int = 32, master_port: int = 29576,
                  store=None, run_id: Optional[str] = None,
-                 callbacks: Optional[list] = None):
+                 callbacks: Optional[list] = None,
+                 validation: Optional[float] = None):
         self.model = model
         self.optimizer_fn = optimizer_fn
         self.loss_fn = loss_fn
@@ -274,6 +297,9 @@ class TorchEstimator(_EstimatorBase):
         self.store = store
         self.run_id = run_id or f"torch-{uuid.uuid4().hex[:8]}"
         self.callbacks = list(callbacks or [])
+        # fraction in (0,1): deterministic hold-out, per-epoch val_loss
+        # in history/callbacks (reference estimator `validation` param)
+        self.validation = validation
 
     def _fit_arrays(self, X, y, run_fn=None, broadcast=None
                     ) -> "TorchModel":
@@ -285,6 +311,7 @@ class TorchEstimator(_EstimatorBase):
         epochs, batch_size = self.epochs, self.batch_size
         store, run_id = self.store, self.run_id
         callbacks = self.callbacks
+        validation = self.validation
         bc = broadcast
 
         def worker():
@@ -298,9 +325,14 @@ class TorchEstimator(_EstimatorBase):
             # shard by PROCESS: the estimator loop is per-worker-process
             # (a process may drive several chips; hvt.size() counts chips)
             n, r = hvt.process_size(), hvt.process_rank()
-            rows = _shard_rows(len(bx), r, n)
+            train_ids, val_ids = _train_val_split(len(bx), validation)
+            rows = train_ids[_shard_rows(len(train_ids), r, n)]
             sx = torch.from_numpy(np.ascontiguousarray(bx[rows]))
             sy = torch.from_numpy(np.ascontiguousarray(by[rows]))
+            vx = (torch.from_numpy(np.ascontiguousarray(bx[val_ids]))
+                  if len(val_ids) else None)
+            vy = (torch.from_numpy(np.ascontiguousarray(by[val_ids]))
+                  if len(val_ids) else None)
             model = pickle.loads(model_blob)
             opt = hvt_torch.DistributedOptimizer(
                 optimizer_fn(model.parameters()),
@@ -309,7 +341,22 @@ class TorchEstimator(_EstimatorBase):
             lf = loss_fn or torch.nn.functional.mse_loss
             # equal step count on every rank (see _steps_per_epoch): the
             # per-step gradient collectives must stay in lockstep
-            steps = _steps_per_epoch(len(bx), n, batch_size)
+            steps = _steps_per_epoch(len(train_ids), n, batch_size)
+
+            def val_loss():
+                total, seen = 0.0, 0
+                model.eval()  # dropout off; BN must not absorb hold-out
+                try:
+                    with torch.no_grad():
+                        for i in range(0, len(vx), batch_size):
+                            xb = vx[i:i + batch_size]
+                            yb = vy[i:i + batch_size]
+                            lv = lf(model(xb).reshape(-1), yb.reshape(-1))
+                            total += float(lv) * len(xb)
+                            seen += len(xb)
+                finally:
+                    model.train()
+                return total / max(seen, 1)
 
             def train_epochs(ckpt_dir=None, on_epoch=None):
                 history = []
@@ -331,6 +378,10 @@ class TorchEstimator(_EstimatorBase):
                         total += float(loss.detach())
                         batches += 1
                     logs = {"loss": total / max(batches, 1)}
+                    if vx is not None and r == 0:
+                        # rank-0 only: no collectives inside, and only
+                        # rank 0's history/callbacks are consumed
+                        logs["val_loss"] = val_loss()
                     history.append(logs)
                     if r == 0:
                         for cb in callbacks:
@@ -389,7 +440,8 @@ class KerasEstimator(_EstimatorBase):
                  num_proc: Optional[int] = None, epochs: int = 1,
                  batch_size: int = 32, master_port: int = 29577,
                  store=None, run_id: Optional[str] = None,
-                 callbacks: Optional[list] = None):
+                 callbacks: Optional[list] = None,
+                 validation: Optional[float] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -402,6 +454,7 @@ class KerasEstimator(_EstimatorBase):
         self.store = store
         self.run_id = run_id or f"keras-{uuid.uuid4().hex[:8]}"
         self.callbacks = list(callbacks or [])
+        self.validation = validation
 
     @staticmethod
     def _model_to_bytes(model) -> bytes:
@@ -448,6 +501,7 @@ class KerasEstimator(_EstimatorBase):
         epochs, batch_size = self.epochs, self.batch_size
         store, run_id = self.store, self.run_id
         callbacks = self.callbacks
+        validation = self.validation
         bc = broadcast
 
         def worker():
@@ -461,9 +515,14 @@ class KerasEstimator(_EstimatorBase):
             # shard by PROCESS: the estimator loop is per-worker-process
             # (a process may drive several chips; hvt.size() counts chips)
             n, r = hvt.process_size(), hvt.process_rank()
-            rows = _shard_rows(len(bx), r, n)
+            train_ids, val_ids = _train_val_split(len(bx), validation)
+            rows = train_ids[_shard_rows(len(train_ids), r, n)]
             sx = np.ascontiguousarray(bx[rows])
             sy = np.ascontiguousarray(by[rows])
+            vx = (np.ascontiguousarray(bx[val_ids]) if len(val_ids)
+                  else None)
+            vy = (np.ascontiguousarray(by[val_ids]) if len(val_ids)
+                  else None)
             model = KerasEstimator._model_from_bytes(model_blob)
             opt = tf.keras.optimizers.deserialize(opt_cfg)
             loss_fn = tf.keras.losses.get(loss)
@@ -473,7 +532,19 @@ class KerasEstimator(_EstimatorBase):
             # uneven shards would desynchronize the per-step gradient
             # collectives (wrap-around padding; global row count is
             # known to all ranks)
-            steps = _steps_per_epoch(len(bx), n, batch_size)
+            steps = _steps_per_epoch(len(train_ids), n, batch_size)
+
+            def val_loss():
+                total, seen = 0.0, 0
+                for i in range(0, len(vx), batch_size):
+                    xb = tf.constant(vx[i:i + batch_size])
+                    yb = tf.constant(vy[i:i + batch_size])
+                    lv = tf.reduce_mean(loss_fn(
+                        tf.reshape(yb, [-1]),
+                        tf.reshape(model(xb, training=False), [-1])))
+                    total += float(lv) * int(xb.shape[0])
+                    seen += int(xb.shape[0])
+                return total / max(seen, 1)
 
             def train_epochs(ckpt_dir=None, on_epoch=None):
                 history = []
@@ -499,6 +570,8 @@ class KerasEstimator(_EstimatorBase):
                         total += float(lv)
                         batches += 1
                     logs = {"loss": total / max(batches, 1)}
+                    if vx is not None and r == 0:
+                        logs["val_loss"] = val_loss()
                     history.append(logs)
                     if r == 0:
                         for cb in callbacks:

@@ -303,3 +303,74 @@ def test_shard_rows_never_empty():
     # normal case unchanged: strided, disjoint, complete
     got = np.sort(np.concatenate([_shard_rows(33, r, 2) for r in range(2)]))
     np.testing.assert_array_equal(got, np.arange(33))
+
+
+def test_estimator_validation_split_val_loss(tmp_path):
+    """validation= holds out a deterministic fraction; callbacks carry
+    per-epoch val_loss (reference estimator param)."""
+    import numpy as np
+    import torch
+
+    from horovod_tpu.spark import TorchEstimator
+    from horovod_tpu.spark.estimator import _train_val_split
+
+    class FullRecorder:
+        def __init__(self):
+            self.logs = []
+
+        def on_epoch_end(self, epoch, logs):
+            self.logs.append(dict(logs))
+
+    rng = np.random.RandomState(6)
+    X = rng.randn(80, 2).astype(np.float32)
+    y = (X @ np.asarray([1.0, -2.0], np.float32))
+    rec = FullRecorder()
+    est = TorchEstimator(
+        model=torch.nn.Linear(2, 1),
+        optimizer_fn=lambda p: torch.optim.SGD(p, lr=0.1),
+        feature_cols=["a", "b"], label_col="y", epochs=5, batch_size=8,
+        callbacks=[rec], validation=0.25)
+    model = est._fit_arrays(X, y)
+    assert all("val_loss" in l for l in rec.logs), rec.logs
+    assert rec.logs[-1]["val_loss"] < rec.logs[0]["val_loss"]
+    preds = model._predict_arrays(X)
+    assert np.mean((preds - y) ** 2) < 0.2
+    # split invariants: deterministic, disjoint, complete
+    t1, v1 = _train_val_split(80, 0.25)
+    t2, v2 = _train_val_split(80, 0.25)
+    np.testing.assert_array_equal(t1, t2)
+    np.testing.assert_array_equal(v1, v2)
+    assert len(v1) == 20 and len(t1) == 60
+    assert not set(t1) & set(v1)
+    with pytest.raises(ValueError, match="validation"):
+        _train_val_split(10, 1.5)
+    with pytest.raises(ValueError, match="validation"):
+        _train_val_split(10, -0.25)
+
+
+def test_keras_estimator_validation(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    import numpy as np
+
+    from horovod_tpu.spark import KerasEstimator
+
+    class FullRecorder:
+        def __init__(self):
+            self.logs = []
+
+        def on_epoch_end(self, epoch, logs):
+            self.logs.append(dict(logs))
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(64, 2).astype(np.float32)
+    y = (X @ np.asarray([2.0, 1.0], np.float32))
+    rec = FullRecorder()
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1, use_bias=False)])
+    est = KerasEstimator(model=model, feature_cols=["a", "b"],
+                         label_col="y",
+                         optimizer=tf.keras.optimizers.SGD(0.1),
+                         epochs=4, batch_size=8, callbacks=[rec],
+                         validation=0.25)
+    est._fit_arrays(X, y)
+    assert all("val_loss" in l for l in rec.logs), rec.logs
+    assert rec.logs[-1]["val_loss"] < rec.logs[0]["val_loss"]
