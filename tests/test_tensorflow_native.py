@@ -173,3 +173,27 @@ def test_native_graph_backward_passes_per_step():
         exp0 = -2.0 * np.mean([i + 1 for i in range(n)])
         np.testing.assert_allclose(v.numpy(), [exp0, -2.0], rtol=1e-6)
     """)
+
+
+def test_native_process_set_allreduce_4proc():
+    # subset collective over the native op path: members reduce among
+    # themselves; non-members run a disjoint set concurrently
+    run_tf_workers("""
+        from horovod_tpu.common.process_sets import ProcessSet
+        even = ProcessSet([0, 2])
+        odd = ProcessSet([1, 3])
+        mine = even if r % 2 == 0 else odd
+        x = tf.fill([3], float(r + 1))
+        res = hvd.allreduce(x, name="ps.even" if r % 2 == 0 else "ps.odd",
+                            average=False, process_set=mine)
+        expected = sum(i + 1 for i in mine.ranks)
+        np.testing.assert_allclose(res.numpy(), float(expected))
+
+        # unnamed eager subset collectives raise with guidance
+        try:
+            hvd.allreduce(x, process_set=mine)
+        except ValueError as e:
+            assert "name" in str(e), e
+        else:
+            raise AssertionError("unnamed process-set allreduce passed")
+    """, np=4)
