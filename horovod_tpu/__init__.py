@@ -49,6 +49,8 @@ from horovod_tpu.common.basics import (
     cuda_built,
     rocm_built,
     ccl_built,
+    ddl_built,
+    xla_built,
     mpi_threads_supported,
 )
 from horovod_tpu.common.exceptions import (
@@ -121,7 +123,8 @@ __all__ = [
     "process_rank", "process_size", "is_homogeneous",
     # build info (TPU build: these document what the backend is)
     "nccl_built", "mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled",
-    "cuda_built", "rocm_built", "ccl_built", "mpi_threads_supported",
+    "cuda_built", "rocm_built", "ccl_built", "ddl_built", "xla_built",
+    "mpi_threads_supported",
     # process sets
     "ProcessSet", "global_process_set", "add_process_set", "remove_process_set",
     "process_set_included_ranks",
