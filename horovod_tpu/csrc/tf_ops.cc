@@ -48,7 +48,7 @@ namespace hvt_tf {
 using namespace tensorflow;  // NOLINT
 
 enum WireOp { OP_ALLREDUCE = 0, OP_ALLGATHER = 1, OP_BROADCAST = 2,
-              OP_ALLTOALL = 3 };
+              OP_ALLTOALL = 3, OP_REDUCESCATTER = 4 };
 
 static int WireDType(DataType dt) {
   switch (dt) {
@@ -346,6 +346,48 @@ class HvtAlltoallOp : public HvtAsyncOpBase {
   }
 };
 
+class HvtReducescatterOp : public HvtAsyncOpBase {
+ public:
+  explicit HvtReducescatterOp(OpKernelConstruction* ctx)
+      : HvtAsyncOpBase(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("reduce_op", &reduce_op_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    const Tensor& input = ctx->input(0);
+    OP_REQUIRES_ASYNC(ctx, input.dims() >= 1,
+                      errors::InvalidArgument("reducescatter needs rank>=1"),
+                      done);
+    SubmitArgs a;
+    a.name = Key(ctx);
+    a.op = OP_REDUCESCATTER;
+    a.reduce = reduce_op_;
+    a.members = members_;
+    TensorShape shape = input.shape();
+    // output row count is statically input rows / participant count
+    // (the engine validates divisibility) — byte-based inference would
+    // collapse zero-width inputs to zero rows
+    int64_t m = members_.empty()
+                    ? (hvt_initialized() ? hvt_size() : 1)
+                    : static_cast<int64_t>(members_.size());
+    if (m <= 0) m = 1;
+    SubmitAndDefer(ctx, done, input, a, [ctx, shape, m](int handle)
+                                            -> Status {
+      TensorShape out_shape = shape;
+      out_shape.set_dim(0, shape.dim_size(0) / m);
+      Tensor* out = nullptr;
+      TF_RETURN_IF_ERROR(ctx->allocate_output(0, out_shape, &out));
+      auto dst = out->tensor_data();
+      hvt_result_read(handle, const_cast<char*>(dst.data()),
+                      static_cast<long long>(dst.size()));
+      return Status();
+    });
+  }
+
+ private:
+  int reduce_op_ = 0;
+};
+
 // Scalar topology ops — graph-time *dynamic* values so elastic jobs pick
 // up rescaled worlds without retracing (reference mpi_ops.cc:758-856).
 // Stateful so constant folding cannot freeze them into the graph.
@@ -423,6 +465,21 @@ REGISTER_OP("HvtAlltoall")
       return Status();
     });
 
+REGISTER_OP("HvtReducescatter")
+    .Attr("T: " HVT_DTYPES)
+    .Attr("tensor_name: string = ''")
+    .Attr("reduce_op: int = 0")  // wire ReduceKind; 0 = SUM
+    .Attr("process_set_ranks: list(int) = []")
+    .Input("tensor: T")
+    .Output("output: T")
+    .SetShapeFn([](shape_inference::InferenceContext* c) {
+      shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(c->input(0), 0, c->UnknownDim(),
+                                       &out));
+      c->set_output(0, out);
+      return Status();
+    });
+
 REGISTER_OP("HvtSize").Output("size: int32").SetIsStateful().SetShapeFn(
     shape_inference::ScalarShape);
 REGISTER_OP("HvtRank").Output("rank: int32").SetIsStateful().SetShapeFn(
@@ -437,6 +494,8 @@ REGISTER_KERNEL_BUILDER(Name("HvtBroadcast").Device(DEVICE_CPU),
 REGISTER_KERNEL_BUILDER(
     Name("HvtAlltoall").Device(DEVICE_CPU).HostMemory("splits"),
     HvtAlltoallOp);
+REGISTER_KERNEL_BUILDER(Name("HvtReducescatter").Device(DEVICE_CPU),
+                        HvtReducescatterOp);
 REGISTER_KERNEL_BUILDER(Name("HvtSize").Device(DEVICE_CPU),
                         HvtScalarOp<SizeOrOne>);
 REGISTER_KERNEL_BUILDER(Name("HvtRank").Device(DEVICE_CPU),

@@ -62,6 +62,21 @@ def _require_tf():
             "collectives); horovod_tpu.torch provides the eager path.")
 
 
+def _wire_reduce_op(op, nat, allow_adasum=False):
+    """Map a ReduceOp constant to the native wire id, with a clear error
+    for unsupported combinations."""
+    from horovod_tpu.ops import collective_ops as C
+
+    table = {C.Sum: nat.SUM, C.Average: nat.AVERAGE, C.Min: nat.MIN,
+             C.Max: nat.MAX, C.Product: nat.PRODUCT}
+    if allow_adasum:
+        table[C.Adasum] = nat.ADASUM
+    try:
+        return table[op]
+    except KeyError:
+        raise ValueError(f"{op!r} is not supported for this collective")
+
+
 def _native():
     """The native custom-op module when usable (library built AND the
     multi-process engine is up), else None → numpy-bridge fallback."""
@@ -154,6 +169,26 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         process_set=process_set or C.global_process_set)
     return (_tf.convert_to_tensor(np.asarray(out)),
             _tf.convert_to_tensor(np.asarray(recv, np.int32)))
+
+
+def reducescatter(tensor, name=None, op=None, process_set=None):
+    """Reduce across workers, each keeping its dim-0 shard (dim 0 must
+    divide the participant count)."""
+    _require_tf()
+    from horovod_tpu.ops import collective_ops as C
+
+    op = op or C.Sum
+    nat = _native()
+    if nat is not None:
+        return nat.reducescatter(_tf.convert_to_tensor(tensor), name=name,
+                                 op=_wire_reduce_op(op, nat),
+                                 process_set=process_set)
+    import numpy as np
+
+    out = C.reducescatter(np.asarray(tensor), op=op,
+                          name=name or "tf.reducescatter",
+                          process_set=process_set or C.global_process_set)
+    return _tf.convert_to_tensor(np.asarray(out))
 
 
 def size_op():
@@ -260,10 +295,7 @@ def _allreduce_grads(grads, op=None, compression=Compression.none,
             fp16 = compression is Compression.fp16 and \
                 gt.dtype in (_tf.float32, _tf.float64)
             wire = _tf.cast(gt, _tf.float16) if fp16 else gt
-            wire_op = {C.Sum: nat.SUM, C.Average: nat.AVERAGE,
-                       C.Min: nat.MIN, C.Max: nat.MAX,
-                       C.Product: nat.PRODUCT,
-                       C.Adasum: nat.ADASUM}[op]
+            wire_op = _wire_reduce_op(op, nat, allow_adasum=True)
             red = nat.allreduce(
                 wire, name=names[i] if names else f"{name_prefix}.{i}",
                 op=wire_op,

@@ -155,6 +155,15 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
                             process_set_ranks=_members(process_set, name))
 
 
+def reducescatter(tensor, name=None, op=SUM, process_set=None):
+    """In-graph reduce-scatter: reduce across members, each keeps its
+    dim-0 shard (dim 0 must divide the participant count)."""
+    mod = _load()
+    return mod.hvt_reducescatter(
+        tensor, tensor_name=_auto_name("reducescatter", name),
+        reduce_op=op, process_set_ranks=_members(process_set, name))
+
+
 def size_op():
     """Graph-time dynamic world size (reference mpi_ops.cc:758 — lets
     elastic jobs see rescaled worlds without retracing)."""
@@ -198,6 +207,27 @@ def _register_gradients():
             reduce_op=SUM, process_set_ranks=members)
         r = mod.hvt_rank()
         return tf.where(tf.equal(r, root), summed, tf.zeros_like(summed))
+
+    @tf_ops.RegisterGradient("HvtReducescatter")
+    def _reducescatter_grad(op, grad):
+        # grad of reduce-scatter(SUM) = allgather of the shard gradients;
+        # AVERAGE forward divided by the participant count, so the
+        # backward scales the same way (torch binding does likewise)
+        reduce_op = op.get_attr("reduce_op")
+        if reduce_op not in (SUM, AVERAGE):
+            raise NotImplementedError(
+                "gradients of min/max/product reducescatter are not "
+                "defined; use SUM or AVERAGE")
+        members = list(op.get_attr("process_set_ranks"))
+        mod = _load()
+        gathered = mod.hvt_allgather(
+            grad, tensor_name=_grad_name(op, "grad"),
+            process_set_ranks=members)
+        if reduce_op == AVERAGE:
+            m = (tf.constant(float(len(members)))
+                 if members else tf.cast(mod.hvt_size(), grad.dtype))
+            gathered = gathered / tf.cast(m, gathered.dtype)
+        return gathered
 
     @tf_ops.RegisterGradient("HvtAllgather")
     def _allgather_grad(op, grad):

@@ -197,3 +197,39 @@ def test_native_process_set_allreduce_4proc():
         else:
             raise AssertionError("unnamed process-set allreduce passed")
     """, np=4)
+
+
+def test_native_reducescatter_2proc():
+    run_tf_workers("""
+        # 4 rows, 2 ranks: each keeps 2 reduced rows
+        x = tf.reshape(tf.range(8, dtype=tf.float32), [4, 2]) + float(r)
+        out = hvd.reducescatter(x, name="rs")
+        full = sum(np.arange(8, dtype=np.float32).reshape(4, 2) + i
+                   for i in range(n))
+        np.testing.assert_allclose(out.numpy(), full[r * 2:(r + 1) * 2])
+
+        # in-graph with gradient: grad of reduce-scatter = allgather
+        v = tf.Variable(tf.ones([4, 2]) * (r + 1.0))
+
+        @tf.function
+        def step():
+            with tf.GradientTape() as tape:
+                y = hvd.reducescatter(v, name="rs.g")
+                loss = tf.reduce_sum(y) * (r + 1.0)
+            return tape.gradient(loss, v)
+
+        g = step()
+        # each rank's shard contributes its owner's upstream factor
+        expect = np.concatenate([np.full((2, 2), float(i + 1))
+                                 for i in range(n)])
+        np.testing.assert_allclose(g.numpy(), expect)
+
+        # AVERAGE: forward divides by n, so must the gradient
+        from horovod_tpu.ops import collective_ops as C
+        w = tf.Variable(tf.ones([4, 2]))
+        with tf.GradientTape() as tape:
+            y = hvd.reducescatter(w, name="rs.avg", op=C.Average)
+            loss = tf.reduce_sum(y)
+        ga = tape.gradient(loss, w)
+        np.testing.assert_allclose(ga.numpy(), 1.0 / n)
+    """)
