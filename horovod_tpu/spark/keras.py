@@ -1,0 +1,6 @@
+"""Reference import-path alias: ``horovod.spark.keras`` →
+``horovod_tpu.spark.keras`` (reference ``spark/keras/estimator.py:106``).
+The implementation lives in :mod:`horovod_tpu.spark.estimator`."""
+
+from horovod_tpu.spark.estimator import (KerasEstimator,  # noqa: F401
+                                         KerasModel)

@@ -1,0 +1,6 @@
+"""Reference import-path alias: ``horovod.spark.torch`` →
+``horovod_tpu.spark.torch`` (reference ``spark/torch/estimator.py:91``).
+The implementation lives in :mod:`horovod_tpu.spark.estimator`."""
+
+from horovod_tpu.spark.estimator import (TorchEstimator,  # noqa: F401
+                                         TorchModel)

@@ -1,0 +1,14 @@
+"""Reference import-path alias: ``horovod.tensorflow.keras`` mirrors
+``horovod.keras`` for tf.keras users (reference ``tensorflow/keras/``);
+here both resolve to :mod:`horovod_tpu.keras`."""
+
+from horovod_tpu.keras import *  # noqa: F401,F403
+from horovod_tpu.keras import (BroadcastGlobalVariablesCallback,  # noqa: F401
+                               CommitStateCallback, DistributedOptimizer,
+                               LearningRateScheduleCallback,
+                               LearningRateWarmupCallback,
+                               MetricAverageCallback,
+                               UpdateBatchStateCallback, allgather,
+                               allreduce, broadcast,
+                               broadcast_global_variables, init, load_model,
+                               local_rank, rank, shutdown, size)
