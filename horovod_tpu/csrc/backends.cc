@@ -1,8 +1,19 @@
 #include "backends.h"
 
+#include <fcntl.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <cstring>
 #include <map>
 #include <stdexcept>
+
+#include "logging.h"
 
 namespace hvt {
 
@@ -72,6 +83,137 @@ void RingBackend::Alltoallv(const void* in,
                             int64_t row_bytes, void* out,
                             const std::vector<int64_t>& recv_rows) {
   dp_->Alltoallv(in, send_rows, row_bytes, out, recv_rows);
+}
+
+// ---------------------------------------------------------------- shm
+
+namespace {
+// segment header, one cache line: sense-reversing barrier state
+struct ShmHeader {
+  std::atomic<uint32_t> arrive;
+  std::atomic<uint32_t> gen;
+  uint8_t pad[56];
+};
+constexpr size_t kShmHeader = sizeof(ShmHeader);
+}  // namespace
+
+ShmLocalBackend::ShmLocalBackend(DataPlane* dp, int rank, int size,
+                                 int shm_key, int64_t capacity,
+                                 bool enabled)
+    : rank_(rank), size_(size), capacity_(capacity) {
+  // deterministic across ranks (env + topology), so every rank takes the
+  // same branch here and the data-plane syncs below stay in lockstep
+  if (!enabled || size < 2) return;
+  char name[64];
+  snprintf(name, sizeof(name), "/hvt_shm_%d", shm_key);
+  map_bytes_ = kShmHeader + static_cast<size_t>(capacity_) * (size_ + 1);
+  try {
+    int fd = -1;
+    uint8_t sync = 0;
+    if (rank_ == 0) {
+      shm_unlink(name);  // stale segment from a crashed earlier job
+      fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+      if (fd >= 0 && ftruncate(fd, static_cast<off_t>(map_bytes_)) != 0) {
+        close(fd);
+        fd = -1;
+      }
+      dp->Broadcast(&sync, 1, 0);  // segment exists before peers open
+    } else {
+      dp->Broadcast(&sync, 1, 0);
+      fd = shm_open(name, O_RDWR, 0600);
+    }
+    void* p = MAP_FAILED;
+    if (fd >= 0) {
+      p = mmap(nullptr, map_bytes_, PROT_READ | PROT_WRITE, MAP_SHARED,
+               fd, 0);
+      close(fd);
+    }
+    // consensus: the backend is on only if EVERY rank mapped — a split
+    // decision would deadlock (some ranks in the shm barrier, others in
+    // the ring). Runs on all ranks unconditionally.
+    int32_t ok = p != MAP_FAILED ? 1 : 0;
+    dp->Allreduce(&ok, 1, DataType::INT32, ReduceKind::MIN);
+    if (rank_ == 0) shm_unlink(name);  // everyone open or given up
+    if (p != MAP_FAILED && !ok) {
+      munmap(p, map_bytes_);
+      p = MAP_FAILED;
+    }
+    if (p == MAP_FAILED) return;
+    base_ = static_cast<uint8_t*>(p);
+    enabled_ = true;
+    HVT_LOG(DEBUG, rank_) << "shm local data plane up (" << size_
+                          << " ranks, " << (capacity_ >> 20)
+                          << " MB slots)";
+  } catch (const std::exception&) {
+    // data-plane sync failed — leave disabled; the ring still works
+  }
+}
+
+ShmLocalBackend::~ShmLocalBackend() {
+  if (base_) munmap(base_, map_bytes_);
+}
+
+uint8_t* ShmLocalBackend::result() const { return base_ + kShmHeader; }
+
+uint8_t* ShmLocalBackend::slot(int r) const {
+  return base_ + kShmHeader + static_cast<size_t>(capacity_) * (1 + r);
+}
+
+void ShmLocalBackend::Barrier() {
+  auto* h = reinterpret_cast<ShmHeader*>(base_);
+  uint32_t g = h->gen.load(std::memory_order_acquire);
+  if (h->arrive.fetch_add(1, std::memory_order_acq_rel) + 1 ==
+      static_cast<uint32_t>(size_)) {
+    h->arrive.store(0, std::memory_order_relaxed);
+    h->gen.fetch_add(1, std::memory_order_acq_rel);
+  } else {
+    // brief spin for the common in-step case, then sleep-wait: ranks
+    // skewed by compute must not burn a core the computing rank needs
+    // (TCP recv would have slept in the kernel)
+    int spins = 0;
+    struct timespec nap = {0, 50'000};  // 50 µs
+    while (h->gen.load(std::memory_order_acquire) == g) {
+      if (++spins < 512)
+        sched_yield();
+      else
+        nanosleep(&nap, nullptr);
+    }
+  }
+}
+
+bool ShmLocalBackend::Enabled(const Response& resp,
+                              int64_t total_elems) const {
+  return enabled_ && resp.op == OpType::ALLREDUCE &&
+         resp.kind == Response::Kind::TENSOR &&
+         resp.reduce != ReduceKind::ADASUM && total_elems > 0 &&
+         total_elems * static_cast<int64_t>(DataTypeSize(resp.dtype)) <=
+             capacity_;
+}
+
+void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
+                                ReduceKind red) {
+  if (!used_logged_) {
+    used_logged_ = true;
+    HVT_LOG(DEBUG, rank_) << "shm allreduce engaged (" << count
+                          << " elems)";
+  }
+  const size_t el = DataTypeSize(dtype);
+  const size_t bytes = static_cast<size_t>(count) * el;
+  memcpy(slot(rank_), buf, bytes);
+  Barrier();  // all contributions visible
+  // parallel reduce-scatter in memory: rank i combines chunk i of every
+  // slot into the shared result area
+  int64_t lo = count * rank_ / size_;
+  int64_t hi = count * (rank_ + 1) / size_;
+  if (hi > lo) {
+    uint8_t* dst = result() + lo * el;
+    memcpy(dst, slot(0) + lo * el, static_cast<size_t>(hi - lo) * el);
+    for (int r = 1; r < size_; ++r)
+      ReduceInto(dst, slot(r) + lo * el, hi - lo, dtype, red);
+  }
+  Barrier();  // result complete
+  memcpy(buf, result(), bytes);
+  Barrier();  // everyone has read; slots/result reusable next op
 }
 
 bool HierarchicalBackend::Enabled(const Response& resp,

@@ -76,6 +76,42 @@ class RingBackend : public CollectiveBackend {
   DataPlane* dp_;
 };
 
+// Same-host POSIX-shared-memory data plane for single-host jobs: every
+// rank copies its contribution into a per-rank slot of one shm segment,
+// a sense-reversing barrier synchronizes, each rank reduces a contiguous
+// chunk across all slots (parallel reduce-scatter in memory), and all
+// ranks copy the combined result out — no sockets at all on the hot
+// path, where the flat ring pays 2(N-1)/N of the payload through
+// loopback TCP. Enabled for non-Adasum allreduces that fit the
+// preallocated capacity when every rank shares one host;
+// HVT_SHM_ALLREDUCE=0 disables. The segment name is derived from the
+// control-star port and unlinked as soon as every rank has mapped it,
+// so crashed jobs never leak segments.
+class ShmLocalBackend : public CollectiveBackend {
+ public:
+  // dp: used once at construction to sequence create-before-open across
+  // ranks (tiny ring broadcasts); not used on the hot path.
+  ShmLocalBackend(DataPlane* dp, int rank, int size, int shm_key,
+                  int64_t capacity, bool enabled);
+  ~ShmLocalBackend() override;
+  const char* Name() const override { return "shm"; }
+  bool Enabled(const Response& resp, int64_t total_elems) const override;
+  void Allreduce(void* buf, int64_t count, DataType dtype,
+                 ReduceKind red) override;
+
+ private:
+  void Barrier();
+  uint8_t* slot(int r) const;
+  uint8_t* result() const;
+
+  int rank_ = 0, size_ = 1;
+  int64_t capacity_ = 0;
+  bool enabled_ = false;
+  bool used_logged_ = false;
+  uint8_t* base_ = nullptr;
+  size_t map_bytes_ = 0;
+};
+
 // Local reduce-scatter → cross-host allreduce → local allgather.
 // Enabled for non-Adasum allreduces on a homogeneous multi-host topology
 // with >1 rank per host; HVT_HIERARCHICAL_ALLREDUCE=0 disables.

@@ -113,7 +113,16 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   bool hier_ok = topo_.homogeneous && topo_.n_hosts > 1 &&
                  topo_.local_group.size() > 1;
   bool hier_on = hier_ok && EnvInt("HVT_HIERARCHICAL_ALLREDUCE", 1) != 0;
+  // shm data plane: only when every rank shares this host (autotuning
+  // can grow the fusion threshold, so give the slots headroom over it)
+  bool shm_on = topo_.n_hosts == 1 && size_ > 1 &&
+                EnvInt("HVT_SHM_ALLREDUCE", 1) != 0;
+  int64_t shm_cap =
+      std::max<int64_t>(fusion_threshold_ * 2, int64_t{64} << 20);
+  shm_cap = (shm_cap + 63) & ~int64_t{63};  // keep every slot 64B-aligned
   backends_.clear();
+  backends_.push_back(std::make_unique<ShmLocalBackend>(
+      data_.get(), rank_, size_, master_port, shm_cap, shm_on));
   backends_.push_back(std::make_unique<HierarchicalBackend>(
       data_.get(), topo_, hier_on));
   backends_.push_back(std::make_unique<RingBackend>(data_.get()));

@@ -3,12 +3,3 @@
 here both resolve to :mod:`horovod_tpu.keras`."""
 
 from horovod_tpu.keras import *  # noqa: F401,F403
-from horovod_tpu.keras import (BroadcastGlobalVariablesCallback,  # noqa: F401
-                               CommitStateCallback, DistributedOptimizer,
-                               LearningRateScheduleCallback,
-                               LearningRateWarmupCallback,
-                               MetricAverageCallback,
-                               UpdateBatchStateCallback, allgather,
-                               allreduce, broadcast,
-                               broadcast_global_variables, init, load_model,
-                               local_rank, rank, shutdown, size)

@@ -629,3 +629,54 @@ def test_stall_inspector_warns_then_recovers_2proc():
     """, launcher_args=("--stall-warning-sec", "1"))
     assert "laggy" in out and "possible stall" in out, out[-2000:]
     assert "not by ranks [ 1 ]" in out, out[-2000:]
+
+
+def test_shm_allreduce_single_host_2proc():
+    """Single-host jobs pick the shared-memory data plane for allreduce
+    (backend priority list: shm → hierarchical → ring); results match the
+    ring exactly across dtypes."""
+    out = run_workers("""
+        for dt in (np.float32, np.float64, np.int32, np.float16):
+            x = (np.arange(7) * (r + 1)).astype(dt)
+            res = np.asarray(hvt.allreduce(x, op=hvt.Sum,
+                                           name=f"shm.{dt.__name__}"))
+            expected = sum((np.arange(7) * (i + 1)).astype(dt)
+                           for i in range(n))
+            np.testing.assert_allclose(res.astype(np.float64),
+                                       expected.astype(np.float64))
+        # average path (postscale applied after the backend)
+        a = np.asarray(hvt.allreduce(np.full(5, float(r + 1), np.float32),
+                                     name="shm.avg"))
+        np.testing.assert_allclose(a, (1 + n) / 2.0)
+    """, extra_env={"HVT_LOG_LEVEL": "debug"})
+    assert "shm local data plane up" in out, out[-2000:]
+    assert "shm allreduce engaged" in out, out[-2000:]
+
+
+def test_shm_disabled_falls_back_to_ring_2proc():
+    out = run_workers("""
+        res = np.asarray(hvt.allreduce(np.full(4, float(r + 1),
+                                               np.float32), name="noshm"))
+        np.testing.assert_allclose(res, (1 + n) / 2.0)
+    """, extra_env={"HVT_LOG_LEVEL": "debug", "HVT_SHM_ALLREDUCE": "0"})
+    assert "shm local data plane up" not in out, out[-2000:]
+
+
+def test_shm_allreduce_4proc_grouped_and_large():
+    """4 ranks through the shm plane: grouped fusion + a payload big
+    enough to span chunk boundaries."""
+    run_workers("""
+        big = (np.arange(100003) % 97).astype(np.float32) + r
+        res = np.asarray(hvt.allreduce(big, op=hvt.Sum, name="shm.big"))
+        expected = sum((np.arange(100003) % 97).astype(np.float32) + i
+                       for i in range(n))
+        np.testing.assert_allclose(res, expected)
+        outs = hvt.grouped_allreduce(
+            [np.full(3, float(r), np.float32),
+             np.full(2, float(10 * r), np.float32)], op=hvt.Sum,
+            name="shm.grp")
+        np.testing.assert_allclose(np.asarray(outs[0]),
+                                   sum(range(n)))
+        np.testing.assert_allclose(np.asarray(outs[1]),
+                                   10.0 * sum(range(n)))
+    """, np=4)
