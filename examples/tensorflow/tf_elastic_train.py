@@ -1,0 +1,66 @@
+"""Elastic TF/Keras training with TensorFlowKerasState — the reference's
+``examples/elastic/tensorflow2_mnist_elastic.py`` pattern.
+
+Launch with an elastic world; the job survives worker loss (rollback to
+the last commit) and absorbs added hosts (re-rendezvous at commit
+points):
+
+    hvtrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh \
+        python examples/tensorflow/tf_elastic_train.py
+"""
+
+import numpy as np
+
+import horovod_tpu as hvt
+import horovod_tpu.tensorflow as hvt_tf
+import horovod_tpu.tensorflow.elastic as tfe
+
+
+def main():
+    import tensorflow as tf
+
+    hvt.init()
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(32, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    model(tf.zeros([1, 20]))
+    opt = tf.keras.optimizers.SGD(0.05)
+    opt.build(model.trainable_variables)
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    state = tfe.TensorFlowKerasState(model, opt, epoch=0, batch=0)
+
+    @tfe.run
+    def train(state):
+        rs = np.random.RandomState(1234)
+        data = rs.randn(512, 20).astype(np.float32)
+        labels = rs.randint(0, 10, (512,))
+        n_batches = 16
+        while state.epoch < 5:
+            for b in range(state.batch, n_batches):
+                lo = b * 32
+                x = tf.constant(data[lo:lo + 32])
+                y = tf.constant(labels[lo:lo + 32])
+                with hvt_tf.DistributedGradientTape(
+                        tf.GradientTape()) as tape:
+                    loss = loss_fn(y, model(x, training=True))
+                grads = tape.gradient(loss, model.trainable_variables)
+                opt.apply_gradients(
+                    zip(grads, model.trainable_variables))
+                state.batch = b + 1
+                state.commit()      # snapshot + host-update check
+            if hvt.rank() == 0:
+                print(f"epoch {state.epoch}  loss {float(loss):.4f}  "
+                      f"world {hvt.size()}", flush=True)
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()
