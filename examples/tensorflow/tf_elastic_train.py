@@ -40,6 +40,7 @@ def main():
         labels = rs.randint(0, 10, (512,))
         n_batches = 16
         while state.epoch < 5:
+            loss = None   # a rollback can resume at the epoch boundary
             for b in range(state.batch, n_batches):
                 lo = b * 32
                 x = tf.constant(data[lo:lo + 32])
@@ -52,7 +53,7 @@ def main():
                     zip(grads, model.trainable_variables))
                 state.batch = b + 1
                 state.commit()      # snapshot + host-update check
-            if hvt.rank() == 0:
+            if hvt.rank() == 0 and loss is not None:
                 print(f"epoch {state.epoch}  loss {float(loss):.4f}  "
                       f"world {hvt.size()}", flush=True)
             state.epoch += 1

@@ -183,11 +183,14 @@ void ShmLocalBackend::Barrier() {
 
 bool ShmLocalBackend::Enabled(const Response& resp,
                               int64_t total_elems) const {
-  return enabled_ && resp.op == OpType::ALLREDUCE &&
-         resp.kind == Response::Kind::TENSOR &&
-         resp.reduce != ReduceKind::ADASUM && total_elems > 0 &&
-         total_elems * static_cast<int64_t>(DataTypeSize(resp.dtype)) <=
-             capacity_;
+  if (!enabled_ || resp.kind != Response::Kind::TENSOR ||
+      total_elems <= 0 ||
+      total_elems * static_cast<int64_t>(DataTypeSize(resp.dtype)) >
+          capacity_)
+    return false;
+  if (resp.op == OpType::ALLREDUCE)
+    return resp.reduce != ReduceKind::ADASUM;
+  return resp.op == OpType::BROADCAST;
 }
 
 void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
@@ -214,6 +217,22 @@ void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
   Barrier();  // result complete
   memcpy(buf, result(), bytes);
   Barrier();  // everyone has read; slots/result reusable next op
+}
+
+void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {
+  if (!bcast_logged_) {
+    bcast_logged_ = true;
+    HVT_LOG(DEBUG, rank_) << "shm broadcast engaged (" << bytes
+                          << " bytes)";
+  }
+  // write-once-read-many: root publishes into the shared result area.
+  // Result writes are always preceded by a barrier that confirmed the
+  // previous op's readers are done (this op's trailing barrier plays
+  // that role for the next one).
+  if (rank_ == root) memcpy(result(), buf, static_cast<size_t>(bytes));
+  Barrier();
+  if (rank_ != root) memcpy(buf, result(), static_cast<size_t>(bytes));
+  Barrier();
 }
 
 bool HierarchicalBackend::Enabled(const Response& resp,

@@ -82,9 +82,10 @@ class RingBackend : public CollectiveBackend {
 // chunk across all slots (parallel reduce-scatter in memory), and all
 // ranks copy the combined result out — no sockets at all on the hot
 // path, where the flat ring pays 2(N-1)/N of the payload through
-// loopback TCP. Enabled for non-Adasum allreduces that fit the
-// preallocated capacity when every rank shares one host;
-// HVT_SHM_ALLREDUCE=0 disables. The segment name is derived from the
+// loopback TCP. Enabled for non-Adasum allreduces AND full-world
+// broadcasts (write-once-read-many) that fit the preallocated capacity
+// when every rank shares one host; HVT_SHM_ALLREDUCE=0 disables the
+// whole shm plane. The segment name is derived from the
 // control-star port and unlinked as soon as every rank has mapped it,
 // so crashed jobs never leak segments.
 class ShmLocalBackend : public CollectiveBackend {
@@ -98,6 +99,7 @@ class ShmLocalBackend : public CollectiveBackend {
   bool Enabled(const Response& resp, int64_t total_elems) const override;
   void Allreduce(void* buf, int64_t count, DataType dtype,
                  ReduceKind red) override;
+  void Broadcast(void* buf, int64_t bytes, int root) override;
 
  private:
   void Barrier();
@@ -108,6 +110,7 @@ class ShmLocalBackend : public CollectiveBackend {
   int64_t capacity_ = 0;
   bool enabled_ = false;
   bool used_logged_ = false;
+  bool bcast_logged_ = false;
   uint8_t* base_ = nullptr;
   size_t map_bytes_ = 0;
 };

@@ -1215,8 +1215,15 @@ void Engine::ExecuteResponse(const Response& resp,
       size_t bytes = static_cast<size_t>(resp.numels[0]) * el;
       std::vector<uint8_t> buf(bytes, 0);
       if (e) memcpy(buf.data(), e->input.data(), bytes);
-      data_->BroadcastGroup(buf.data(), static_cast<int64_t>(bytes),
-                            resp.root, grp);
+      if (resp.members.empty())
+        // full world: backend list applies (shm write-once-read-many
+        // beats the TCP star for model-sized payloads)
+        PickBackend(resp, resp.numels[0])
+            ->Broadcast(buf.data(), static_cast<int64_t>(bytes),
+                        resp.root);
+      else
+        data_->BroadcastGroup(buf.data(), static_cast<int64_t>(bytes),
+                              resp.root, grp);
       if (e) {
         e->output = std::move(buf);
         CompleteEntry(e, Status::OK());

@@ -648,9 +648,20 @@ def test_shm_allreduce_single_host_2proc():
         a = np.asarray(hvt.allreduce(np.full(5, float(r + 1), np.float32),
                                      name="shm.avg"))
         np.testing.assert_allclose(a, (1 + n) / 2.0)
+        # full-world broadcast rides the shm plane too (root publishes
+        # once; non-members path still uses the ring)
+        b = np.asarray(hvt.broadcast(np.full(6, float(r * 7 + 3),
+                                             np.float32),
+                                     root_rank=1, name="shm.bc"))
+        np.testing.assert_allclose(b, 10.0)
+        big = np.arange(1 << 20, dtype=np.float32) * (r + 1)
+        bb = np.asarray(hvt.broadcast(big, root_rank=0, name="shm.bcbig"))
+        np.testing.assert_allclose(bb, np.arange(1 << 20,
+                                                 dtype=np.float32))
     """, extra_env={"HVT_LOG_LEVEL": "debug"})
     assert "shm local data plane up" in out, out[-2000:]
     assert "shm allreduce engaged" in out, out[-2000:]
+    assert "shm broadcast engaged" in out, out[-2000:]
 
 
 def test_shm_disabled_falls_back_to_ring_2proc():
