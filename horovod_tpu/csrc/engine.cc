@@ -445,6 +445,8 @@ std::vector<Response> Engine::Coordinate(
     uint8_t flags = rd.u8();
     rank_shutdown_[r] = rank_shutdown_[r] || (flags & 1);
     bool joined = (flags & 2) != 0;
+    if (joined && !rank_joined_[r])
+      last_join_rank_ = r;  // join order is observed here, cycle by cycle
     rank_joined_[r] = joined;
     auto hits = rd.i64vec();
     auto invalids = rd.i64vec();
@@ -626,7 +628,10 @@ std::vector<Response> Engine::Coordinate(
       Response j;
       j.kind = Response::Kind::JOIN;
       j.names = {"<join>"};
-      j.root = size_ - 1;  // deterministic last-joiner id
+      // the actual last rank to join (reference Join semantics: callers
+      // broadcast final state from it); several ranks joining within one
+      // cycle tie-break by rank order deterministically
+      j.root = last_join_rank_ >= 0 ? last_join_rank_ : size_ - 1;
       out.push_back(j);
     }
   }

@@ -191,6 +191,16 @@ def reducescatter(tensor, name=None, op=None, process_set=None):
     return _tf.convert_to_tensor(np.asarray(out))
 
 
+def join(device=None) -> int:
+    """Signal exhausted data; pending collectives proceed with zero
+    stand-ins from joined ranks (reference ``tensorflow/mpi_ops.cc:723``
+    HorovodJoinOp). Returns the last rank to join."""
+    _require_tf()
+    from horovod_tpu.ops import collective_ops as C
+
+    return C.join(device)
+
+
 def size_op():
     """Graph-time dynamic world size (reference ``mpi_ops.cc:758`` — the
     elastic-aware alternative to baking ``size()`` into the graph)."""

@@ -233,3 +233,19 @@ def test_native_reducescatter_2proc():
         ga = tape.gradient(loss, w)
         np.testing.assert_allclose(ga.numpy(), 1.0 / n)
     """)
+
+
+def test_tf_join_uneven_steps_2proc():
+    # reference HorovodJoinOp semantics: rank 1 joins early; rank 0's
+    # later collectives proceed with zero stand-ins
+    run_tf_workers("""
+        steps = 3 if r == 0 else 1
+        for i in range(steps):
+            res = hvd.allreduce(tf.ones([2]), name=f"j{i}", average=False)
+            if i < 1:
+                np.testing.assert_allclose(res.numpy(), float(n))
+            else:
+                np.testing.assert_allclose(res.numpy(), 1.0)
+        last = hvd.join()
+        assert last == 0, last  # rank 0 ran more steps → joined last
+    """)
