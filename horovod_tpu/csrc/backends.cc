@@ -183,11 +183,19 @@ void ShmLocalBackend::Barrier() {
 
 bool ShmLocalBackend::Enabled(const Response& resp,
                               int64_t total_elems) const {
-  if (!enabled_ || resp.kind != Response::Kind::TENSOR ||
-      total_elems <= 0 ||
-      total_elems * static_cast<int64_t>(DataTypeSize(resp.dtype)) >
-          capacity_)
-    return false;
+  if (!enabled_ || resp.kind != Response::Kind::TENSOR) return false;
+  const int64_t el = static_cast<int64_t>(DataTypeSize(resp.dtype));
+  if (resp.op == OpType::ALLGATHER) {
+    // every rank's contribution must fit its slot (rows may be uneven)
+    if (resp.rows_flat.size() < static_cast<size_t>(size_) ||
+        resp.trailing <= 0)
+      return false;
+    int64_t mx = 0;
+    for (int r = 0; r < size_; ++r)
+      mx = std::max(mx, resp.rows_flat[r]);
+    return mx * resp.trailing * el <= capacity_;
+  }
+  if (total_elems <= 0 || total_elems * el > capacity_) return false;
   if (resp.op == OpType::ALLREDUCE)
     return resp.reduce != ReduceKind::ADASUM;
   return resp.op == OpType::BROADCAST;
@@ -217,6 +225,25 @@ void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
   Barrier();  // result complete
   memcpy(buf, result(), bytes);
   Barrier();  // everyone has read; slots/result reusable next op
+}
+
+void ShmLocalBackend::Allgatherv(const void* in, int64_t my_rows,
+                                 const std::vector<int64_t>& rows,
+                                 int64_t row_bytes, void* out) {
+  if (!gather_logged_) {
+    gather_logged_ = true;
+    HVT_LOG(DEBUG, rank_) << "shm allgather engaged";
+  }
+  memcpy(slot(rank_), in, static_cast<size_t>(my_rows * row_bytes));
+  Barrier();  // all contributions visible
+  auto* dst = static_cast<uint8_t*>(out);
+  size_t off = 0;
+  for (int r = 0; r < size_; ++r) {
+    size_t nb = static_cast<size_t>(rows[r] * row_bytes);
+    memcpy(dst + off, slot(r), nb);
+    off += nb;
+  }
+  Barrier();  // reads done; slots reusable by the next op
 }
 
 void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {

@@ -100,6 +100,9 @@ class ShmLocalBackend : public CollectiveBackend {
   void Allreduce(void* buf, int64_t count, DataType dtype,
                  ReduceKind red) override;
   void Broadcast(void* buf, int64_t bytes, int root) override;
+  void Allgatherv(const void* in, int64_t my_rows,
+                  const std::vector<int64_t>& rows, int64_t row_bytes,
+                  void* out) override;
 
  private:
   void Barrier();
@@ -111,6 +114,7 @@ class ShmLocalBackend : public CollectiveBackend {
   bool enabled_ = false;
   bool used_logged_ = false;
   bool bcast_logged_ = false;
+  bool gather_logged_ = false;
   uint8_t* base_ = nullptr;
   size_t map_bytes_ = 0;
 };

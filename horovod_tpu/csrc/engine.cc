@@ -1198,15 +1198,22 @@ void Engine::ExecuteResponse(const Response& resp,
       // per-row element count from the coordinator (identical on every
       // rank, including joined ranks with no local entry)
       int64_t row_bytes = resp.trailing * static_cast<int64_t>(el);
+      // mirror the coordinator's row convention (BuildResponse counts a
+      // 0-d entry as ONE row) or peers would read an uninitialized row
       int64_t my_rows =
-          (e && !e->shape.dims.empty()) ? e->shape.dims[0] : 0;
+          e ? (e->shape.dims.empty() ? 1 : e->shape.dims[0]) : 0;
       int64_t total_rows = 0;
       for (auto r : rows) total_rows += r;
       std::vector<uint8_t> out(static_cast<size_t>(total_rows) * row_bytes);
       const void* in = e ? static_cast<const void*>(e->input.data())
                          : static_cast<const void*>(out.data());
-      data_->AllgathervGroup(in, my_rows, rows, row_bytes, out.data(),
-                             grp);
+      if (resp.members.empty())
+        // full world: backend list applies (shm single-copy concat)
+        PickBackend(resp, total_rows * resp.trailing)
+            ->Allgatherv(in, my_rows, rows, row_bytes, out.data());
+      else
+        data_->AllgathervGroup(in, my_rows, rows, row_bytes, out.data(),
+                               grp);
       if (e) {
         e->output = std::move(out);
         e->recv_splits = rows;

@@ -658,10 +658,20 @@ def test_shm_allreduce_single_host_2proc():
         bb = np.asarray(hvt.broadcast(big, root_rank=0, name="shm.bcbig"))
         np.testing.assert_allclose(bb, np.arange(1 << 20,
                                                  dtype=np.float32))
+        # scalar (0-d) allgather: one row per rank, not garbage
+        s = np.asarray(hvt.allgather(np.float32(r + 0.5), name="shm.sc"))
+        np.testing.assert_allclose(s, [i + 0.5 for i in range(n)])
+        # uneven allgather rides shm (single-copy concat from slots)
+        g = np.asarray(hvt.allgather(np.full((r + 2, 3), float(r),
+                                             np.float32), name="shm.ag"))
+        assert g.shape == (2 * n + 1, 3), g.shape
+        np.testing.assert_allclose(g[:2], 0.0)
+        np.testing.assert_allclose(g[2:], 1.0)
     """, extra_env={"HVT_LOG_LEVEL": "debug"})
     assert "shm local data plane up" in out, out[-2000:]
     assert "shm allreduce engaged" in out, out[-2000:]
     assert "shm broadcast engaged" in out, out[-2000:]
+    assert "shm allgather engaged" in out, out[-2000:]
 
 
 def test_shm_disabled_falls_back_to_ring_2proc():
