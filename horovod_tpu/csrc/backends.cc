@@ -63,6 +63,17 @@ void CollectiveBackend::Alltoallv(const void*, const std::vector<int64_t>&,
                            "' does not implement alltoall");
 }
 
+void CollectiveBackend::AlltoallvMatrix(
+    const void* in, const std::vector<int64_t>& rows_flat, int m,
+    int64_t row_bytes, void* out, int my_pos) {
+  std::vector<int64_t> send_rows(m, 0), recv_rows(m, 0);
+  for (int d = 0; d < m; ++d)
+    send_rows[d] = rows_flat[static_cast<size_t>(my_pos) * m + d];
+  for (int s = 0; s < m; ++s)
+    recv_rows[s] = rows_flat[static_cast<size_t>(s) * m + my_pos];
+  Alltoallv(in, send_rows, row_bytes, out, recv_rows);
+}
+
 void RingBackend::Allreduce(void* buf, int64_t count, DataType dtype,
                             ReduceKind red) {
   dp_->Allreduce(buf, count, dtype, red);
@@ -195,6 +206,21 @@ bool ShmLocalBackend::Enabled(const Response& resp,
       mx = std::max(mx, resp.rows_flat[r]);
     return mx * resp.trailing * el <= capacity_;
   }
+  if (resp.op == OpType::ALLTOALL) {
+    // every sender's full send buffer must fit its slot
+    if (resp.rows_flat.size() <
+            static_cast<size_t>(size_) * static_cast<size_t>(size_) ||
+        resp.trailing <= 0)
+      return false;
+    int64_t mx = 0;
+    for (int s = 0; s < size_; ++s) {
+      int64_t tot = 0;
+      for (int d = 0; d < size_; ++d)
+        tot += resp.rows_flat[static_cast<size_t>(s) * size_ + d];
+      mx = std::max(mx, tot);
+    }
+    return mx * resp.trailing * el <= capacity_;
+  }
   if (total_elems <= 0 || total_elems * el > capacity_) return false;
   if (resp.op == OpType::ALLREDUCE)
     return resp.reduce != ReduceKind::ADASUM;
@@ -244,6 +270,36 @@ void ShmLocalBackend::Allgatherv(const void* in, int64_t my_rows,
     off += nb;
   }
   Barrier();  // reads done; slots reusable by the next op
+}
+
+void ShmLocalBackend::AlltoallvMatrix(const void* in,
+                                      const std::vector<int64_t>& rows_flat,
+                                      int m, int64_t row_bytes, void* out,
+                                      int my_pos) {
+  (void)my_pos;  // full world only: position == rank
+  if (!a2a_logged_) {
+    a2a_logged_ = true;
+    HVT_LOG(DEBUG, rank_) << "shm alltoall engaged";
+  }
+  int64_t my_send = 0;
+  for (int d = 0; d < m; ++d)
+    my_send += rows_flat[static_cast<size_t>(rank_) * m + d];
+  memcpy(slot(rank_), in, static_cast<size_t>(my_send * row_bytes));
+  Barrier();  // all send buffers visible
+  auto* dst = static_cast<uint8_t*>(out);
+  size_t off = 0;
+  for (int s = 0; s < m; ++s) {
+    // sender s's slot holds its destinations in position order; my
+    // segment starts after everything addressed to positions < me
+    int64_t pre = 0;
+    for (int d = 0; d < rank_; ++d)
+      pre += rows_flat[static_cast<size_t>(s) * m + d];
+    size_t nb = static_cast<size_t>(
+        rows_flat[static_cast<size_t>(s) * m + rank_] * row_bytes);
+    memcpy(dst + off, slot(s) + pre * row_bytes, nb);
+    off += nb;
+  }
+  Barrier();  // reads done; slots reusable
 }
 
 void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {

@@ -54,6 +54,13 @@ class CollectiveBackend {
                          const std::vector<int64_t>& send_rows,
                          int64_t row_bytes, void* out,
                          const std::vector<int64_t>& recv_rows);
+  // full sender-position-major m x m row matrix (my_pos = this rank's
+  // position). Default derives the send/recv vectors and delegates to
+  // Alltoallv; the shm backend overrides to address peer slots directly.
+  virtual void AlltoallvMatrix(const void* in,
+                               const std::vector<int64_t>& rows_flat,
+                               int m, int64_t row_bytes, void* out,
+                               int my_pos);
 };
 
 // Flat TCP ring over the full mesh — always enabled (the fallback).
@@ -103,6 +110,9 @@ class ShmLocalBackend : public CollectiveBackend {
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,
                   void* out) override;
+  void AlltoallvMatrix(const void* in,
+                       const std::vector<int64_t>& rows_flat, int m,
+                       int64_t row_bytes, void* out, int my_pos) override;
 
  private:
   void Barrier();
@@ -115,6 +125,7 @@ class ShmLocalBackend : public CollectiveBackend {
   bool used_logged_ = false;
   bool bcast_logged_ = false;
   bool gather_logged_ = false;
+  bool a2a_logged_ = false;
   uint8_t* base_ = nullptr;
   size_t map_bytes_ = 0;
 };

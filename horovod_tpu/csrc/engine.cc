@@ -1253,16 +1253,19 @@ void Engine::ExecuteResponse(const Response& resp,
       for (int s = 0; s < m; ++s)
         recv_rows[s] =
             resp.rows_flat[static_cast<size_t>(s) * m + my_pos];
-      int64_t my_rows = 0;
-      for (auto r : send_rows) my_rows += r;
       int64_t row_bytes = resp.trailing * static_cast<int64_t>(el);
       int64_t total_recv = 0;
       for (auto r : recv_rows) total_recv += r;
       std::vector<uint8_t> out(static_cast<size_t>(total_recv) * row_bytes);
       const void* in = e ? static_cast<const void*>(e->input.data())
                          : static_cast<const void*>(out.data());
-      data_->AlltoallvGroup(in, send_rows, row_bytes, out.data(),
-                            recv_rows, grp);
+      if (resp.members.empty())
+        PickBackend(resp, total_recv * resp.trailing)
+            ->AlltoallvMatrix(in, resp.rows_flat, m, row_bytes,
+                              out.data(), my_pos);
+      else
+        data_->AlltoallvGroup(in, send_rows, row_bytes, out.data(),
+                              recv_rows, grp);
       if (e) {
         e->output = std::move(out);
         e->recv_splits = recv_rows;

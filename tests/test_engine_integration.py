@@ -667,11 +667,24 @@ def test_shm_allreduce_single_host_2proc():
         assert g.shape == (2 * n + 1, 3), g.shape
         np.testing.assert_allclose(g[:2], 0.0)
         np.testing.assert_allclose(g[2:], 1.0)
+        # uneven alltoall rides shm (direct slot addressing)
+        payload = np.asarray([[float(r)], [float(r) + 10],
+                              [float(r) + 10]], np.float32)
+        out2, rsp = hvt.alltoall(payload, splits=[1, 2], name="shm.a2a")
+        out2 = np.asarray(out2)
+        if r == 0:
+            assert list(rsp) == [1, 1]
+            np.testing.assert_allclose(out2[:, 0], [0.0, 1.0])
+        else:
+            assert list(rsp) == [2, 2]
+            np.testing.assert_allclose(out2[:, 0],
+                                       [10.0, 10.0, 11.0, 11.0])
     """, extra_env={"HVT_LOG_LEVEL": "debug"})
     assert "shm local data plane up" in out, out[-2000:]
     assert "shm allreduce engaged" in out, out[-2000:]
     assert "shm broadcast engaged" in out, out[-2000:]
     assert "shm allgather engaged" in out, out[-2000:]
+    assert "shm alltoall engaged" in out, out[-2000:]
 
 
 def test_shm_disabled_falls_back_to_ring_2proc():
