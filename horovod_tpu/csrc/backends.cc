@@ -194,7 +194,12 @@ void ShmLocalBackend::Barrier() {
 
 bool ShmLocalBackend::Enabled(const Response& resp,
                               int64_t total_elems) const {
-  if (!enabled_ || resp.kind != Response::Kind::TENSOR) return false;
+  // full-world only: slots are addressed by global rank and the barrier
+  // counts every rank — a subset response must never land here even if
+  // a future call site forgets its members.empty() guard
+  if (!enabled_ || resp.kind != Response::Kind::TENSOR ||
+      !resp.members.empty())
+    return false;
   const int64_t el = static_cast<int64_t>(DataTypeSize(resp.dtype));
   if (resp.op == OpType::ALLGATHER) {
     // every rank's contribution must fit its slot (rows may be uneven)
@@ -222,7 +227,8 @@ bool ShmLocalBackend::Enabled(const Response& resp,
     return mx * resp.trailing * el <= capacity_;
   }
   if (total_elems <= 0 || total_elems * el > capacity_) return false;
-  if (resp.op == OpType::ALLREDUCE)
+  if (resp.op == OpType::ALLREDUCE || resp.op == OpType::REDUCESCATTER)
+    // reducescatter lowers to allreduce + local slice at the engine
     return resp.reduce != ReduceKind::ADASUM;
   return resp.op == OpType::BROADCAST;
 }
@@ -320,8 +326,12 @@ void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {
 
 bool HierarchicalBackend::Enabled(const Response& resp,
                                   int64_t total_elems) const {
-  return enabled_ && resp.op == OpType::ALLREDUCE &&
-         resp.kind == Response::Kind::TENSOR &&
+  // reducescatter lowers to a full allreduce at the engine, so the
+  // hierarchical decomposition serves it identically
+  return enabled_ &&
+         (resp.op == OpType::ALLREDUCE ||
+          resp.op == OpType::REDUCESCATTER) &&
+         resp.kind == Response::Kind::TENSOR && resp.members.empty() &&
          resp.reduce != ReduceKind::ADASUM && total_elems > 0;
 }
 

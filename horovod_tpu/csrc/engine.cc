@@ -1281,11 +1281,14 @@ void Engine::ExecuteResponse(const Response& resp,
       if (e) memcpy(buf.data(), e->input.data(), buf.size());
       if (resp.prescale != 1.0)
         ScaleBuffer(buf.data(), numel, resp.dtype, resp.prescale);
-      data_->AllreduceGroup(buf.data(), numel, resp.dtype,
-                            resp.reduce == ReduceKind::AVERAGE
-                                ? ReduceKind::SUM
-                                : resp.reduce,
-                            grp);
+      ReduceKind rk = resp.reduce == ReduceKind::AVERAGE
+                          ? ReduceKind::SUM
+                          : resp.reduce;
+      if (resp.members.empty())
+        PickBackend(resp, numel)->Allreduce(buf.data(), numel,
+                                            resp.dtype, rk);
+      else
+        data_->AllreduceGroup(buf.data(), numel, resp.dtype, rk, grp);
       double rs_post = resp.postscale;
       if (resp.reduce == ReduceKind::AVERAGE) rs_post /= m;
       if (rs_post != 1.0)

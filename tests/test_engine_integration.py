@@ -667,6 +667,13 @@ def test_shm_allreduce_single_host_2proc():
         assert g.shape == (2 * n + 1, 3), g.shape
         np.testing.assert_allclose(g[:2], 0.0)
         np.testing.assert_allclose(g[2:], 1.0)
+        # reducescatter rides the shm allreduce (engine slices locally)
+        rs = np.asarray(hvt.reducescatter(
+            (np.arange(8, dtype=np.float32) + r).reshape(4, 2),
+            op=hvt.Sum, name="shm.rs"))
+        full = sum((np.arange(8, dtype=np.float32) + i).reshape(4, 2)
+                   for i in range(n))
+        np.testing.assert_allclose(rs, full[r * 2:(r + 1) * 2])
         # uneven alltoall rides shm (direct slot addressing)
         payload = np.asarray([[float(r)], [float(r) + 10],
                               [float(r) + 10]], np.float32)
