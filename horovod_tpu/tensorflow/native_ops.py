@@ -145,14 +145,20 @@ def broadcast(tensor, root_rank=0, name=None, process_set=None):
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
-    """Returns (output, received_splits)."""
+    """Returns (output, received_splits). ``splits=None`` sends an even
+    dim-0 split to every participant (the engine validates
+    divisibility)."""
     import tensorflow as tf
     mod = _load()
+    members = _members(process_set, name)
     if splits is None:
-        splits = tf.zeros([0], dtype=tf.int32)
+        world = (tf.constant(len(members), tf.int32) if members
+                 else mod.hvt_size())
+        rows = tf.shape(tensor)[0]
+        splits = tf.fill(tf.reshape(world, [1]), rows // world)
     return mod.hvt_alltoall(tensor, tf.cast(splits, tf.int32),
                             tensor_name=_auto_name("alltoall", name),
-                            process_set_ranks=_members(process_set, name))
+                            process_set_ranks=members)
 
 
 def reducescatter(tensor, name=None, op=SUM, process_set=None):

@@ -85,6 +85,15 @@ def test_native_broadcast_and_alltoall():
                           name="bc")
         np.testing.assert_allclose(b.numpy(), 8.0)
 
+        # default (even) splits: row d of each rank's payload goes to
+        # rank d, so rank r receives [r_row from rank 0, r_row from 1, ..]
+        ev, evr = hvd.alltoall(
+            tf.constant([[10.0 * r + d] for d in range(n)]),
+            name="a2a.even")
+        np.testing.assert_allclose(
+            ev.numpy().ravel(), [10.0 * s + r for s in range(n)])
+        assert list(evr.numpy()) == [1] * n
+
         payload = tf.constant([[float(r)], [float(r) + 10.0],
                                [float(r) + 10.0]])
         out, recv = hvd.alltoall(payload, splits=[1, 2], name="a2a")
