@@ -155,7 +155,13 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
         world = (tf.constant(len(members), tf.int32) if members
                  else mod.hvt_size())
         rows = tf.shape(tensor)[0]
-        splits = tf.fill(tf.reshape(world, [1]), rows // world)
+        # fail HERE, not after a negotiation round-trip with a message
+        # about splits the caller never passed (mirrors engine/api.py)
+        with tf.control_dependencies([tf.debugging.assert_equal(
+                rows % world, 0,
+                message="alltoall without splits requires dim 0 "
+                        "divisible by the number of participants")]):
+            splits = tf.fill(tf.reshape(world, [1]), rows // world)
     return mod.hvt_alltoall(tensor, tf.cast(splits, tf.int32),
                             tensor_name=_auto_name("alltoall", name),
                             process_set_ranks=members)
