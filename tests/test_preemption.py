@@ -225,3 +225,81 @@ def test_sigterm_worker_midepoch_resumes_with_stable_ranks(tmp_path):
     # the signaled worker went through interrupt → reset → resume: its
     # batch counter must not restart from 0 after the first commit
     assert sorted(set(batches_1)) == list(range(6)), batches_1
+
+
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="C++ engine not built (make -C horovod_tpu/csrc)")
+def test_worker_death_restores_tf_keras_state(tmp_path):
+    """Elastic TF job: kill a worker mid-run; survivors restore from
+    their commit, the respawned worker syncs weights from rank 0, and
+    the final model state is exactly TOTAL deterministic updates on
+    every rank (reference tensorflow/elastic.py semantics)."""
+    marker_dir = str(tmp_path)
+    script = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import horovod_tpu as hvt
+        hvt.init()
+        import tensorflow as tf
+        import horovod_tpu.tensorflow.elastic as tfe
+
+        TMP = {marker_dir!r}
+        TOTAL = 6
+        v = tf.Variable([100.0])
+        model = tf.keras.Sequential()  # state rides the explicit var list
+        state = tfe.TensorFlowState([v], batch=0)
+
+        @hvt.elastic.run
+        def train(state):
+            slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
+            with open(f"{{TMP}}/pid_{{slot}}", "w") as f:
+                f.write(str(os.getpid()))
+            while state.batch < TOTAL:
+                hvt.allreduce(np.float32(1.0), name=f"b{{state.batch}}")
+                v.assign_sub([1.0])       # deterministic update per batch
+                state.batch += 1
+                open(f"{{TMP}}/tfprog_{{slot}}_{{state.batch}}",
+                     "w").close()
+                time.sleep(0.25)
+                state.commit()
+            print(f"TFDONE slot={{slot}} w={{float(v.numpy()[0])}}",
+                  flush=True)
+
+        train(state)
+        hvt.shutdown()
+    """)
+    path = os.path.join(marker_dir, "tf_worker.py")
+    with open(path, "w") as f:
+        f.write(script)
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": "", "TF_CPP_MIN_LOG_LEVEL": "3"})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--min-np", "2", "--master-port", "29812",
+         sys.executable, path],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        assert wait_until(
+            lambda: os.path.exists(f"{marker_dir}/tfprog_0_2")
+            and os.path.exists(f"{marker_dir}/tfprog_1_2"), timeout=120), \
+            "workers never reached batch 2"
+        with open(f"{marker_dir}/pid_1") as f:
+            pid = int(f.read())
+        os.kill(pid, signal.SIGTERM)
+        out, _ = proc.communicate(timeout=240)
+    except Exception:
+        proc.kill()
+        out = proc.stdout.read() if proc.stdout else ""
+        raise AssertionError(f"elastic TF job did not complete:\n{out}")
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{out}"
+    # both finished with EXACTLY TOTAL applied updates — rollback/sync
+    # must not lose or double-apply any
+    finals = [line for line in out.splitlines() if "TFDONE" in line]
+    assert len(finals) == 2, out
+    for line in finals:
+        assert "w=94.0" in line, line
