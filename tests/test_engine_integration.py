@@ -292,6 +292,26 @@ def test_hierarchical_allreduce_2x2_topology():
         assert f"HIER-OK-{r}" in out
 
 
+def test_hierarchical_serves_reducescatter_2x2():
+    """Reducescatter lowers to allreduce at the engine, so on a faked
+    2-host topology it must ride the hierarchical decomposition and slice
+    the right shard."""
+    extra = """
+rs = np.asarray(hvt.reducescatter(
+    (np.arange(8, dtype=np.float32) + r).reshape(8, 1), op=hvt.Sum,
+    name="hier.rs"))
+full = sum((np.arange(8, dtype=np.float32) + rr).reshape(8, 1)
+           for rr in range(n))
+np.testing.assert_array_equal(rs, full[r * 2:(r + 1) * 2])
+print(f"HIER-RS-OK-{r}", flush=True)
+"""
+    body = _HIER_BODY.replace("hvt.shutdown()", extra + "hvt.shutdown()")
+    out = _run_raw(body, extra_env={"HVT_LOG_LEVEL": "info"})
+    assert "hierarchical allreduce (2x2)" in out, out
+    for r in range(4):
+        assert f"HIER-RS-OK-{r}" in out
+
+
 def test_hierarchical_disabled_falls_back_to_ring():
     """HVT_HIERARCHICAL_ALLREDUCE=0 keeps the ordered backend list on the
     ring fallback; results unchanged."""
