@@ -25,6 +25,8 @@ from typing import Any, Callable, Optional, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from .normalization import TpuBatchNorm
+
 ModuleDef = Any
 
 
@@ -67,12 +69,20 @@ class ResNet(nn.Module):
     width: int = 64
     dtype: jnp.dtype = jnp.bfloat16
     axis_name: Optional[str] = None  # set → synchronized batch norm
+    # "tpu": TpuBatchNorm — bf16 HBM traffic, fp32-accumulated statistics
+    # (see models/normalization.py); "flax": stock nn.BatchNorm (fp32
+    # statistics AND fp32 normalization passes) kept for parity checks.
+    norm_impl: str = "tpu"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=jnp.float32)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
+        if self.norm_impl not in ("tpu", "flax"):
+            raise ValueError(f"norm_impl must be 'tpu' or 'flax', got "
+                             f"{self.norm_impl!r}")
+        norm_cls = TpuBatchNorm if self.norm_impl == "tpu" else nn.BatchNorm
+        norm = partial(norm_cls, use_running_average=not train,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype,
                        param_dtype=jnp.float32, axis_name=self.axis_name)
         act = nn.relu

@@ -36,7 +36,8 @@ def test_main_scaling_sweep_and_json_schema(monkeypatch, capsys):
     per_chip_by_n = {1: 100.0, 2: 95.0, 4: 90.0, 8: 85.0}
 
     def fake_measure(model_name, devices, per_chip_batch, num_iters,
-                     num_batches_per_iter, dtype_name, image_size=224):
+                     num_batches_per_iter, dtype_name, image_size=224,
+                     norm_impl="tpu"):
         pc = per_chip_by_n[len(devices)]
         return pc, pc * len(devices), 0.0, 12.3e9, 23.5e9, 1.23
 
@@ -54,6 +55,8 @@ def test_main_scaling_sweep_and_json_schema(monkeypatch, capsys):
     assert rec["vs_baseline"] == pytest.approx(
         85.0 / bench.BASELINE_IMG_SEC_PER_DEVICE, rel=1e-3)
     assert rec["calib_tflops"] == 100.0
+    # 3 identical interleaved samples → zero spread
+    assert rec["calib_spread"] == 0.0
     assert rec["achieved_tflops"] == pytest.approx(
         85.0 * 12.3e9 / 1e12, rel=1e-3)
     assert rec["mfu"] == pytest.approx(rec["achieved_tflops"] / 100.0,
