@@ -418,10 +418,12 @@ def main():
         sweep_eff = [round(per_chip_at[k] / per_chip_at[1], 4)
                      for k in sweep_n]
 
-    if len(sweep_n) > 1:
-        # only a real sweep separates sample 2 from sample 3 in time;
-        # back-to-back samples would double-weight one instant
-        calib_samples.append(calibrate_matmul_tflops(platform))
+    if len(sweep_n) <= 1:
+        # no sweep ran to separate samples 2 and 3 in time; pause so the
+        # third sample still measures a distinct instant (median-of-3
+        # rejects one drifted sample, median-of-2 cannot)
+        time.sleep(10)
+    calib_samples.append(calibrate_matmul_tflops(platform))
     import numpy as np
 
     calib_tflops = float(np.median(calib_samples))

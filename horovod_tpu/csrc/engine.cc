@@ -864,8 +864,8 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
   if (a.op == OpType::REDUCESCATTER) {
     int64_t rows = a.shape.dims.empty() ? 1 : a.shape.dims[0];
     if (rows % m != 0)
-      return fail("reducescatter dim 0 must divide the participant count "
-                  "for '" + a.name + "'");
+      return fail("reducescatter dim 0 must be divisible by the "
+                  "participant count for '" + a.name + "'");
   }
   return resp;
 }

@@ -255,12 +255,29 @@ class HvtAllgatherOp : public HvtAsyncOpBase {
     DataType dt = input.dtype();
     SubmitAndDefer(ctx, done, input, a,
                    [ctx, shape, dt](int handle) -> Status {
-      long long nbytes = hvt_result_bytes(handle);
+      // Output dim 0 = sum of the NEGOTIATED per-member row counts, not
+      // result_bytes / row_bytes: byte division collapses zero-width
+      // rows (any trailing dim of 0) to zero rows, hiding the true
+      // gathered count from downstream shape logic.
+      std::vector<long long> rsp(hvt_size() > 0 ? hvt_size() : 1);
+      int n = hvt_result_recv_splits(handle, rsp.data(),
+                                     static_cast<int>(rsp.size()));
+      n = n < static_cast<int>(rsp.size()) ? n
+                                           : static_cast<int>(rsp.size());
       TensorShape out_shape = shape;
-      int64_t row_elems = 1;
-      for (int i = 1; i < shape.dims(); ++i) row_elems *= shape.dim_size(i);
-      int64_t row_bytes = row_elems * DataTypeSize(dt);
-      out_shape.set_dim(0, row_bytes > 0 ? nbytes / row_bytes : 0);
+      int64_t total_rows = 0;
+      if (n > 0) {
+        for (int i = 0; i < n; ++i) total_rows += rsp[i];
+      } else {
+        // legacy fallback (engine predating recv_splits on allgather)
+        int64_t row_elems = 1;
+        for (int i = 1; i < shape.dims(); ++i)
+          row_elems *= shape.dim_size(i);
+        int64_t row_bytes = row_elems * DataTypeSize(dt);
+        total_rows =
+            row_bytes > 0 ? hvt_result_bytes(handle) / row_bytes : 0;
+      }
+      out_shape.set_dim(0, total_rows);
       Tensor* out = nullptr;
       TF_RETURN_IF_ERROR(ctx->allocate_output(0, out_shape, &out));
       auto dst = out->tensor_data();
@@ -316,10 +333,8 @@ class HvtAlltoallOp : public HvtAsyncOpBase {
     auto flat = splits.flat<int32>();
     for (int i = 0; i < flat.size(); ++i) a.splits.push_back(flat(i));
     TensorShape shape = input.shape();
-    DataType dt = input.dtype();
     SubmitAndDefer(ctx, done, input, a,
-                   [ctx, shape, dt](int handle) -> Status {
-      long long nbytes = hvt_result_bytes(handle);
+                   [ctx, shape](int handle) -> Status {
       // sized by world size: the engine returns one split per member
       std::vector<long long> rsp(hvt_size() > 0 ? hvt_size() : 1);
       int n = hvt_result_recv_splits(handle, rsp.data(),
@@ -327,10 +342,11 @@ class HvtAlltoallOp : public HvtAsyncOpBase {
       n = n < static_cast<int>(rsp.size()) ? n
                                            : static_cast<int>(rsp.size());
       TensorShape out_shape = shape;
-      int64_t row_elems = 1;
-      for (int i = 1; i < shape.dims(); ++i) row_elems *= shape.dim_size(i);
-      int64_t row_bytes = row_elems * DataTypeSize(dt);
-      out_shape.set_dim(0, row_bytes > 0 ? nbytes / row_bytes : 0);
+      // dim 0 from the negotiated splits (byte division would collapse
+      // zero-width rows to zero rows)
+      int64_t total_rows = 0;
+      for (int i = 0; i < n; ++i) total_rows += rsp[i];
+      out_shape.set_dim(0, total_rows);
       Tensor* out = nullptr;
       TF_RETURN_IF_ERROR(ctx->allocate_output(0, out_shape, &out));
       auto dst = out->tensor_data();

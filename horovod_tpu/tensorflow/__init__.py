@@ -173,7 +173,7 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
 
 def reducescatter(tensor, name=None, op=None, process_set=None):
     """Reduce across workers, each keeping its dim-0 shard (dim 0 must
-    divide the participant count)."""
+    be divisible by the participant count)."""
     _require_tf()
     from horovod_tpu.ops import collective_ops as C
 
@@ -428,6 +428,7 @@ class _DistributedOptimizer:
         self._agg = None       # list of numpy accumulators (None for None)
         self._agg_count = 0
         self._graph_agg = None  # tf.function path: in-graph aggregation
+        self._graph_agg_var_keys = None
 
     def __getattr__(self, name):
         return getattr(self._opt, name)
@@ -471,6 +472,12 @@ class _DistributedOptimizer:
             from horovod_tpu.tensorflow.gradient_aggregation import \
                 LocalGradientAggregationHelper
 
+            # The helper's allreduce closure captures the per-variable
+            # names from the call that BUILT it; a later call with a
+            # same-length but different variable list would silently
+            # reuse names keyed to the old variables.
+            var_keys = [v.ref() if hasattr(v, "ref") else id(v)
+                        for v in variables]
             if self._graph_agg is None:
                 self._graph_agg = LocalGradientAggregationHelper(
                     self.backward_passes_per_step,
@@ -481,6 +488,13 @@ class _DistributedOptimizer:
                         process_set=self._process_set,
                         name_prefix="DistributedOptimizer", names=names),
                     average_aggregated_gradients=self._average_aggregated)
+                self._graph_agg_var_keys = var_keys
+            elif var_keys != self._graph_agg_var_keys:
+                raise ValueError(
+                    "apply_gradients called with a different variable "
+                    "list than the in-graph gradient aggregation was "
+                    "built for; use a separate DistributedOptimizer per "
+                    "variable set")
             return self._graph_agg.compute_and_apply(
                 grads,
                 lambda red: self._opt.apply_gradients(

@@ -169,7 +169,7 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
 
 def reducescatter(tensor, name=None, op=SUM, process_set=None):
     """In-graph reduce-scatter: reduce across members, each keeps its
-    dim-0 shard (dim 0 must divide the participant count)."""
+    dim-0 shard (dim 0 must be divisible by the participant count)."""
     mod = _load()
     return mod.hvt_reducescatter(
         tensor, tensor_name=_auto_name("reducescatter", name),
@@ -240,6 +240,21 @@ def _register_gradients():
                  if members else tf.cast(mod.hvt_size(), grad.dtype))
             gathered = gathered / tf.cast(m, gathered.dtype)
         return gathered
+
+    @tf_ops.RegisterGradient("HvtAlltoall")
+    def _alltoall_grad(op, grad, _grad_splits):
+        # Route each received block's gradient back to the rank that sent
+        # it: alltoall the incoming gradient with the FORWARD's negotiated
+        # received_splits as the send splits — every rank then receives
+        # exactly its forward send-split rows, reconstructing the input
+        # layout (reference tensorflow/mpi_ops.py alltoall gradient).
+        # splits input is integral → no gradient.
+        members = list(op.get_attr("process_set_ranks"))
+        mod = _load()
+        out, _ = mod.hvt_alltoall(
+            grad, op.outputs[1], tensor_name=_grad_name(op, "grad"),
+            process_set_ranks=members)
+        return out, None
 
     @tf_ops.RegisterGradient("HvtAllgather")
     def _allgather_grad(op, grad):

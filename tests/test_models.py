@@ -262,3 +262,51 @@ class TestTpuBatchNorm:
             return losses
 
         np.testing.assert_allclose(run("tpu"), run("flax"), rtol=1e-4)
+
+    def test_resnet_bf16_loss_trajectory_tracks_flax_bn(self):
+        """Same trajectory check in bf16 — the production default path
+        (the fp32 test would pass even if the bf16 affine application
+        regressed). Loose tolerance: the two implementations round at
+        different points by design."""
+        import optax
+
+        from horovod_tpu.models import ResNet50
+
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(4, 32, 32, 3), jnp.bfloat16)
+        labels = jnp.asarray(rng.randint(0, 10, (4,)))
+
+        def run(norm_impl):
+            model = ResNet50(num_classes=10, dtype=jnp.bfloat16,
+                             norm_impl=norm_impl)
+            variables = model.init(jax.random.PRNGKey(0), x, train=True)
+            params, bs = variables["params"], variables["batch_stats"]
+            # small lr: a big step overfits 4 samples to ~0 loss in one
+            # update, where relative comparison is meaningless
+            tx = optax.sgd(0.005, momentum=0.9)
+            opt = tx.init(params)
+
+            @jax.jit
+            def step(params, bs, opt):
+                def loss_fn(p, b):
+                    logits, mut = model.apply(
+                        {"params": p, "batch_stats": b}, x, train=True,
+                        mutable=["batch_stats"])
+                    l = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, labels).mean()
+                    return l, mut["batch_stats"]
+
+                (l, bs2), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, bs)
+                up, opt2 = tx.update(g, opt, params)
+                return optax.apply_updates(params, up), bs2, opt2, l
+
+            losses = []
+            for _ in range(3):
+                params, bs, opt, l = step(params, bs, opt)
+                losses.append(float(l))
+            return losses
+
+        t, f = run("tpu"), run("flax")
+        assert all(np.isfinite(t)) and all(np.isfinite(f))
+        np.testing.assert_allclose(t, f, rtol=0.05, atol=0.02)

@@ -258,3 +258,41 @@ def test_tf_join_uneven_steps_2proc():
         last = hvd.join()
         assert last == 0, last  # rank 0 ran more steps → joined last
     """)
+
+
+def test_native_alltoall_gradient_2proc():
+    # grad of alltoall routes each received block's gradient back to its
+    # sender via the forward's negotiated received_splits (reference
+    # tensorflow/mpi_ops.py alltoall gradient)
+    run_tf_workers("""
+        splits = [1, 2] if r == 0 else [2, 1]
+        v = tf.Variable(
+            tf.reshape(tf.range(3, dtype=tf.float32) + 10.0 * r, [3, 1]))
+
+        @tf.function
+        def step():
+            with tf.GradientTape() as tape:
+                out, recv = hvd.alltoall(v, splits=splits, name="a2a.g")
+                loss = tf.reduce_sum(out) * (r + 1.0)
+            return tape.gradient(loss, v)
+
+        g = step()
+        # rank 0 kept row 0 (factor 1), sent rows 1-2 to rank 1 (factor 2)
+        # rank 1 sent rows 0-1 to rank 0 (factor 1), kept row 2 (factor 2)
+        expect = [[1.0], [2.0], [2.0]] if r == 0 else [[1.0], [1.0], [2.0]]
+        np.testing.assert_allclose(g.numpy(), expect)
+    """)
+
+
+def test_native_zero_width_rows_keep_true_row_count():
+    # trailing dim 0 → row_bytes 0; dim 0 must come from the negotiated
+    # splits, not result_bytes/row_bytes
+    run_tf_workers("""
+        rows = r + 1
+        res = hvd.allgather(tf.zeros([rows, 0]), name="agz")
+        assert tuple(res.shape) == (n * (n + 1) // 2, 0), res.shape
+
+        out, recv = hvd.alltoall(tf.zeros([n, 0]), name="a2az")
+        assert tuple(out.shape) == (n, 0), out.shape
+        assert list(recv.numpy()) == [1] * n
+    """)

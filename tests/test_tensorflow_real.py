@@ -381,3 +381,27 @@ def test_keras_commit_state_callback_with_tf_keras_state():
     assert state.batch == 0 and state.epoch == 1  # epoch rolled over
     # the last commit snapshot restores cleanly
     state.restore()
+
+
+def test_graph_mode_aggregation_rejects_changed_variable_list():
+    """The in-graph aggregation helper closes over per-variable collective
+    names from the call that built it; a later call with a same-length but
+    DIFFERENT variable list must raise, not silently reuse stale names."""
+    import horovod_tpu.tensorflow as hvt_tf2
+
+    v1 = tf.Variable([1.0, 2.0])
+    v2 = tf.Variable([3.0, 4.0])
+    opt = hvt_tf2.DistributedOptimizer(
+        tf.keras.optimizers.SGD(1.0), backward_passes_per_step=2)
+
+    @tf.function
+    def step_a(g):
+        return opt.apply_gradients([(g, v1)])
+
+    @tf.function
+    def step_b(g):
+        return opt.apply_gradients([(g, v2)])
+
+    step_a(tf.constant([1.0, 1.0]))
+    with pytest.raises(Exception, match="different variable list"):
+        step_b(tf.constant([1.0, 1.0]))
