@@ -50,6 +50,16 @@ using namespace tensorflow;  // NOLINT
 enum WireOp { OP_ALLREDUCE = 0, OP_ALLGATHER = 1, OP_BROADCAST = 2,
               OP_ALLTOALL = 3, OP_REDUCESCATTER = 4 };
 
+// Negotiated per-member row counts of a completed gather/alltoall result.
+// Returns the number of splits read (0 if the engine recorded none).
+static int ReadRecvSplits(int handle, std::vector<long long>* out) {
+  out->assign(hvt_size() > 0 ? hvt_size() : 1, 0);
+  int n = hvt_result_recv_splits(handle, out->data(),
+                                 static_cast<int>(out->size()));
+  return n < static_cast<int>(out->size()) ? n
+                                           : static_cast<int>(out->size());
+}
+
 static int WireDType(DataType dt) {
   switch (dt) {
     case DT_UINT8: return 0;
@@ -259,11 +269,8 @@ class HvtAllgatherOp : public HvtAsyncOpBase {
       // result_bytes / row_bytes: byte division collapses zero-width
       // rows (any trailing dim of 0) to zero rows, hiding the true
       // gathered count from downstream shape logic.
-      std::vector<long long> rsp(hvt_size() > 0 ? hvt_size() : 1);
-      int n = hvt_result_recv_splits(handle, rsp.data(),
-                                     static_cast<int>(rsp.size()));
-      n = n < static_cast<int>(rsp.size()) ? n
-                                           : static_cast<int>(rsp.size());
+      std::vector<long long> rsp;
+      int n = ReadRecvSplits(handle, &rsp);
       TensorShape out_shape = shape;
       int64_t total_rows = 0;
       if (n > 0) {
@@ -335,12 +342,8 @@ class HvtAlltoallOp : public HvtAsyncOpBase {
     TensorShape shape = input.shape();
     SubmitAndDefer(ctx, done, input, a,
                    [ctx, shape](int handle) -> Status {
-      // sized by world size: the engine returns one split per member
-      std::vector<long long> rsp(hvt_size() > 0 ? hvt_size() : 1);
-      int n = hvt_result_recv_splits(handle, rsp.data(),
-                                     static_cast<int>(rsp.size()));
-      n = n < static_cast<int>(rsp.size()) ? n
-                                           : static_cast<int>(rsp.size());
+      std::vector<long long> rsp;
+      int n = ReadRecvSplits(handle, &rsp);
       TensorShape out_shape = shape;
       // dim 0 from the negotiated splits (byte division would collapse
       // zero-width rows to zero rows)

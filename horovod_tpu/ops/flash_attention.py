@@ -183,13 +183,17 @@ def _blocks(s, requested):
     return max(b, 1)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k)
-    return o
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, out_dtype):
+    """Differentiable (o, lse). The lse output carries its own gradient:
+    d lse/dS = P, so a dlse cotangent folds into the backward kernels as
+    delta := rowsum(do∘o) − dlse — the kernels are unchanged."""
+    o, lse = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k,
+                             out_dtype)
+    return o, lse
 
 
-def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k):
+def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, out_dtype):
     b, h, s, d = q.shape
     grid = (b, h, s // block_q)
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
@@ -202,23 +206,27 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k):
         out_specs=[qspec,
                    pl.BlockSpec((1, 1, block_q, 1),
                                 lambda bi, hi, qi: (bi, hi, qi, 0))],
-        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, out_dtype),
                    jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v)
     return o, lse
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, out_dtype):
+    o, lse = _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k,
+                             out_dtype)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, res, do):
+def _flash_bwd(scale, causal, block_q, block_k, out_dtype, res, cot):
+    do, dlse = cot
     q, k, v, o, lse = res
     b, h, s, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)        # [B, H, S, 1]
+    # lse cotangent: ds gains + P∘dlse, i.e. delta shifts by −dlse
+    delta = delta - dlse.astype(jnp.float32)
 
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
     full = pl.BlockSpec((1, 1, s, d), lambda bi, hi, i: (bi, hi, 0, 0))
@@ -267,6 +275,25 @@ def flash_attention(q, k, v, *, causal=True, scale=None,
     Returns [batch, seq, heads, head_dim] in q.dtype. Differentiable
     (custom VJP with recompute-based backward kernels).
     """
+    o, _ = flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                    block_q=block_q, block_k=block_k)
+    return o
+
+
+def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
+                             block_q=128, block_k=128, out_dtype=None):
+    """Fused attention returning ``(o, lse)``; both are differentiable.
+
+    ``lse[b, s, h]`` is the log-sum-exp of the (scaled, masked) scores for
+    each query — exactly what blockwise/ring composition needs to combine
+    partial attention outputs: given per-block ``(o_i, lse_i)``, the total
+    is ``o = Σ_i exp(lse_i − logaddexp_i lse_i) · o_i``
+    (``parallel/sequence.py`` ring attention uses this).
+
+    ``out_dtype`` (default ``q.dtype``): dtype o is written in. Blockwise
+    consumers should pass ``jnp.float32`` so the fp32 accumulator reaches
+    the combine unrounded; the matmuls still run on bf16 operands.
+    """
     b, s, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
@@ -274,6 +301,9 @@ def flash_attention(q, k, v, *, causal=True, scale=None,
     block_k = _blocks(s, block_k)
     # Kernels are gridded (batch, head, block): BHSD layout.
     to_bhsd = lambda x: jnp.transpose(x, (0, 2, 1, 3))
-    o = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-               float(scale), bool(causal), block_q, block_k)
-    return jnp.transpose(o, (0, 2, 1, 3))
+    o, lse = _flash(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                    float(scale), bool(causal), block_q, block_k,
+                    jnp.dtype(out_dtype or q.dtype))
+    # lse: [B, H, S, 1] → [B, S, H]
+    return jnp.transpose(o, (0, 2, 1, 3)), jnp.transpose(lse[..., 0],
+                                                         (0, 2, 1))

@@ -59,7 +59,8 @@ def _local_attention(q, k, v, q_pos, k_pos, *, causal, scale):
     return o, m, l
 
 
-def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None):
+def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
+                         use_flash=False):
     """Ring attention on per-device shards; call under ``shard_map``.
 
     Args:
@@ -68,9 +69,17 @@ def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None):
       axis_name: mesh axis carrying the sequence shards.
       causal: apply a causal mask using *global* token positions.
       scale: softmax scale; default ``head_dim ** -0.5``.
+      use_flash: run each K/V block through the pallas fused kernel
+        (``ops/flash_attention.py``) instead of the einsum-softmax block
+        step — O(shard) VMEM-resident scores instead of a materialized
+        [Sq × Sk] tile. Blocks combine via the kernel's differentiable
+        logsumexp output.
 
     Returns [batch, seq_shard, heads, head_dim] in q.dtype.
     """
+    if use_flash:
+        return _ring_flash_shard(q, k, v, axis_name=axis_name,
+                                 causal=causal, scale=scale)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
@@ -108,6 +117,68 @@ def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None):
     l = jnp.maximum(l, 1e-30)
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
+
+
+def _ring_flash_shard(q, k, v, *, axis_name, causal, scale):
+    """Ring attention where each block step IS the flash kernel.
+
+    With sequence shards, the causal structure is block-triangular: the
+    K/V shard that started on this device attends causally (the kernel's
+    own mask — positions align), shards from EARLIER ring positions are
+    fully visible (no mask), and later shards are fully hidden (skipped
+    via an lse of −∞, so their combine weight underflows to exactly 0).
+    Blocks merge by the flash kernel's differentiable logsumexp:
+    ``o = Σ_i exp(lse_i − logaddexp_i lse_i) · o_i``.
+    """
+    from horovod_tpu.ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def flash_blk(blk_causal):
+        def run(k_blk, v_blk):
+            # out_dtype fp32: the kernel's accumulator reaches the
+            # logsumexp combine unrounded (parity with the einsum ring
+            # path, which carries fp32 end-to-end)
+            o, lse = flash_attention_with_lse(q, k_blk, v_blk,
+                                              causal=blk_causal,
+                                              scale=scale,
+                                              out_dtype=jnp.float32)
+            return o, lse
+        return run
+
+    def masked_blk(k_blk, v_blk):
+        return (jnp.zeros((b, s, h, d), jnp.float32),
+                jnp.full((b, s, h), _NEG_INF, jnp.float32))
+
+    def body(step, carry):
+        o, lse, k_blk, v_blk = carry
+        k_idx = (idx - step) % n
+        if causal:
+            case = jnp.where(k_idx == idx, 0,
+                             jnp.where(k_idx < idx, 1, 2))
+            o_blk, lse_blk = lax.switch(
+                case, [flash_blk(True), flash_blk(False), masked_blk],
+                k_blk, v_blk)
+        else:
+            o_blk, lse_blk = flash_blk(False)(k_blk, v_blk)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        w_old = jnp.exp(lse - lse_new)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+        o_new = o * w_old + o_blk * w_blk
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return o_new, lse_new, k_nxt, v_nxt
+
+    o0 = jnp.zeros((b, s, h, d), jnp.float32)
+    # finite −∞ stand-in: fully-masked rows produce 0, never inf−inf NaN
+    lse0 = jnp.full((b, s, h), _NEG_INF, jnp.float32)
+    o, _, _, _ = lax.fori_loop(0, n, body, (o0, lse0, k, v))
+    return o.astype(q.dtype)
 
 
 def ulysses_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
@@ -163,7 +234,7 @@ def _wrap(shard_fn, q, k, v, *, mesh, axis_name, seq_specs, **kw):
 
 
 def ring_attention(q, k, v, *, mesh, axis_name="sp", seq_specs=None,
-                   causal=True, scale=None):
+                   causal=True, scale=None, use_flash=False):
     """Global-array convenience wrapper: shard_map + `ring_attention_shard`.
 
     ``seq_specs`` is the PartitionSpec of q/k/v (default: batch over 'dp' if
@@ -173,7 +244,7 @@ def ring_attention(q, k, v, *, mesh, axis_name="sp", seq_specs=None,
         seq_specs = _default_specs(mesh, axis_name)
     return _wrap(ring_attention_shard, q, k, v, mesh=mesh,
                  axis_name=axis_name, seq_specs=seq_specs,
-                 causal=causal, scale=scale)
+                 causal=causal, scale=scale, use_flash=use_flash)
 
 
 def ulysses_attention(q, k, v, *, mesh, axis_name="sp", seq_specs=None,

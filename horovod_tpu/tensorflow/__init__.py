@@ -475,9 +475,9 @@ class _DistributedOptimizer:
             # The helper's allreduce closure captures the per-variable
             # names from the call that BUILT it; a later call with a
             # same-length but different variable list would silently
-            # reuse names keyed to the old variables.
-            var_keys = [v.ref() if hasattr(v, "ref") else id(v)
-                        for v in variables]
+            # reuse names keyed to the old variables. Strong references +
+            # identity comparison (never id(): reuse after GC could
+            # false-negative; never ==: tf overloads it elementwise).
             if self._graph_agg is None:
                 self._graph_agg = LocalGradientAggregationHelper(
                     self.backward_passes_per_step,
@@ -488,8 +488,10 @@ class _DistributedOptimizer:
                         process_set=self._process_set,
                         name_prefix="DistributedOptimizer", names=names),
                     average_aggregated_gradients=self._average_aggregated)
-                self._graph_agg_var_keys = var_keys
-            elif var_keys != self._graph_agg_var_keys:
+                self._graph_agg_var_keys = list(variables)
+            elif (len(variables) != len(self._graph_agg_var_keys)
+                  or any(a is not b for a, b in
+                         zip(variables, self._graph_agg_var_keys))):
                 raise ValueError(
                     "apply_gradients called with a different variable "
                     "list than the in-graph gradient aggregation was "
