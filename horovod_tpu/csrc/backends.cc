@@ -74,6 +74,49 @@ void CollectiveBackend::AlltoallvMatrix(
   Alltoallv(in, send_rows, row_bytes, out, recv_rows);
 }
 
+void CollectiveBackend::AllreduceGroup(void*, int64_t, DataType,
+                                       ReduceKind,
+                                       const std::vector<int>&) {
+  throw std::runtime_error(std::string("hvt backend '") + Name() +
+                           "' does not implement subset allreduce");
+}
+
+void CollectiveBackend::AllgathervGroup(const void*, int64_t,
+                                        const std::vector<int64_t>&,
+                                        int64_t, void*,
+                                        const std::vector<int>&) {
+  throw std::runtime_error(std::string("hvt backend '") + Name() +
+                           "' does not implement subset allgather");
+}
+
+void CollectiveBackend::BroadcastGroup(void*, int64_t, int,
+                                       const std::vector<int>&) {
+  throw std::runtime_error(std::string("hvt backend '") + Name() +
+                           "' does not implement subset broadcast");
+}
+
+void CollectiveBackend::AlltoallvMatrixGroup(const void*,
+                                             const std::vector<int64_t>&,
+                                             int, int64_t, void*, int,
+                                             const std::vector<int>&) {
+  throw std::runtime_error(std::string("hvt backend '") + Name() +
+                           "' does not implement subset alltoall");
+}
+
+void CollectiveBackend::ReduceScatter(void* buf, int64_t count,
+                                      DataType dtype, ReduceKind red,
+                                      int my_pos, int m,
+                                      const std::vector<int>& group,
+                                      bool full_world) {
+  // default lowering: full allreduce; the caller slices chunk my_pos
+  (void)my_pos;
+  (void)m;
+  if (full_world)
+    Allreduce(buf, count, dtype, red);
+  else
+    AllreduceGroup(buf, count, dtype, red, group);
+}
+
 void RingBackend::Allreduce(void* buf, int64_t count, DataType dtype,
                             ReduceKind red) {
   dp_->Allreduce(buf, count, dtype, red);
@@ -96,16 +139,52 @@ void RingBackend::Alltoallv(const void* in,
   dp_->Alltoallv(in, send_rows, row_bytes, out, recv_rows);
 }
 
+void RingBackend::AllreduceGroup(void* buf, int64_t count, DataType dtype,
+                                 ReduceKind red,
+                                 const std::vector<int>& group) {
+  dp_->AllreduceGroup(buf, count, dtype, red, group);
+}
+
+void RingBackend::AllgathervGroup(const void* in, int64_t my_rows,
+                                  const std::vector<int64_t>& rows,
+                                  int64_t row_bytes, void* out,
+                                  const std::vector<int>& group) {
+  dp_->AllgathervGroup(in, my_rows, rows, row_bytes, out, group);
+}
+
+void RingBackend::BroadcastGroup(void* buf, int64_t bytes, int root,
+                                 const std::vector<int>& group) {
+  dp_->BroadcastGroup(buf, bytes, root, group);
+}
+
+void RingBackend::AlltoallvMatrixGroup(const void* in,
+                                       const std::vector<int64_t>& rows_flat,
+                                       int m, int64_t row_bytes, void* out,
+                                       int my_pos,
+                                       const std::vector<int>& group) {
+  std::vector<int64_t> send_rows(m, 0), recv_rows(m, 0);
+  for (int d = 0; d < m; ++d)
+    send_rows[d] = rows_flat[static_cast<size_t>(my_pos) * m + d];
+  for (int s = 0; s < m; ++s)
+    recv_rows[s] = rows_flat[static_cast<size_t>(s) * m + my_pos];
+  dp_->AlltoallvGroup(in, send_rows, row_bytes, out, recv_rows, group);
+}
+
 // ---------------------------------------------------------------- shm
 
 namespace {
-// segment header, one cache line: sense-reversing barrier state
-struct ShmHeader {
-  std::atomic<uint32_t> arrive;
-  std::atomic<uint32_t> gen;
+// One progress word per rank, one cache line each. A rank publishes
+// (response_seq << 3 | phase) into ITS OWN word; barrier waiters compare
+// co-members' words against that value. Values are strictly monotonic
+// per writer (the engine's response sequence is a single global stream),
+// so a non-member rank that skipped a response and ran ahead can never
+// corrupt an in-flight group's barrier — its word only ever proves MORE
+// progress, and nobody waits on non-members.
+struct ShmProgress {
+  std::atomic<uint64_t> v;
   uint8_t pad[56];
 };
-constexpr size_t kShmHeader = sizeof(ShmHeader);
+static_assert(sizeof(ShmProgress) == 64, "one cache line per rank");
 }  // namespace
 
 ShmLocalBackend::ShmLocalBackend(DataPlane* dp, int rank, int size,
@@ -117,7 +196,10 @@ ShmLocalBackend::ShmLocalBackend(DataPlane* dp, int rank, int size,
   if (!enabled || size < 2) return;
   char name[64];
   snprintf(name, sizeof(name), "/hvt_shm_%d", shm_key);
-  map_bytes_ = kShmHeader + static_cast<size_t>(capacity_) * (size_ + 1);
+  hdr_bytes_ = sizeof(ShmProgress) * static_cast<size_t>(size_);
+  map_bytes_ = hdr_bytes_ + static_cast<size_t>(capacity_) * (size_ + 1);
+  world_group_.resize(size_);
+  for (int i = 0; i < size_; ++i) world_group_[i] = i;
   try {
     int fd = -1;
     uint8_t sync = 0;
@@ -164,26 +246,29 @@ ShmLocalBackend::~ShmLocalBackend() {
   if (base_) munmap(base_, map_bytes_);
 }
 
-uint8_t* ShmLocalBackend::result() const { return base_ + kShmHeader; }
+uint8_t* ShmLocalBackend::result() const { return base_ + hdr_bytes_; }
 
 uint8_t* ShmLocalBackend::slot(int r) const {
-  return base_ + kShmHeader + static_cast<size_t>(capacity_) * (1 + r);
+  return base_ + hdr_bytes_ + static_cast<size_t>(capacity_) * (1 + r);
 }
 
-void ShmLocalBackend::Barrier() {
-  auto* h = reinterpret_cast<ShmHeader*>(base_);
-  uint32_t g = h->gen.load(std::memory_order_acquire);
-  if (h->arrive.fetch_add(1, std::memory_order_acq_rel) + 1 ==
-      static_cast<uint32_t>(size_)) {
-    h->arrive.store(0, std::memory_order_relaxed);
-    h->gen.fetch_add(1, std::memory_order_acq_rel);
-  } else {
+void ShmLocalBackend::BeginResponse(uint64_t seq) {
+  seq_ = seq;
+  phase_ = 0;
+}
+
+void ShmLocalBackend::Barrier(const std::vector<int>& group) {
+  const uint64_t val = (seq_ << 3) | static_cast<uint64_t>(++phase_);
+  auto* words = reinterpret_cast<ShmProgress*>(base_);
+  words[rank_].v.store(val, std::memory_order_release);
+  for (int g : group) {
+    if (g == rank_) continue;
     // brief spin for the common in-step case, then sleep-wait: ranks
     // skewed by compute must not burn a core the computing rank needs
     // (TCP recv would have slept in the kernel)
     int spins = 0;
     struct timespec nap = {0, 50'000};  // 50 µs
-    while (h->gen.load(std::memory_order_acquire) == g) {
+    while (words[g].v.load(std::memory_order_acquire) < val) {
       if (++spins < 512)
         sched_yield();
       else
@@ -192,43 +277,57 @@ void ShmLocalBackend::Barrier() {
   }
 }
 
+void ShmLocalBackend::LogSubsetOnce(const std::vector<int>& group) {
+  if (!subset_logged_) {
+    subset_logged_ = true;
+    HVT_LOG(DEBUG, rank_) << "shm subset collective engaged ("
+                          << group.size() << " members)";
+  }
+}
+
 bool ShmLocalBackend::Enabled(const Response& resp,
                               int64_t total_elems) const {
-  // full-world only: slots are addressed by global rank and the barrier
-  // counts every rank — a subset response must never land here even if
-  // a future call site forgets its members.empty() guard
-  if (!enabled_ || resp.kind != Response::Kind::TENSOR ||
-      !resp.members.empty())
-    return false;
+  if (!enabled_ || resp.kind != Response::Kind::TENSOR) return false;
+  // subsets are served too (per-group barrier cells, direct slot reads);
+  // members must be valid ranks of this single-host world
+  const int m = resp.members.empty() ? size_
+                                     : static_cast<int>(resp.members.size());
+  if (!resp.members.empty()) {
+    if (m < 2) return false;
+    for (auto r : resp.members)
+      if (r < 0 || r >= size_) return false;
+  }
   const int64_t el = static_cast<int64_t>(DataTypeSize(resp.dtype));
   if (resp.op == OpType::ALLGATHER) {
-    // every rank's contribution must fit its slot (rows may be uneven)
-    if (resp.rows_flat.size() < static_cast<size_t>(size_) ||
+    // every participant's contribution must fit its slot (uneven rows;
+    // rows_flat indexed by group position)
+    if (resp.rows_flat.size() < static_cast<size_t>(m) ||
         resp.trailing <= 0)
       return false;
     int64_t mx = 0;
-    for (int r = 0; r < size_; ++r)
+    for (int r = 0; r < m; ++r)
       mx = std::max(mx, resp.rows_flat[r]);
     return mx * resp.trailing * el <= capacity_;
   }
   if (resp.op == OpType::ALLTOALL) {
-    // every sender's full send buffer must fit its slot
+    // every sender's full send buffer must fit its slot (m x m
+    // position-major row matrix)
     if (resp.rows_flat.size() <
-            static_cast<size_t>(size_) * static_cast<size_t>(size_) ||
+            static_cast<size_t>(m) * static_cast<size_t>(m) ||
         resp.trailing <= 0)
       return false;
     int64_t mx = 0;
-    for (int s = 0; s < size_; ++s) {
+    for (int s = 0; s < m; ++s) {
       int64_t tot = 0;
-      for (int d = 0; d < size_; ++d)
-        tot += resp.rows_flat[static_cast<size_t>(s) * size_ + d];
+      for (int d = 0; d < m; ++d)
+        tot += resp.rows_flat[static_cast<size_t>(s) * m + d];
       mx = std::max(mx, tot);
     }
     return mx * resp.trailing * el <= capacity_;
   }
   if (total_elems <= 0 || total_elems * el > capacity_) return false;
   if (resp.op == OpType::ALLREDUCE || resp.op == OpType::REDUCESCATTER)
-    // reducescatter lowers to allreduce + local slice at the engine
+    // reducescatter runs natively (chunk reduce straight from slots)
     return resp.reduce != ReduceKind::ADASUM;
   return resp.op == OpType::BROADCAST;
 }
@@ -243,7 +342,7 @@ void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
   const size_t el = DataTypeSize(dtype);
   const size_t bytes = static_cast<size_t>(count) * el;
   memcpy(slot(rank_), buf, bytes);
-  Barrier();  // all contributions visible
+  Barrier(world_group_);  // all contributions visible
   // parallel reduce-scatter in memory: rank i combines chunk i of every
   // slot into the shared result area
   int64_t lo = count * rank_ / size_;
@@ -254,9 +353,9 @@ void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
     for (int r = 1; r < size_; ++r)
       ReduceInto(dst, slot(r) + lo * el, hi - lo, dtype, red);
   }
-  Barrier();  // result complete
+  Barrier(world_group_);  // result complete
   memcpy(buf, result(), bytes);
-  Barrier();  // everyone has read; slots/result reusable next op
+  Barrier(world_group_);  // everyone has read; slots/result reusable next op
 }
 
 void ShmLocalBackend::Allgatherv(const void* in, int64_t my_rows,
@@ -267,7 +366,7 @@ void ShmLocalBackend::Allgatherv(const void* in, int64_t my_rows,
     HVT_LOG(DEBUG, rank_) << "shm allgather engaged";
   }
   memcpy(slot(rank_), in, static_cast<size_t>(my_rows * row_bytes));
-  Barrier();  // all contributions visible
+  Barrier(world_group_);  // all contributions visible
   auto* dst = static_cast<uint8_t*>(out);
   size_t off = 0;
   for (int r = 0; r < size_; ++r) {
@@ -275,7 +374,7 @@ void ShmLocalBackend::Allgatherv(const void* in, int64_t my_rows,
     memcpy(dst + off, slot(r), nb);
     off += nb;
   }
-  Barrier();  // reads done; slots reusable by the next op
+  Barrier(world_group_);  // reads done; slots reusable by the next op
 }
 
 void ShmLocalBackend::AlltoallvMatrix(const void* in,
@@ -287,25 +386,7 @@ void ShmLocalBackend::AlltoallvMatrix(const void* in,
     a2a_logged_ = true;
     HVT_LOG(DEBUG, rank_) << "shm alltoall engaged";
   }
-  int64_t my_send = 0;
-  for (int d = 0; d < m; ++d)
-    my_send += rows_flat[static_cast<size_t>(rank_) * m + d];
-  memcpy(slot(rank_), in, static_cast<size_t>(my_send * row_bytes));
-  Barrier();  // all send buffers visible
-  auto* dst = static_cast<uint8_t*>(out);
-  size_t off = 0;
-  for (int s = 0; s < m; ++s) {
-    // sender s's slot holds its destinations in position order; my
-    // segment starts after everything addressed to positions < me
-    int64_t pre = 0;
-    for (int d = 0; d < rank_; ++d)
-      pre += rows_flat[static_cast<size_t>(s) * m + d];
-    size_t nb = static_cast<size_t>(
-        rows_flat[static_cast<size_t>(s) * m + rank_] * row_bytes);
-    memcpy(dst + off, slot(s) + pre * row_bytes, nb);
-    off += nb;
-  }
-  Barrier();  // reads done; slots reusable
+  A2aFromSlots(in, rows_flat, m, row_bytes, out, rank_, world_group_);
 }
 
 void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {
@@ -319,9 +400,119 @@ void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {
   // previous op's readers are done (this op's trailing barrier plays
   // that role for the next one).
   if (rank_ == root) memcpy(result(), buf, static_cast<size_t>(bytes));
-  Barrier();
+  Barrier(world_group_);
   if (rank_ != root) memcpy(buf, result(), static_cast<size_t>(bytes));
-  Barrier();
+  Barrier(world_group_);
+}
+
+// ---- subset ops: per-group barrier cell (lowest member), direct peer
+// slot reads, NO shared result area — disjoint groups run concurrently.
+
+void ShmLocalBackend::AllreduceGroup(void* buf, int64_t count,
+                                     DataType dtype, ReduceKind red,
+                                     const std::vector<int>& group) {
+  LogSubsetOnce(group);
+  const size_t el = DataTypeSize(dtype);
+  const size_t bytes = static_cast<size_t>(count) * el;
+  memcpy(slot(rank_), buf, bytes);
+  Barrier(group);  // all member contributions visible
+  // every member reduces in the SAME slot order → bitwise-identical
+  // results across the group
+  memcpy(buf, slot(group[0]), bytes);
+  for (size_t i = 1; i < group.size(); ++i)
+    ReduceInto(buf, slot(group[i]), count, dtype, red);
+  Barrier(group);  // reads done; slots reusable
+}
+
+void ShmLocalBackend::BroadcastGroup(void* buf, int64_t bytes, int root,
+                                     const std::vector<int>& group) {
+  LogSubsetOnce(group);
+  if (rank_ == root)
+    memcpy(slot(rank_), buf, static_cast<size_t>(bytes));
+  Barrier(group);
+  if (rank_ != root)
+    memcpy(buf, slot(root), static_cast<size_t>(bytes));
+  Barrier(group);
+}
+
+void ShmLocalBackend::AllgathervGroup(const void* in, int64_t my_rows,
+                                      const std::vector<int64_t>& rows,
+                                      int64_t row_bytes, void* out,
+                                      const std::vector<int>& group) {
+  LogSubsetOnce(group);
+  memcpy(slot(rank_), in, static_cast<size_t>(my_rows * row_bytes));
+  Barrier(group);
+  auto* dst = static_cast<uint8_t*>(out);
+  size_t off = 0;
+  for (size_t i = 0; i < group.size(); ++i) {
+    size_t nb = static_cast<size_t>(rows[i] * row_bytes);
+    memcpy(dst + off, slot(group[i]), nb);
+    off += nb;
+  }
+  Barrier(group);
+}
+
+void ShmLocalBackend::AlltoallvMatrixGroup(
+    const void* in, const std::vector<int64_t>& rows_flat, int m,
+    int64_t row_bytes, void* out, int my_pos,
+    const std::vector<int>& group) {
+  LogSubsetOnce(group);
+  A2aFromSlots(in, rows_flat, m, row_bytes, out, my_pos, group);
+}
+
+void ShmLocalBackend::A2aFromSlots(const void* in,
+                                   const std::vector<int64_t>& rows_flat,
+                                   int m, int64_t row_bytes, void* out,
+                                   int my_pos,
+                                   const std::vector<int>& group) {
+  int64_t my_send = 0;
+  for (int d = 0; d < m; ++d)
+    my_send += rows_flat[static_cast<size_t>(my_pos) * m + d];
+  memcpy(slot(rank_), in, static_cast<size_t>(my_send * row_bytes));
+  Barrier(group);  // all send buffers visible
+  auto* dst = static_cast<uint8_t*>(out);
+  size_t off = 0;
+  for (int s = 0; s < m; ++s) {
+    // sender s's slot holds destinations in position order; my segment
+    // starts after everything addressed to positions < mine
+    int64_t pre = 0;
+    for (int d = 0; d < my_pos; ++d)
+      pre += rows_flat[static_cast<size_t>(s) * m + d];
+    size_t nb = static_cast<size_t>(
+        rows_flat[static_cast<size_t>(s) * m + my_pos] * row_bytes);
+    memcpy(dst + off, slot(group[s]) + pre * row_bytes, nb);
+    off += nb;
+  }
+  Barrier(group);  // reads done; slots reusable
+}
+
+void ShmLocalBackend::ReduceScatter(void* buf, int64_t count,
+                                    DataType dtype, ReduceKind red,
+                                    int my_pos, int m,
+                                    const std::vector<int>& group,
+                                    bool full_world) {
+  // native chunk reduce: each participant combines ONLY its own chunk
+  // straight from the member slots — reads count bytes/rank where the
+  // allreduce lowering reads ~2x and writes the full result
+  (void)full_world;  // group always lists every participant
+  if (!rs_logged_) {
+    rs_logged_ = true;
+    HVT_LOG(DEBUG, rank_) << "shm reducescatter engaged (native chunk "
+                          << "reduce, " << m << " participants)";
+  }
+  const size_t el = DataTypeSize(dtype);
+  memcpy(slot(rank_), buf, static_cast<size_t>(count) * el);
+  Barrier(group);  // all contributions visible
+  const int64_t lo = count * my_pos / m;
+  const int64_t hi = count * (my_pos + 1) / m;
+  if (hi > lo) {
+    uint8_t* dst = static_cast<uint8_t*>(buf) + lo * el;
+    memcpy(dst, slot(group[0]) + lo * el,
+           static_cast<size_t>(hi - lo) * el);
+    for (int i = 1; i < m; ++i)
+      ReduceInto(dst, slot(group[i]) + lo * el, hi - lo, dtype, red);
+  }
+  Barrier(group);  // reads done; slots reusable
 }
 
 bool HierarchicalBackend::Enabled(const Response& resp,

@@ -43,6 +43,9 @@ class CollectiveBackend {
   virtual ~CollectiveBackend() = default;
   virtual const char* Name() const = 0;
   // total_elems: summed numels of the (possibly fused) response.
+  // resp.members non-empty = process-subset response; a backend that
+  // accepts one must implement the *Group methods below (the reference
+  // serves every op from the selected backend — operation_manager.cc).
   virtual bool Enabled(const Response& resp, int64_t total_elems) const = 0;
   virtual void Allreduce(void* buf, int64_t count, DataType dtype,
                          ReduceKind red) = 0;
@@ -61,6 +64,38 @@ class CollectiveBackend {
                                const std::vector<int64_t>& rows_flat,
                                int m, int64_t row_bytes, void* out,
                                int my_pos);
+
+  // ---- process-subset variants (group: ascending global ranks,
+  // containing this rank; rows/positions indexed by group position) ----
+  virtual void AllreduceGroup(void* buf, int64_t count, DataType dtype,
+                              ReduceKind red,
+                              const std::vector<int>& group);
+  virtual void AllgathervGroup(const void* in, int64_t my_rows,
+                               const std::vector<int64_t>& rows,
+                               int64_t row_bytes, void* out,
+                               const std::vector<int>& group);
+  virtual void BroadcastGroup(void* buf, int64_t bytes, int root,
+                              const std::vector<int>& group);
+  virtual void AlltoallvMatrixGroup(const void* in,
+                                    const std::vector<int64_t>& rows_flat,
+                                    int m, int64_t row_bytes, void* out,
+                                    int my_pos,
+                                    const std::vector<int>& group);
+  // Reduce-scatter: leave THIS rank's chunk
+  // [count*my_pos/m, count*(my_pos+1)/m) of buf reduced across the
+  // participants (other regions of buf may stay stale — the engine
+  // slices only the chunk). Default lowers to a full allreduce; the shm
+  // backend overrides with a native chunk reduce.
+  virtual void ReduceScatter(void* buf, int64_t count, DataType dtype,
+                             ReduceKind red, int my_pos, int m,
+                             const std::vector<int>& group,
+                             bool full_world);
+
+  // Called by the engine before dispatching each TENSOR response, with a
+  // GLOBAL response sequence number (identical stream on every rank).
+  // Synchronization keyed to it stays sound even when non-member ranks
+  // skip responses and run ahead.
+  virtual void BeginResponse(uint64_t seq) { (void)seq; }
 };
 
 // Flat TCP ring over the full mesh — always enabled (the fallback).
@@ -78,6 +113,18 @@ class RingBackend : public CollectiveBackend {
   void Alltoallv(const void* in, const std::vector<int64_t>& send_rows,
                  int64_t row_bytes, void* out,
                  const std::vector<int64_t>& recv_rows) override;
+  void AllreduceGroup(void* buf, int64_t count, DataType dtype,
+                      ReduceKind red,
+                      const std::vector<int>& group) override;
+  void AllgathervGroup(const void* in, int64_t my_rows,
+                       const std::vector<int64_t>& rows, int64_t row_bytes,
+                       void* out, const std::vector<int>& group) override;
+  void BroadcastGroup(void* buf, int64_t bytes, int root,
+                      const std::vector<int>& group) override;
+  void AlltoallvMatrixGroup(const void* in,
+                            const std::vector<int64_t>& rows_flat, int m,
+                            int64_t row_bytes, void* out, int my_pos,
+                            const std::vector<int>& group) override;
 
  private:
   DataPlane* dp_;
@@ -89,12 +136,15 @@ class RingBackend : public CollectiveBackend {
 // chunk across all slots (parallel reduce-scatter in memory), and all
 // ranks copy the combined result out — no sockets at all on the hot
 // path, where the flat ring pays 2(N-1)/N of the payload through
-// loopback TCP. Enabled for non-Adasum allreduces AND full-world
-// broadcasts (write-once-read-many) that fit the preallocated capacity
-// when every rank shares one host; HVT_SHM_ALLREDUCE=0 disables the
-// whole shm plane. The segment name is derived from the
-// control-star port and unlinked as soon as every rank has mapped it,
-// so crashed jobs never leak segments.
+// loopback TCP. Enabled for non-Adasum allreduces, broadcasts
+// (write-once-read-many), allgathers, alltoalls, and native
+// reduce-scatters that fit the preallocated capacity when every rank
+// shares one host — full world AND process subsets (subset ops use
+// per-group barrier cells and read peer slots directly, so disjoint
+// subsets run concurrently without touching the shared result area).
+// HVT_SHM_ALLREDUCE=0 disables the whole shm plane. The segment name is
+// derived from the control-star port and unlinked as soon as every rank
+// has mapped it, so crashed jobs never leak segments.
 class ShmLocalBackend : public CollectiveBackend {
  public:
   // dp: used once at construction to sequence create-before-open across
@@ -113,9 +163,36 @@ class ShmLocalBackend : public CollectiveBackend {
   void AlltoallvMatrix(const void* in,
                        const std::vector<int64_t>& rows_flat, int m,
                        int64_t row_bytes, void* out, int my_pos) override;
+  void AllreduceGroup(void* buf, int64_t count, DataType dtype,
+                      ReduceKind red,
+                      const std::vector<int>& group) override;
+  void AllgathervGroup(const void* in, int64_t my_rows,
+                       const std::vector<int64_t>& rows, int64_t row_bytes,
+                       void* out, const std::vector<int>& group) override;
+  void BroadcastGroup(void* buf, int64_t bytes, int root,
+                      const std::vector<int>& group) override;
+  void AlltoallvMatrixGroup(const void* in,
+                            const std::vector<int64_t>& rows_flat, int m,
+                            int64_t row_bytes, void* out, int my_pos,
+                            const std::vector<int>& group) override;
+  void ReduceScatter(void* buf, int64_t count, DataType dtype,
+                     ReduceKind red, int my_pos, int m,
+                     const std::vector<int>& group,
+                     bool full_world) override;
+  void BeginResponse(uint64_t seq) override;
 
  private:
-  void Barrier();
+  // Group barrier via per-rank PROGRESS WORDS: each member publishes
+  // (response seq << 3 | phase) into its own word and waits until every
+  // co-member's word reaches that value. No shared counters, so a rank
+  // that skipped this response and ran ahead into a later collective can
+  // never pollute another group's barrier (values are monotonic per
+  // writer; a co-member's larger value proves it already passed here).
+  void Barrier(const std::vector<int>& group);
+  void LogSubsetOnce(const std::vector<int>& group);
+  void A2aFromSlots(const void* in, const std::vector<int64_t>& rows_flat,
+                    int m, int64_t row_bytes, void* out, int my_pos,
+                    const std::vector<int>& group);
   uint8_t* slot(int r) const;
   uint8_t* result() const;
 
@@ -126,8 +203,14 @@ class ShmLocalBackend : public CollectiveBackend {
   bool bcast_logged_ = false;
   bool gather_logged_ = false;
   bool a2a_logged_ = false;
+  bool subset_logged_ = false;
+  bool rs_logged_ = false;
   uint8_t* base_ = nullptr;
   size_t map_bytes_ = 0;
+  size_t hdr_bytes_ = 0;
+  uint64_t seq_ = 0;      // current response sequence (BeginResponse)
+  uint32_t phase_ = 0;    // barrier index within the current response
+  std::vector<int> world_group_;
 };
 
 // Local reduce-scatter → cross-host allreduce → local allgather.

@@ -126,6 +126,10 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   backends_.push_back(std::make_unique<HierarchicalBackend>(
       data_.get(), topo_, hier_on));
   backends_.push_back(std::make_unique<RingBackend>(data_.get()));
+  // must restart from the same value on every rank — an elastic re-init
+  // mixes survivors with fresh workers, and the shm barrier words are
+  // keyed to this sequence
+  resp_seq_ = 0;
   rank_joined_.assign(size_, false);
   rank_shutdown_.assign(size_, false);
   hit_pending_.assign(size_, {});
@@ -1072,6 +1076,11 @@ void Engine::ExecuteResponse(const Response& resp,
       break;
   }
 
+  // Global response sequence: identical on every rank (one coordinated
+  // response stream), advanced for every TENSOR response INCLUDING ones
+  // this rank skips — the shm plane keys its progress-word barriers to it
+  ++resp_seq_;
+
   // process-set participants (the whole world when members is empty);
   // non-member ranks skip the response — they are not in the sub-rings
   std::vector<int> grp;
@@ -1159,12 +1168,19 @@ void Engine::ExecuteResponse(const Response& resp,
       if (resp.prescale != 1.0)
         ScaleBuffer(fusion_buffer_.data(), total, resp.dtype,
                     resp.prescale);
-      if (resp.members.empty()) {
-        PickBackend(resp, total)->Allreduce(fusion_buffer_.data(), total,
-                                            resp.dtype, resp.reduce);
-      } else {
-        data_->AllreduceGroup(fusion_buffer_.data(), total, resp.dtype,
-                              resp.reduce, grp);
+      {
+        // subset responses route through the backend list too (shm serves
+        // them via per-group barrier cells; ring is the fallback) — the
+        // reference serves every op from the selected backend
+        // (operation_manager.cc)
+        auto* be = PickBackend(resp, total);
+        be->BeginResponse(resp_seq_);
+        if (resp.members.empty())
+          be->Allreduce(fusion_buffer_.data(), total, resp.dtype,
+                        resp.reduce);
+        else
+          be->AllreduceGroup(fusion_buffer_.data(), total, resp.dtype,
+                             resp.reduce, grp);
       }
       double post = resp.postscale;
       if (resp.reduce == ReduceKind::AVERAGE) post /= m;
@@ -1207,13 +1223,16 @@ void Engine::ExecuteResponse(const Response& resp,
       std::vector<uint8_t> out(static_cast<size_t>(total_rows) * row_bytes);
       const void* in = e ? static_cast<const void*>(e->input.data())
                          : static_cast<const void*>(out.data());
-      if (resp.members.empty())
-        // full world: backend list applies (shm single-copy concat)
-        PickBackend(resp, total_rows * resp.trailing)
-            ->Allgatherv(in, my_rows, rows, row_bytes, out.data());
-      else
-        data_->AllgathervGroup(in, my_rows, rows, row_bytes, out.data(),
-                               grp);
+      {
+        auto* be = PickBackend(resp, total_rows * resp.trailing);
+        be->BeginResponse(resp_seq_);
+        if (resp.members.empty())
+          // full world: shm single-copy concat from slots
+          be->Allgatherv(in, my_rows, rows, row_bytes, out.data());
+        else
+          be->AllgathervGroup(in, my_rows, rows, row_bytes, out.data(),
+                              grp);
+      }
       if (e) {
         e->output = std::move(out);
         e->recv_splits = rows;
@@ -1227,15 +1246,18 @@ void Engine::ExecuteResponse(const Response& resp,
       size_t bytes = static_cast<size_t>(resp.numels[0]) * el;
       std::vector<uint8_t> buf(bytes, 0);
       if (e) memcpy(buf.data(), e->input.data(), bytes);
-      if (resp.members.empty())
-        // full world: backend list applies (shm write-once-read-many
-        // beats the TCP star for model-sized payloads)
-        PickBackend(resp, resp.numels[0])
-            ->Broadcast(buf.data(), static_cast<int64_t>(bytes),
+      {
+        auto* be = PickBackend(resp, resp.numels[0]);
+        be->BeginResponse(resp_seq_);
+        if (resp.members.empty())
+          // full world: shm write-once-read-many beats the TCP star for
+          // model-sized payloads
+          be->Broadcast(buf.data(), static_cast<int64_t>(bytes),
                         resp.root);
-      else
-        data_->BroadcastGroup(buf.data(), static_cast<int64_t>(bytes),
-                              resp.root, grp);
+        else
+          be->BroadcastGroup(buf.data(), static_cast<int64_t>(bytes),
+                             resp.root, grp);
+      }
       if (e) {
         e->output = std::move(buf);
         CompleteEntry(e, Status::OK());
@@ -1259,13 +1281,16 @@ void Engine::ExecuteResponse(const Response& resp,
       std::vector<uint8_t> out(static_cast<size_t>(total_recv) * row_bytes);
       const void* in = e ? static_cast<const void*>(e->input.data())
                          : static_cast<const void*>(out.data());
-      if (resp.members.empty())
-        PickBackend(resp, total_recv * resp.trailing)
-            ->AlltoallvMatrix(in, resp.rows_flat, m, row_bytes,
+      {
+        auto* be = PickBackend(resp, total_recv * resp.trailing);
+        be->BeginResponse(resp_seq_);
+        if (resp.members.empty())
+          be->AlltoallvMatrix(in, resp.rows_flat, m, row_bytes,
                               out.data(), my_pos);
-      else
-        data_->AlltoallvGroup(in, send_rows, row_bytes, out.data(),
-                              recv_rows, grp);
+        else
+          be->AlltoallvMatrixGroup(in, resp.rows_flat, m, row_bytes,
+                                   out.data(), my_pos, grp);
+      }
       if (e) {
         e->output = std::move(out);
         e->recv_splits = recv_rows;
@@ -1284,15 +1309,21 @@ void Engine::ExecuteResponse(const Response& resp,
       ReduceKind rk = resp.reduce == ReduceKind::AVERAGE
                           ? ReduceKind::SUM
                           : resp.reduce;
-      if (resp.members.empty())
-        PickBackend(resp, numel)->Allreduce(buf.data(), numel,
-                                            resp.dtype, rk);
-      else
-        data_->AllreduceGroup(buf.data(), numel, resp.dtype, rk, grp);
+      // backend-native reduce-scatter: only this rank's chunk of buf is
+      // guaranteed reduced afterwards (the slice below reads just that);
+      // the default lowering is still a full allreduce
+      {
+        auto* be = PickBackend(resp, numel);
+        be->BeginResponse(resp_seq_);
+        be->ReduceScatter(buf.data(), numel, resp.dtype, rk, my_pos, m,
+                          grp, resp.members.empty());
+      }
       double rs_post = resp.postscale;
       if (resp.reduce == ReduceKind::AVERAGE) rs_post /= m;
       if (rs_post != 1.0)
-        ScaleBuffer(buf.data(), numel, resp.dtype, rs_post);
+        // only this rank's chunk is read below — scale just it
+        ScaleBuffer(buf.data() + (numel * my_pos / m) * el, numel / m,
+                    resp.dtype, rs_post);
       if (e) {
         int64_t rows = e->shape.dims.empty() ? 1 : e->shape.dims[0];
         int64_t row_bytes = (e->shape.num_elements() / rows) *

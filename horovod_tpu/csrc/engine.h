@@ -110,6 +110,9 @@ class Engine {
   Listener data_listener_;
   // ordered backend list (reference operations.cc:142-249); built at Init
   std::vector<std::unique_ptr<CollectiveBackend>> backends_;
+  // global TENSOR-response counter (identical stream on every rank);
+  // feeds CollectiveBackend::BeginResponse
+  uint64_t resp_seq_ = 0;
   Topology topo_;
 
   int rank_ = 0, size_ = 1;
