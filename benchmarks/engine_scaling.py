@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Eager data-plane scaling curve: allreduce latency/bandwidth vs process
+count, payload size, backend (shm vs ring), and cache state.
+
+The eager-plane analog of the reference's published scaling tables
+(``/root/reference/docs/benchmarks.rst:13-14`` — its whole pitch is
+fusion/cache behavior at scale). Results are committed to
+docs/performance.md; ``tests/test_engine_scaling.py`` pins the shm ≥ ring
+invariant at 16 MB.
+
+Run as a driver (spawns launcher jobs over the sweep):
+    python benchmarks/engine_scaling.py [--quick]
+Worker mode is selected internally via HVT_BENCH_WORKER.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+SIZES = {"4KB": 1 << 10 >> 2 << 2, "1MB": 1 << 18, "16MB": 1 << 22,
+         "64MB": 1 << 24}  # float32 element counts
+
+
+def worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvt
+
+    hvt.init()
+    r = hvt.rank()
+    sizes = json.loads(os.environ["HVT_BENCH_SIZES"])
+    iters = int(os.environ.get("HVT_BENCH_ITERS", "8"))
+    out = {}
+    for label, numel in sizes.items():
+        x = np.arange(numel, dtype=np.float32) % 1001 + r
+
+        # cold: first submission of each name pays a full negotiation
+        # round trip (no response-cache entry)
+        cold = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            hvt.allreduce(x, op=hvt.Sum, name=f"cold.{label}.{i}")
+            cold.append(time.perf_counter() - t0)
+
+        # hit: repeated name rides the position-synced cache fast path
+        hvt.allreduce(x, op=hvt.Sum, name=f"hot.{label}")  # prime
+        hot = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            res = hvt.allreduce(x, op=hvt.Sum, name=f"hot.{label}")
+            hot.append(time.perf_counter() - t0)
+        res = np.asarray(res)
+        expected = sum(np.arange(numel, dtype=np.float32) % 1001 + i
+                       for i in range(hvt.size()))
+        np.testing.assert_allclose(res, expected)
+        out[label] = {"cold_ms": round(float(np.median(cold)) * 1e3, 2),
+                      "hit_ms": round(float(np.median(hot)) * 1e3, 2)}
+    if r == 0:
+        print("HVT_BENCH_RESULT " + json.dumps(out), flush=True)
+
+
+def run_job(np_, shm, sizes, iters, repo):
+    env = dict(os.environ)
+    env.update({
+        "HVT_BENCH_WORKER": "1",
+        "HVT_BENCH_SIZES": json.dumps(sizes),
+        "HVT_BENCH_ITERS": str(iters),
+        "HVT_SHM_ALLREDUCE": "1" if shm else "0",
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np",
+         str(np_), sys.executable, os.path.abspath(__file__)],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=1200)
+    if proc.returncode != 0:
+        raise RuntimeError(f"np={np_} shm={shm} failed:\n"
+                           f"{proc.stdout}\n{proc.stderr}")
+    for line in proc.stdout.splitlines():
+        # launcher prefixes worker output with "[rank] "
+        if "HVT_BENCH_RESULT" in line:
+            return json.loads(line.split("HVT_BENCH_RESULT ", 1)[1])
+    raise RuntimeError(f"no result line:\n{proc.stdout}")
+
+
+def main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    quick = "--quick" in sys.argv
+    sizes = ({"4KB": 1024, "16MB": 1 << 22} if quick else
+             {k: v for k, v in SIZES.items()})
+    nps = [2, 4] if quick else [1, 2, 4, 8]
+    iters = 4 if quick else 8
+    rows = []
+    for np_ in nps:
+        for shm in ([True] if np_ == 1 else [True, False]):
+            res = run_job(np_, shm, sizes, iters, repo)
+            for label, v in res.items():
+                mb = SIZES[label] * 4 / (1 << 20)
+                hit_bw = mb / (v["hit_ms"] / 1e3) if v["hit_ms"] else 0
+                rows.append({"np": np_,
+                             "backend": "shm" if shm else "ring",
+                             "size": label, **v,
+                             "hit_MBps": round(hit_bw, 1)})
+                print(json.dumps(rows[-1]), flush=True)
+    print("\n| np | backend | size | cold ms | hit ms | hit MB/s |")
+    print("|---|---|---|---|---|---|")
+    for row in rows:
+        print(f"| {row['np']} | {row['backend']} | {row['size']} | "
+              f"{row['cold_ms']} | {row['hit_ms']} | {row['hit_MBps']} |")
+
+
+if __name__ == "__main__":
+    if os.environ.get("HVT_BENCH_WORKER"):
+        worker()
+    else:
+        main()

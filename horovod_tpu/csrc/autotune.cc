@@ -173,7 +173,8 @@ std::vector<double> BayesianOptimizer::Suggest(int candidates, int min_fit) {
 // ---------------------------------------------------- ParameterManager
 
 // tunable box: x0 = log2(fusion_threshold) in [20, 28] (1 MB..256 MB),
-// x1 = cycle_ms in [1, 25]
+// x1 = cycle_ms in [1, 25], x2 = cache enabled (>0.5), x3 = prefer the
+// flat ring over the priority backends (>0.5)
 static const double kLog2FusionMin = 20.0, kLog2FusionMax = 28.0;
 static const double kCycleMin = 1.0, kCycleMax = 25.0;
 
@@ -186,9 +187,11 @@ void ParameterManager::Initialize(int64_t fusion_threshold, int cycle_ms) {
   samples_ = 0;
   cycle_count_ = 0;
   bytes_acc_ = 0;
-  bo_ = BayesianOptimizer(2);
+  bo_ = BayesianOptimizer(4);
   fusion_threshold_ = fusion_threshold;
   cycle_ms_ = cycle_ms;
+  cache_enabled_ = true;
+  prefer_flat_ = false;
   active_ = EnvInt("HVT_AUTOTUNE", 0) != 0;
   warmup_remaining_ =
       static_cast<int>(EnvInt("HVT_AUTOTUNE_WARMUP_SAMPLES", 3));
@@ -205,7 +208,9 @@ std::vector<double> ParameterManager::CurrentPoint() const {
                kLog2FusionMin) / (kLog2FusionMax - kLog2FusionMin);
   double x1 = (cycle_ms_ - kCycleMin) / (kCycleMax - kCycleMin);
   return {std::min(1.0, std::max(0.0, x0)),
-          std::min(1.0, std::max(0.0, x1))};
+          std::min(1.0, std::max(0.0, x1)),
+          cache_enabled_ ? 1.0 : 0.0,
+          prefer_flat_ ? 1.0 : 0.0};
 }
 
 void ParameterManager::ApplyPoint(const std::vector<double>& x) {
@@ -214,14 +219,17 @@ void ParameterManager::ApplyPoint(const std::vector<double>& x) {
   cycle_ms_ = static_cast<int>(
       std::lround(kCycleMin + x[1] * (kCycleMax - kCycleMin)));
   if (cycle_ms_ < 1) cycle_ms_ = 1;
+  cache_enabled_ = x.size() > 2 ? x[2] > 0.5 : true;
+  prefer_flat_ = x.size() > 3 ? x[3] > 0.5 : false;
 }
 
 void ParameterManager::Log(double score) {
   if (log_path_.empty()) return;
   FILE* f = fopen(log_path_.c_str(), "a");
   if (!f) return;
-  fprintf(f, "%d,%lld,%d,%.1f\n", samples_.load(),
-          static_cast<long long>(fusion_threshold_), cycle_ms_, score);
+  fprintf(f, "%d,%lld,%d,%d,%d,%.1f\n", samples_.load(),
+          static_cast<long long>(fusion_threshold_), cycle_ms_,
+          cache_enabled_ ? 1 : 0, prefer_flat_ ? 1 : 0, score);
   fclose(f);
 }
 

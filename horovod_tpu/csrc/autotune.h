@@ -3,16 +3,25 @@
 // BayesianOptimization / GaussianProcessRegressor
 // (horovod/common/optim/bayesian_optimization.cc, gaussian_process.cc).
 //
-// Rank 0 tunes {fusion threshold, cycle time} by Bayesian optimization
-// (RBF-kernel Gaussian process + expected-improvement acquisition) over the
-// observed data-plane throughput (bytes/sec), discarding warmup samples.
-// The tuned fusion threshold applies coordinator-side only; the tuned cycle
-// time is broadcast to workers piggybacked on the per-cycle response frame
-// (the analog of Controller::SynchronizeParameters, controller.cc:39-53).
+// Rank 0 tunes {fusion threshold, cycle time, cache enabled, backend
+// preference} by Bayesian optimization (RBF-kernel Gaussian process +
+// expected-improvement acquisition) over the observed data-plane
+// throughput (bytes/sec), discarding warmup samples — the same four-knob
+// surface the reference ParameterManager tunes (parameter_manager.h:60-78:
+// fusion threshold, cycle time, cache enabled, hierarchical
+// allreduce/allgather; our backend-preference knob covers the
+// hierarchical/flat split). The tuned fusion threshold applies
+// coordinator-side only; cycle time and the cache/backend flags are
+// broadcast to workers piggybacked on the per-cycle response frame (the
+// analog of Controller::SynchronizeParameters, controller.cc:39-53) and
+// applied at the same frame boundary on every rank, so cache lookups and
+// backend picks never diverge.
 //
 // The reference maximizes EI with LBFGS over a vendored library; we use
-// deterministic random-candidate search, which for a 2-D box is equally
-// effective and dependency-free.
+// deterministic random-candidate search — dependency-free, and for this
+// low-dimensional box (2 continuous + 2 effectively-binary axes, where
+// EI is piecewise-flat and gradient search adds nothing) just as
+// effective at 512 candidates.
 #pragma once
 
 #include <atomic>
@@ -77,7 +86,9 @@ class BayesianOptimizer {
   double best_y_ = -1e300;
 };
 
-// Tunes fusion_threshold (log2-scaled, 1 MB..256 MB) and cycle_ms (1..25).
+// Tunes fusion_threshold (log2-scaled, 1 MB..256 MB), cycle_ms (1..25),
+// cache_enabled (response cache on/off), and prefer_flat (bypass the
+// shm/hierarchical priority backends for the flat ring).
 class ParameterManager {
  public:
   ParameterManager();
@@ -96,6 +107,8 @@ class ParameterManager {
 
   int64_t fusion_threshold() const { return fusion_threshold_; }
   int cycle_ms() const { return cycle_ms_; }
+  bool cache_enabled() const { return cache_enabled_; }
+  bool prefer_flat() const { return prefer_flat_; }
   int samples() const { return samples_; }
   double best_score() const { return bo_.best_y(); }
 
@@ -113,9 +126,11 @@ class ParameterManager {
   int max_samples_ = 20;
   std::string log_path_;
 
-  BayesianOptimizer bo_{2};
+  BayesianOptimizer bo_{4};
   int64_t fusion_threshold_ = 64 << 20;
   int cycle_ms_ = 2;
+  bool cache_enabled_ = true;
+  bool prefer_flat_ = false;
 
   int cycle_count_ = 0;
   int64_t bytes_acc_ = 0;

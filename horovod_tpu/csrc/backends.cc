@@ -517,7 +517,9 @@ void ShmLocalBackend::ReduceScatter(void* buf, int64_t count,
 
 bool HierarchicalBackend::Enabled(const Response& resp,
                                   int64_t total_elems) const {
-  // reducescatter lowers to a full allreduce at the engine, so the
+  // reducescatter reaches this backend through the default
+  // CollectiveBackend::ReduceScatter lowering (full allreduce; only the
+  // shm backend overrides it with a native chunk reduce), so the
   // hierarchical decomposition serves it identically
   return enabled_ &&
          (resp.op == OpType::ALLREDUCE ||

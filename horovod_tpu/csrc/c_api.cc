@@ -157,4 +157,12 @@ void hvt_autotune_state(long long* out4) {
   out4[3] = e.autotune().active() ? 1 : 0;
 }
 
+// Frame-synchronized tuned flags: bit0 = response cache enabled, bit1 =
+// flat-ring preference. Identical across ranks at any frame boundary —
+// tests allgather this to pin the broadcast.
+int hvt_engine_flags() {
+  auto& e = Engine::Get();
+  return (e.cache_enabled() ? 1 : 0) | (e.prefer_flat() ? 2 : 0);
+}
+
 }  // extern "C"

@@ -130,6 +130,10 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   // mixes survivors with fresh workers, and the shm barrier words are
   // keyed to this sequence
   resp_seq_ = 0;
+  cache_enabled_ = true;
+  prefer_flat_ = false;
+  tuned_cache_enabled_ = true;
+  tuned_prefer_flat_ = false;
   rank_joined_.assign(size_, false);
   rank_shutdown_.assign(size_, false);
   hit_pending_.assign(size_, {});
@@ -325,7 +329,8 @@ bool Engine::RunCycle() {
     // params are fully rank-symmetric. allgather/alltoall rows vary per
     // call and per rank; grouped tensors renegotiate as an atomic unit;
     // process-set responses carry membership the cache does not key on.
-    int32_t pos = (e->op == OpType::ALLREDUCE && e->group_id < 0 &&
+    int32_t pos = (cache_enabled_.load() &&
+                   e->op == OpType::ALLREDUCE && e->group_id < 0 &&
                    e->members.empty())
                       ? cache_.Lookup(r)
                       : ResponseCache::kMiss;
@@ -370,12 +375,19 @@ bool Engine::RunCycle() {
     // evictions gathered by Coordinate into pending_evictions_
     Writer out;
     out.u8(resp_flags);
-    // broadcast the (possibly autotuned) cycle time — the analog of
-    // Controller::SynchronizeParameters (controller.cc:39-53)
+    // broadcast the (possibly autotuned) cycle time and cache/backend
+    // flags — the analog of Controller::SynchronizeParameters
+    // (controller.cc:39-53). The flags apply on every rank at THIS frame
+    // boundary (rank 0 below, workers on receipt), so the next cycle's
+    // cache lookups and this cycle's backend picks stay rank-identical.
     out.i32(static_cast<int32_t>(cycle_ms_));
+    out.u8(static_cast<uint8_t>((tuned_cache_enabled_ ? 1 : 0) |
+                                (tuned_prefer_flat_ ? 2 : 0)));
     out.i64vec(pending_evictions_);
     EncodeResponseList(out, responses);
     for (int r = 1; r < size_; ++r) workers_[r].SendFrame(out.buf);
+    cache_enabled_ = tuned_cache_enabled_;
+    prefer_flat_ = tuned_prefer_flat_;
     evictions = std::move(pending_evictions_);
     pending_evictions_.clear();
   } else {
@@ -385,6 +397,9 @@ bool Engine::RunCycle() {
     resp_flags = rd.u8();
     int tuned_cycle = rd.i32();
     if (tuned_cycle > 0) cycle_ms_ = tuned_cycle;
+    uint8_t tuned = rd.u8();
+    cache_enabled_ = (tuned & 1) != 0;
+    prefer_flat_ = (tuned & 2) != 0;
     evictions = rd.i64vec();
     responses = DecodeResponseList(rd);
   }
@@ -416,6 +431,12 @@ bool Engine::RunCycle() {
       autotune_.Record(cycle_bytes_)) {
     fusion_threshold_ = autotune_.fusion_threshold();
     cycle_ms_ = autotune_.cycle_ms();
+    tuned_cache_enabled_ = autotune_.cache_enabled();
+    tuned_prefer_flat_ = autotune_.prefer_flat();
+    if (size_ == 1) {
+      cache_enabled_ = tuned_cache_enabled_;
+      prefer_flat_ = tuned_prefer_flat_;
+    }
     HVT_LOG(DEBUG, rank_) << "autotune sample " << autotune_.samples()
                           << ": fusion " << (fusion_threshold_ >> 20)
                           << " MB, cycle " << cycle_ms_ << " ms";
@@ -918,6 +939,9 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
 
 CollectiveBackend* Engine::PickBackend(const Response& resp,
                                        int64_t total_elems) {
+  // autotuned flat preference: bypass the priority backends entirely
+  // (flag is frame-synchronized, so every rank picks the same family)
+  if (prefer_flat_.load()) return backends_.back().get();
   for (auto& b : backends_)
     if (b->Enabled(resp, total_elems)) return b.get();
   return backends_.back().get();  // ring fallback accepts everything
@@ -1197,8 +1221,8 @@ void Engine::ExecuteResponse(const Response& resp,
           CachedParams p{resp.op,      resp.reduce,    resp.dtype,
                          entries[i]->shape, resp.root, resp.prescale,
                          resp.postscale, entries[i]->splits};
-          if (!join_pending_ && resp.group_id < 0 &&
-              resp.members.empty())
+          if (cache_enabled_.load() && !join_pending_ &&
+              resp.group_id < 0 && resp.members.empty())
             cache_.Insert(resp.names[i], p);
           CompleteEntry(entries[i], Status::OK());
         }

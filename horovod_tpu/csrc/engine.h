@@ -63,6 +63,8 @@ class Engine {
   int rank() const { return rank_; }
   int size() const { return size_; }
   const ParameterManager& autotune() const { return autotune_; }
+  bool cache_enabled() const { return cache_enabled_.load(); }
+  bool prefer_flat() const { return prefer_flat_.load(); }
   int64_t fusion_threshold() const { return fusion_threshold_; }
   int current_cycle_ms() const { return cycle_ms_; }
   // total data-plane collectives executed (one fused allreduce = one);
@@ -119,6 +121,13 @@ class Engine {
   // atomic: mutated by the engine thread, read by the introspection API
   // (hvt_autotune_state) from client threads
   std::atomic<int> cycle_ms_{2};
+  // autotuned flags, applied at the response-frame boundary on EVERY rank
+  // (cache lookups and backend picks must never diverge across ranks);
+  // tuned_* hold rank 0's pending values until the next frame carries them
+  std::atomic<bool> cache_enabled_{true};
+  std::atomic<bool> prefer_flat_{false};
+  bool tuned_cache_enabled_ = true;
+  bool tuned_prefer_flat_ = false;
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> fatal_{false};

@@ -157,3 +157,50 @@ def test_autotune_engine_integration():
                    "HVT_AUTOTUNE_CYCLES_PER_SAMPLE": "5",
                    "HVT_AUTOTUNE_MAX_SAMPLES": "50"})
     assert "AUTOTUNE-SAMPLES-" in out
+
+
+def test_autotune_four_knobs_converge_and_stay_synchronized_4proc():
+    """Widened tuning surface (reference parameter_manager.h:60-78):
+    {fusion threshold, cycle time, cache enabled, backend preference}.
+    The BO's space-filling start genuinely toggles the cache and
+    flat-ring flags, so this pins three things at once: numerics stay
+    correct while the knobs move, the tuner reaches its sample budget and
+    freezes on the best point, and the frame-broadcast keeps cycle_ms and
+    the flags identical on every rank."""
+    from tests.test_engine_integration import run_workers
+
+    out = run_workers("""
+        import ctypes
+        lib = ctypes.CDLL(
+            os.path.join({REPO!r}, "horovod_tpu", "csrc", "build",
+                         "libhvt_core.so"))
+        for step in range(400):
+            x = np.full((512,), float(r + 1 + step % 3), np.float32)
+            res = np.asarray(hvt.allreduce(x, op=hvt.Sum,
+                                           name=f"k{step % 4}"))
+            np.testing.assert_allclose(
+                res, float(sum(i + 1 + step % 3 for i in range(n))))
+        # one more collective so every rank has passed a frame boundary
+        # AFTER the tuner froze, then compare the synchronized state
+        hvt.allreduce(np.zeros(4, np.float32), op=hvt.Sum, name="fin")
+        st = (ctypes.c_longlong * 4)()
+        lib.hvt_autotune_state(st)
+        flags = lib.hvt_engine_flags()
+        states = hvt.allgather_object({"rank": r, "flags": flags,
+                                       "cycle": int(st[1])})
+        base = {k: v for k, v in states[0].items() if k != "rank"}
+        for s in states:
+            assert {k: v for k, v in s.items() if k != "rank"} == base, \
+                f"tuned state diverged across ranks: {states}"
+        if r == 0:
+            assert st[3] == 1, "autotune not active"
+            assert st[2] >= 6, f"tuner did not finish: {list(st)}"
+            print(f"AUTOTUNE4-DONE samples={st[2]} flags={flags} "
+                  f"cycle={int(st[1])}", flush=True)
+    """.replace("{REPO!r}", repr(REPO)),
+        np=4,
+        extra_env={"HVT_AUTOTUNE": "1",
+                   "HVT_AUTOTUNE_WARMUP_SAMPLES": "1",
+                   "HVT_AUTOTUNE_CYCLES_PER_SAMPLE": "3",
+                   "HVT_AUTOTUNE_MAX_SAMPLES": "6"})
+    assert "AUTOTUNE4-DONE" in out, out[-2000:]

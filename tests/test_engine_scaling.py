@@ -1,0 +1,41 @@
+"""Regression pin for the eager data-plane scaling work
+(benchmarks/engine_scaling.py, docs/performance.md): the shm plane must
+not lose to the loopback TCP ring at the 16 MB payload where its
+single-copy design wins by design.
+
+Timing on a shared 1-core box is noisy, so the comparison interleaves
+shm/ring pairs and compares MEDIANS with headroom — a real regression
+(shm slower than ring by design, as a naive barrier bug would cause)
+clears the margin; scheduler noise does not.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "engine_scaling", os.path.join(REPO, "benchmarks", "engine_scaling.py"))
+engine_scaling = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(engine_scaling)
+
+
+@pytest.mark.timeout(900)
+def test_shm_not_slower_than_ring_at_16mb_2proc():
+    shm_ms, ring_ms = [], []
+    for _ in range(3):  # interleaved pairs: noise hits both alike
+        shm_ms.append(engine_scaling.run_job(
+            2, True, {"16MB": 1 << 22}, 4, REPO)["16MB"]["hit_ms"])
+        ring_ms.append(engine_scaling.run_job(
+            2, False, {"16MB": 1 << 22}, 4, REPO)["16MB"]["hit_ms"])
+    shm, ring = float(np.median(shm_ms)), float(np.median(ring_ms))
+    # shm is ~25-35% faster here when the box is quiet (round-2 and
+    # round-3 measurements); 1.2x headroom absorbs scheduler noise while
+    # still catching a plane that actually lost its advantage
+    assert shm <= ring * 1.2, (
+        f"shm 16MB allreduce median {shm} ms vs ring {ring} ms — the "
+        f"single-copy shm plane should not lose to loopback TCP "
+        f"(samples: shm={shm_ms}, ring={ring_ms})")
