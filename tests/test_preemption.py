@@ -29,6 +29,12 @@ def _clean_watcher():
     preemption._reset_for_tests()
 
 
+def fields_of(line):
+    """Parse 'BATCH slot=.. rank=..'-style worker lines (launcher output
+    prefixes each line with '[rank] ', which carries no '=')."""
+    return dict(kv.split("=") for kv in line.split() if "=" in kv)
+
+
 def wait_until(cond, timeout=5.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -153,9 +159,13 @@ def test_sigterm_worker_midepoch_resumes_with_stable_ranks(tmp_path):
         TMP = {marker_dir!r}
         TOTAL = 6
 
+        # spawn slot, captured BEFORE the elastic runner rewrites
+        # HVT_LOCAL_PROCESS_ID per round — labels the PROCESS, so the
+        # slot→rank stability assertion is real
+        slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
+
         @hvt.elastic.run
         def train(state):
-            slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
             with open(f"{{TMP}}/pid_{{slot}}", "w") as f:
                 f.write(str(os.getpid()))
             while state.batch < TOTAL:
@@ -206,11 +216,6 @@ def test_sigterm_worker_midepoch_resumes_with_stable_ranks(tmp_path):
     assert "DONE slot=0" in out and "DONE slot=1" in out, out
     # ranks stayed stable across the preemption round: every slot keeps
     # one rank for the whole job
-    # launcher prefixes worker lines with "[rank] "
-    def fields_of(line):
-        return dict(kv.split("=") for kv in line.split()
-                    if "=" in kv)
-
     slot_ranks = {}
     batches_1 = []
     for line in out.splitlines():
@@ -252,9 +257,13 @@ def test_worker_death_restores_tf_keras_state(tmp_path):
         model = tf.keras.Sequential()  # state rides the explicit var list
         state = tfe.TensorFlowState([v], batch=0)
 
+        # spawn slot, captured BEFORE the elastic runner rewrites
+        # HVT_LOCAL_PROCESS_ID per round — labels the PROCESS, so the
+        # slot→rank stability assertion is real
+        slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
+
         @hvt.elastic.run
         def train(state):
-            slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
             with open(f"{{TMP}}/pid_{{slot}}", "w") as f:
                 f.write(str(os.getpid()))
             while state.batch < TOTAL:
@@ -303,3 +312,106 @@ def test_worker_death_restores_tf_keras_state(tmp_path):
     assert len(finals) == 2, out
     for line in finals:
         assert "w=94.0" in line, line
+
+
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="C++ engine not built (make -C horovod_tpu/csrc)")
+def test_grown_host_gets_worker_at_next_rendezvous(tmp_path):
+    """End-to-end growth (VERDICT r2 #8; reference
+    elastic_common.py:34-60): a discovery script flips localhost:2 →
+    localhost:3 mid-job. Running workers interrupt at the next commit,
+    re-rendezvous, the NEW slot receives a worker in that round, the
+    surviving slots keep their ranks, and everyone finishes with
+    size == 3."""
+    marker_dir = str(tmp_path)
+    disc = os.path.join(marker_dir, "discover.sh")
+    with open(disc, "w") as f:
+        f.write(textwrap.dedent(f"""\
+            #!/bin/sh
+            if [ -f {marker_dir}/grow ]; then
+                echo localhost:3
+            else
+                echo localhost:2
+            fi
+        """))
+    os.chmod(disc, 0o755)
+    script = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import horovod_tpu as hvt
+        from horovod_tpu.elastic import ObjectState
+
+        TMP = {marker_dir!r}
+        TOTAL = 8
+
+        # spawn slot, captured BEFORE the elastic runner rewrites
+        # HVT_LOCAL_PROCESS_ID per round (labels the process, not the
+        # round's local rank)
+        slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
+
+        @hvt.elastic.run
+        def train(state):
+            while state.batch < TOTAL:
+                hvt.allreduce(np.float32(1.0), name=f"b{{state.batch}}")
+                print(f"BATCH slot={{slot}} rank={{hvt.process_rank()}}"
+                      f" size={{hvt.process_size()}}"
+                      f" batch={{state.batch}}", flush=True)
+                open(f"{{TMP}}/progress_{{slot}}_{{state.batch}}",
+                     "w").close()
+                state.batch += 1
+                time.sleep(0.3)
+                state.commit()
+            print(f"DONE slot={{slot}} rank={{hvt.process_rank()}}"
+                  f" size={{hvt.process_size()}}", flush=True)
+
+        hvt.init()
+        train(ObjectState(batch=0))
+        hvt.shutdown()
+    """)
+    path = os.path.join(marker_dir, "worker.py")
+    with open(path, "w") as f:
+        f.write(script)
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": ""})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--min-np", "2", "--max-np", "3",
+         "--host-discovery-script", disc, "--master-port", "29814",
+         sys.executable, path],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        assert wait_until(
+            lambda: os.path.exists(f"{marker_dir}/progress_0_1")
+            and os.path.exists(f"{marker_dir}/progress_1_1"), timeout=60), \
+            "workers never reached batch 1"
+        open(f"{marker_dir}/grow", "w").close()  # flip discovery 2 → 3
+        out, _ = proc.communicate(timeout=150)
+    except Exception:
+        proc.kill()
+        out = proc.stdout.read() if proc.stdout else ""
+        raise AssertionError(f"elastic growth job did not complete:\n{out}")
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{out}"
+
+    slot_ranks, sizes_by_slot = {}, {}
+    for line in out.splitlines():
+        if "BATCH " in line or "DONE " in line:
+            fields = fields_of(line)
+            slot_ranks.setdefault(fields["slot"], set()).add(fields["rank"])
+            if "size" in fields:
+                sizes_by_slot.setdefault(fields["slot"], []).append(
+                    int(fields["size"]))
+    # the grown slot actually received a worker at the next round
+    assert "2" in slot_ranks, f"new slot never started: {slot_ranks}\n{out}"
+    assert "DONE slot=2" in out, out
+    # every slot finished at world size 3
+    for slot, sizes in sizes_by_slot.items():
+        assert sizes[-1] == 3, f"slot {slot} final size {sizes[-1]}\n{out}"
+    # surviving slots kept their ranks across the growth round
+    for slot in ("0", "1"):
+        assert len(slot_ranks[slot]) == 1, \
+            f"slot {slot} changed rank: {slot_ranks[slot]}\n{out}"
