@@ -22,12 +22,14 @@ local-scratch-dir + sync contract and invoke ``callbacks``
 (``on_epoch_end(epoch, logs)``) on rank 0.
 
 The reference materializes DataFrames through Petastorm stores
-(``spark/common/store.py``); TPU-natively the estimator converts the
-(feature, label) columns to per-partition numpy shards — each barrier
-task trains on its shard with gradients combined across tasks. Petastorm
-out-of-core storage is out of scope; for datasets beyond executor
-memory, feed TFRecord/array files from the training fn, using the
-store's data-path layout.
+(``spark/common/store.py``); TPU-natively the in-memory default converts
+the (feature, label) columns to per-partition numpy shards — each
+barrier task trains on its shard with gradients combined across tasks.
+For beyond-memory datasets, ``TorchEstimator(out_of_core=True)``
+materializes per-partition ``.npz`` shard files into the store on the
+executors and STREAMS them file-at-a-time in the training loop
+(``spark/data.py`` — the Petastorm-store analog); the Jax/Keras flavors
+still collect to memory.
 
 Both estimators split fit into a Spark-facing ``fit(df)`` and a pure
 ``_fit_arrays(X, y, run_fn=...)`` so the gated test rig exercises the
@@ -145,21 +147,41 @@ def _collect_xy(df, feature_cols, label_col):
 
 
 class _EstimatorBase:
-    """Shared Spark-facing plumbing (collect → _fit_arrays → model)."""
+    """Shared Spark-facing plumbing (collect-or-materialize →
+    _fit_arrays → model)."""
 
     def fit(self, df):
         from horovod_tpu.spark.runner import _require_pyspark, run
 
         _require_pyspark()
+
+        def run_fn(worker, num_proc=None, master_port=29575):
+            return run(worker, num_proc=num_proc, master_port=master_port)
+
+        return self._fit_dataframe(df, run_fn=run_fn)
+
+    def _fit_dataframe(self, df, run_fn=None):
+        """The DataFrame half of ``fit`` (everything between the Spark
+        session and ``_fit_arrays``), factored so the gated test rig can
+        execute it with a fake DataFrame/barrier context — the coverage
+        ``_fit_arrays`` alone skips."""
+        if getattr(self, "out_of_core", False):
+            # reference-parity out-of-core path: materialize per-partition
+            # shard files into the store on the executors; workers stream
+            # them (spark/data.py — the Petastorm-store analog)
+            if self.store is None:
+                raise ValueError("out_of_core=True requires store=")
+            from horovod_tpu.spark.data import write_dataframe_shards
+
+            write_dataframe_shards(df, self.store, self.feature_cols,
+                                   self.label_col, idx=self.run_id)
+            return self._fit_arrays(None, None, run_fn=run_fn,
+                                    sharded=True)
         X, y = _collect_xy(df, self.feature_cols, self.label_col)
         # ship the dataset once per executor (broadcast), not once per
         # task via the function closure
         sc = df.sparkSession.sparkContext
         bc = sc.broadcast((X, y))
-
-        def run_fn(worker, num_proc=None, master_port=29575):
-            return run(worker, num_proc=num_proc, master_port=master_port)
-
         # X/y must NOT also ride the worker closure (cloudpickle would
         # serialize the captured cells per task, defeating the broadcast)
         return self._fit_arrays(None, None, run_fn=run_fn, broadcast=bc)
@@ -284,7 +306,8 @@ class TorchEstimator(_EstimatorBase):
                  batch_size: int = 32, master_port: int = 29576,
                  store=None, run_id: Optional[str] = None,
                  callbacks: Optional[list] = None,
-                 validation: Optional[float] = None):
+                 validation: Optional[float] = None,
+                 out_of_core: bool = False):
         self.model = model
         self.optimizer_fn = optimizer_fn
         self.loss_fn = loss_fn
@@ -300,9 +323,19 @@ class TorchEstimator(_EstimatorBase):
         # fraction in (0,1): deterministic hold-out, per-epoch val_loss
         # in history/callbacks (reference estimator `validation` param)
         self.validation = validation
+        # out-of-core: fit(df) materializes per-partition shard files
+        # into the store (spark/data.py) and workers STREAM them instead
+        # of holding the dataset in memory — the reference's
+        # Petastorm-store path. Validation split needs the in-memory
+        # dataset, so the two are mutually exclusive.
+        self.out_of_core = bool(out_of_core)
+        if self.out_of_core and validation:
+            raise ValueError("out_of_core=True does not support "
+                             "validation= (stream the hold-out from a "
+                             "separate materialized DataFrame instead)")
 
-    def _fit_arrays(self, X, y, run_fn=None, broadcast=None
-                    ) -> "TorchModel":
+    def _fit_arrays(self, X, y, run_fn=None, broadcast=None,
+                    sharded=False) -> "TorchModel":
         import torch
 
         run_fn = run_fn or _local_run
@@ -321,27 +354,57 @@ class TorchEstimator(_EstimatorBase):
             import horovod_tpu as hvt
             import horovod_tpu.torch as hvt_torch
 
-            bx, by = bc.value if bc is not None else (X, y)
             # shard by PROCESS: the estimator loop is per-worker-process
             # (a process may drive several chips; hvt.size() counts chips)
             n, r = hvt.process_size(), hvt.process_rank()
-            train_ids, val_ids = _train_val_split(len(bx), validation)
-            rows = train_ids[_shard_rows(len(train_ids), r, n)]
-            sx = torch.from_numpy(np.ascontiguousarray(bx[rows]))
-            sy = torch.from_numpy(np.ascontiguousarray(by[rows]))
-            vx = (torch.from_numpy(np.ascontiguousarray(bx[val_ids]))
-                  if len(val_ids) else None)
-            vy = (torch.from_numpy(np.ascontiguousarray(by[val_ids]))
-                  if len(val_ids) else None)
+            if sharded:
+                # streaming path: batches come one shard FILE at a time
+                # from the store (spark/data.py); nothing in memory
+                # beyond the current file
+                from horovod_tpu.spark.data import ShardedDataset
+
+                ds = ShardedDataset(store, idx=run_id)
+                sx = sy = vx = vy = None
+                steps = ds.lockstep_steps(n, batch_size)
+
+                def epoch_batches(epoch):
+                    for bx_, by_ in ds.iter_batches(
+                            r, n, batch_size, steps, seed=1000 + epoch):
+                        yield (torch.from_numpy(
+                                   np.ascontiguousarray(bx_)),
+                               torch.from_numpy(
+                                   np.ascontiguousarray(by_)))
+            else:
+                bx, by = bc.value if bc is not None else (X, y)
+                train_ids, val_ids = _train_val_split(len(bx), validation)
+                rows = train_ids[_shard_rows(len(train_ids), r, n)]
+                sx = torch.from_numpy(np.ascontiguousarray(bx[rows]))
+                sy = torch.from_numpy(np.ascontiguousarray(by[rows]))
+                vx = (torch.from_numpy(np.ascontiguousarray(bx[val_ids]))
+                      if len(val_ids) else None)
+                vy = (torch.from_numpy(np.ascontiguousarray(by[val_ids]))
+                      if len(val_ids) else None)
+                # equal step count on every rank (see _steps_per_epoch):
+                # per-step gradient collectives must stay in lockstep
+                steps = _steps_per_epoch(len(train_ids), n, batch_size)
+
+                def epoch_batches(epoch):
+                    perm = torch.from_numpy(np.resize(
+                        torch.randperm(
+                            len(sx),
+                            generator=torch.Generator().manual_seed(
+                                1000 + epoch)).numpy(),
+                        steps * batch_size))
+                    for s in range(steps):
+                        idx = perm[s * batch_size:(s + 1) * batch_size]
+                        yield sx[idx], sy[idx]
+
             model = pickle.loads(model_blob)
             opt = hvt_torch.DistributedOptimizer(
                 optimizer_fn(model.parameters()),
                 named_parameters=model.named_parameters())
             hvt_torch.broadcast_parameters(model.state_dict(), root_rank=0)
             lf = loss_fn or torch.nn.functional.mse_loss
-            # equal step count on every rank (see _steps_per_epoch): the
-            # per-step gradient collectives must stay in lockstep
-            steps = _steps_per_epoch(len(train_ids), n, batch_size)
 
             def val_loss():
                 total, seen = 0.0, 0
@@ -361,18 +424,11 @@ class TorchEstimator(_EstimatorBase):
             def train_epochs(ckpt_dir=None, on_epoch=None):
                 history = []
                 for epoch in range(epochs):
-                    perm = torch.from_numpy(np.resize(
-                        torch.randperm(
-                            len(sx),
-                            generator=torch.Generator().manual_seed(
-                                1000 + epoch)).numpy(),
-                        steps * batch_size))
                     total, batches = 0.0, 0
-                    for s in range(steps):
-                        idx = perm[s * batch_size:(s + 1) * batch_size]
+                    for xb, yb in epoch_batches(epoch):
                         opt.zero_grad()
-                        pred = model(sx[idx])
-                        loss = lf(pred.reshape(-1), sy[idx].reshape(-1))
+                        pred = model(xb)
+                        loss = lf(pred.reshape(-1), yb.reshape(-1))
                         loss.backward()
                         opt.step()
                         total += float(loss.detach())
