@@ -25,11 +25,11 @@ The reference materializes DataFrames through Petastorm stores
 (``spark/common/store.py``); TPU-natively the in-memory default converts
 the (feature, label) columns to per-partition numpy shards — each
 barrier task trains on its shard with gradients combined across tasks.
-For beyond-memory datasets, ``TorchEstimator(out_of_core=True)``
-materializes per-partition ``.npz`` shard files into the store on the
-executors and STREAMS them file-at-a-time in the training loop
-(``spark/data.py`` — the Petastorm-store analog); the Jax/Keras flavors
-still collect to memory.
+For beyond-memory datasets, the Torch and Keras flavors accept
+``out_of_core=True``: per-partition ``.npz`` shard files are
+materialized into the store on the executors and STREAMED
+file-at-a-time in the training loop (``spark/data.py`` — the
+Petastorm-store analog); the Jax flavor still collects to memory.
 
 Both estimators split fit into a Spark-facing ``fit(df)`` and a pure
 ``_fit_arrays(X, y, run_fn=...)`` so the gated test rig exercises the
@@ -149,6 +149,15 @@ def _collect_xy(df, feature_cols, label_col):
 class _EstimatorBase:
     """Shared Spark-facing plumbing (collect-or-materialize →
     _fit_arrays → model)."""
+
+    def _set_out_of_core(self, out_of_core, validation):
+        """Streaming-mode flag + its validation mutual exclusion (the
+        hold-out split needs the in-memory dataset)."""
+        self.out_of_core = bool(out_of_core)
+        if self.out_of_core and validation:
+            raise ValueError("out_of_core=True does not support "
+                             "validation= (stream the hold-out from a "
+                             "separate materialized DataFrame instead)")
 
     def fit(self, df):
         from horovod_tpu.spark.runner import _require_pyspark, run
@@ -326,13 +335,8 @@ class TorchEstimator(_EstimatorBase):
         # out-of-core: fit(df) materializes per-partition shard files
         # into the store (spark/data.py) and workers STREAM them instead
         # of holding the dataset in memory — the reference's
-        # Petastorm-store path. Validation split needs the in-memory
-        # dataset, so the two are mutually exclusive.
-        self.out_of_core = bool(out_of_core)
-        if self.out_of_core and validation:
-            raise ValueError("out_of_core=True does not support "
-                             "validation= (stream the hold-out from a "
-                             "separate materialized DataFrame instead)")
+        # Petastorm-store path.
+        self._set_out_of_core(out_of_core, validation)
 
     def _fit_arrays(self, X, y, run_fn=None, broadcast=None,
                     sharded=False) -> "TorchModel":
@@ -497,7 +501,8 @@ class KerasEstimator(_EstimatorBase):
                  batch_size: int = 32, master_port: int = 29577,
                  store=None, run_id: Optional[str] = None,
                  callbacks: Optional[list] = None,
-                 validation: Optional[float] = None):
+                 validation: Optional[float] = None,
+                 out_of_core: bool = False):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -511,6 +516,8 @@ class KerasEstimator(_EstimatorBase):
         self.run_id = run_id or f"keras-{uuid.uuid4().hex[:8]}"
         self.callbacks = list(callbacks or [])
         self.validation = validation
+        # same streaming contract as TorchEstimator (spark/data.py)
+        self._set_out_of_core(out_of_core, validation)
 
     @staticmethod
     def _model_to_bytes(model) -> bytes:
@@ -542,8 +549,8 @@ class KerasEstimator(_EstimatorBase):
         finally:
             os.unlink(path)
 
-    def _fit_arrays(self, X, y, run_fn=None, broadcast=None
-                    ) -> "KerasModel":
+    def _fit_arrays(self, X, y, run_fn=None, broadcast=None,
+                    sharded=False) -> "KerasModel":
         import tensorflow as tf
 
         run_fn = run_fn or _local_run
@@ -567,28 +574,52 @@ class KerasEstimator(_EstimatorBase):
             import horovod_tpu as hvt
             import horovod_tpu.tensorflow as hvt_tf
 
-            bx, by = bc.value if bc is not None else (X, y)
             # shard by PROCESS: the estimator loop is per-worker-process
             # (a process may drive several chips; hvt.size() counts chips)
             n, r = hvt.process_size(), hvt.process_rank()
-            train_ids, val_ids = _train_val_split(len(bx), validation)
-            rows = train_ids[_shard_rows(len(train_ids), r, n)]
-            sx = np.ascontiguousarray(bx[rows])
-            sy = np.ascontiguousarray(by[rows])
-            vx = (np.ascontiguousarray(bx[val_ids]) if len(val_ids)
-                  else None)
-            vy = (np.ascontiguousarray(by[val_ids]) if len(val_ids)
-                  else None)
+            if sharded:
+                from horovod_tpu.spark.data import ShardedDataset
+
+                ds = ShardedDataset(store, idx=run_id)
+                vx = vy = None
+                steps = ds.lockstep_steps(n, batch_size)
+                # build-only input: shape/dtype from the manifest — no
+                # reason to fetch+decompress a whole shard for one row
+                first_x = np.zeros((1, len(ds.feature_cols)), np.float32)
+
+                def epoch_batches(epoch):
+                    yield from ds.iter_batches(r, n, batch_size, steps,
+                                               seed=1000 + epoch)
+            else:
+                bx, by = bc.value if bc is not None else (X, y)
+                train_ids, val_ids = _train_val_split(len(bx), validation)
+                rows = train_ids[_shard_rows(len(train_ids), r, n)]
+                sx = np.ascontiguousarray(bx[rows])
+                sy = np.ascontiguousarray(by[rows])
+                vx = (np.ascontiguousarray(bx[val_ids]) if len(val_ids)
+                      else None)
+                vy = (np.ascontiguousarray(by[val_ids]) if len(val_ids)
+                      else None)
+                first_x = sx[:1]
+                # every rank must run the SAME number of steps per epoch
+                # — uneven shards would desynchronize the per-step
+                # gradient collectives (wrap-around padding; global row
+                # count is known to all ranks)
+                steps = _steps_per_epoch(len(train_ids), n, batch_size)
+
+                def epoch_batches(epoch):
+                    perm = np.resize(
+                        np.random.RandomState(1000 + epoch).permutation(
+                            len(sx)), steps * batch_size)
+                    for s in range(steps):
+                        idx = perm[s * batch_size:(s + 1) * batch_size]
+                        yield sx[idx], sy[idx]
+
             model = KerasEstimator._model_from_bytes(model_blob)
             opt = tf.keras.optimizers.deserialize(opt_cfg)
             loss_fn = tf.keras.losses.get(loss)
-            model(tf.constant(sx[:1]))  # build weights
+            model(tf.constant(first_x))  # build weights
             hvt_tf.broadcast_variables(model.weights, root_rank=0)
-            # every rank must run the SAME number of steps per epoch —
-            # uneven shards would desynchronize the per-step gradient
-            # collectives (wrap-around padding; global row count is
-            # known to all ranks)
-            steps = _steps_per_epoch(len(train_ids), n, batch_size)
 
             def val_loss():
                 total, seen = 0.0, 0
@@ -605,14 +636,10 @@ class KerasEstimator(_EstimatorBase):
             def train_epochs(ckpt_dir=None, on_epoch=None):
                 history = []
                 for epoch in range(epochs):
-                    perm = np.resize(
-                        np.random.RandomState(1000 + epoch).permutation(
-                            len(sx)), steps * batch_size)
                     total, batches = 0.0, 0
-                    for s in range(steps):
-                        idx = perm[s * batch_size:(s + 1) * batch_size]
-                        xb = tf.constant(sx[idx])
-                        yb = tf.constant(sy[idx])
+                    for xb_, yb_ in epoch_batches(epoch):
+                        xb = tf.constant(xb_)
+                        yb = tf.constant(yb_)
                         with hvt_tf.DistributedGradientTape(
                                 tf.GradientTape()) as tape:
                             pred = model(xb, training=True)

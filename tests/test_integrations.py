@@ -539,3 +539,42 @@ def test_torch_out_of_core_rejects_validation():
                        optimizer_fn=lambda p: torch.optim.SGD(p, lr=0.1),
                        feature_cols=["a", "b"], label_col="y",
                        validation=0.2, out_of_core=True)
+
+
+def test_keras_estimator_out_of_core_fit(tmp_path):
+    """Keras flavor of the streaming path: same store/shard contract as
+    the Torch estimator."""
+    import numpy as np
+
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.spark import KerasEstimator, Store
+
+    rng = np.random.RandomState(17)
+    X = rng.randn(100, 2).astype(np.float32)
+    y = X @ np.asarray([0.8, -0.6], np.float32)
+    store = Store.create(str(tmp_path / "st"))
+    model = tf.keras.Sequential(
+        [tf.keras.layers.Dense(1, use_bias=False)])
+    rec = _EpochRecorder()
+    est = KerasEstimator(
+        model, feature_cols=["a", "b"], label_col="y",
+        optimizer=tf.keras.optimizers.SGD(0.1), epochs=6, batch_size=20,
+        store=store, run_id="kooc", callbacks=[rec], out_of_core=True)
+    fitted = est._fit_dataframe(_df_from_xy(X, y, n_parts=4))
+    assert rec.epochs[-1][1] < rec.epochs[0][1]
+    np.testing.assert_allclose(fitted._predict_arrays(X), y, atol=0.2)
+    assert store.exists(store.get_train_data_path("kooc")
+                        + "/manifest.json")
+
+
+def test_keras_out_of_core_rejects_validation():
+    tf = pytest.importorskip("tensorflow")
+
+    from horovod_tpu.spark import KerasEstimator
+
+    with pytest.raises(ValueError, match="out_of_core"):
+        KerasEstimator(
+            tf.keras.Sequential([tf.keras.layers.Dense(1)]),
+            feature_cols=["a"], label_col="y", validation=0.2,
+            out_of_core=True)
