@@ -1,0 +1,96 @@
+"""Long-context training with ring attention + the fused flash kernel —
+the sequence-parallel capability the reference framework does not have
+(SURVEY.md §5.7: its only relevant primitive is alltoall).
+
+Each device owns a sequence shard of Q/K/V; K/V shards stream around the
+ring with ``lax.ppermute`` while each step runs the pallas flash kernel
+on the resident block and merges via its differentiable logsumexp output
+(``parallel/sequence.py``). Peak attention memory per device is
+O(seq/N · seq/N) score tiles inside VMEM — never the full [seq × seq]
+matrix.
+
+    python examples/jax/jax_long_context_train.py --sp 4 --seq 2048
+(on a virtual mesh: XLA_FLAGS=--xla_force_host_platform_device_count=4)
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvt
+from horovod_tpu.parallel.mesh import make_parallel_mesh
+from horovod_tpu.parallel.sequence import ring_attention
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sp", type=int, default=4,
+                   help="sequence-parallel axis size")
+    p.add_argument("--seq", type=int, default=2048,
+                   help="global sequence length")
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--batch", type=int, default=2)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--no-flash", action="store_true",
+                   help="einsum block step instead of the pallas kernel")
+    p.add_argument("--fp32", action="store_true")
+    args = p.parse_args()
+    if args.steps < 1:
+        p.error("--steps must be >= 1")
+
+    hvt.init()
+    mesh = make_parallel_mesh(sp=args.sp)
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    b, s, h, d = args.batch, args.seq, args.heads, args.head_dim
+    dm = h * d
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(b, s, dm) * 0.3, dtype)
+    target = jnp.asarray(rng.randn(b, s, dm) * 0.3, dtype)
+    spec = P(None, "sp", None)
+    x = jax.device_put(x, NamedSharding(mesh, spec))
+    target = jax.device_put(target, NamedSharding(mesh, spec))
+
+    params = {
+        "wq": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
+        "wk": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
+        "wv": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
+        "wo": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
+    }
+    tx = optax.adam(3e-3)
+    opt = tx.init(params)
+
+    def attn_block(p, x):
+        proj = lambda w: (x @ w.astype(x.dtype)).reshape(b, s, h, d)
+        o = ring_attention(proj(p["wq"]), proj(p["wk"]), proj(p["wv"]),
+                           mesh=mesh, causal=True,
+                           use_flash=not args.no_flash)
+        return o.reshape(b, s, dm) @ p["wo"].astype(x.dtype)
+
+    @jax.jit
+    def step(params, opt, x, target):
+        def loss_fn(p):
+            out = attn_block(p, x).astype(jnp.float32)
+            return ((out - target.astype(jnp.float32)) ** 2).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(grads, opt)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for i in range(args.steps):
+        params, opt, loss = step(params, opt, x, target)
+        if i == 0 or (i + 1) % 5 == 0:
+            print(f"step {i + 1}: loss {float(loss):.5f}", flush=True)
+    final = float(loss)
+    assert np.isfinite(final), "training diverged"
+    print(f"final loss {final:.5f} (seq={s} over {args.sp}-way ring, "
+          f"flash={'off' if args.no_flash else 'on'})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

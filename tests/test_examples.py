@@ -78,3 +78,12 @@ def test_tf_elastic_train_smoke_2proc():
     out = _run_example(["examples/tensorflow/tf_elastic_train.py"],
                        np_procs=2, timeout=420)
     assert "epoch 4" in out, out[-1500:]
+
+
+def test_jax_long_context_train_smoke():
+    out = _run_example(
+        ["examples/jax/jax_long_context_train.py", "--sp", "4", "--seq",
+         "128", "--steps", "4", "--batch", "1", "--fp32"],
+        extra_env={"XLA_FLAGS":
+                   "--xla_force_host_platform_device_count=4"})
+    assert "final loss" in out and "flash=on" in out, out[-1000:]
