@@ -42,6 +42,9 @@ def main():
     args = p.parse_args()
     if args.steps < 1:
         p.error("--steps must be >= 1")
+    if args.seq % args.sp != 0:
+        p.error(f"--seq ({args.seq}) must be divisible by --sp "
+                f"({args.sp}) — each device owns one sequence shard")
 
     hvt.init()
     mesh = make_parallel_mesh(sp=args.sp)
