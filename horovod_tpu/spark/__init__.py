@@ -6,6 +6,7 @@ estimator/store ecosystem per ``spark/common/store.py`` +
 from horovod_tpu.spark.estimator import (JaxEstimator, JaxModel,  # noqa: F401,E501
                                          KerasEstimator, KerasModel,
                                          TorchEstimator, TorchModel)
-from horovod_tpu.spark.runner import (run, slot_envs_from_task_infos)  # noqa: F401,E501
+from horovod_tpu.spark.runner import (run, run_elastic,  # noqa: F401
+                                      slot_envs_from_task_infos)  # noqa: F401,E501
 from horovod_tpu.spark.store import (DBFSLocalStore, FilesystemStore,  # noqa: F401,E501
                                      HDFSStore, LocalStore, Store)

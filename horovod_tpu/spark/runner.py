@@ -103,3 +103,102 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: Optional[int] = None,
              .mapPartitions(task)
              .collect())
     return [r for _, r in sorted(pairs)]
+
+
+def _elastic_attempt_loop(attempt, available_slots, num_proc=None,
+                          min_np=None, max_np=None, reset_limit=3,
+                          elastic_timeout=600.0, _sleep=None,
+                          _monotonic=None):
+    """Driver-side elastic retry loop, pure and unit-testable.
+
+    ``attempt(world_size, attempt_idx)`` runs one gang; on failure the
+    world is RE-SIZED from ``available_slots()`` (scale up and down
+    between attempts, clamped to [min_np, max_np]) and retried, up to
+    ``reset_limit`` resets (reference spark/runner.py:303 semantics).
+    ``max_np`` defaults to ``num_proc`` when given — a reset must not
+    silently outgrow the requested world (same convention as hvtrun's
+    launcher). A slot pool momentarily below ``min_np`` is waited out up
+    to ``elastic_timeout`` seconds (the hvtrun --elastic-timeout analog)
+    before the job is declared dead.
+    """
+    import time as _time
+
+    _sleep = _sleep or _time.sleep
+    _monotonic = _monotonic or _time.monotonic
+    if num_proc is not None and max_np is None:
+        max_np = num_proc
+    if (min_np is not None and max_np is not None and min_np > max_np):
+        raise ValueError(f"min_np ({min_np}) > max_np ({max_np})")
+    last_err = None
+    for i in range(reset_limit + 1):
+        world = available_slots()
+        if min_np is not None and world < min_np:
+            # a transient dip (executor replacement in flight) is the
+            # exact event elasticity exists to survive — wait it out
+            deadline = _monotonic() + elastic_timeout
+            while world < min_np and _monotonic() < deadline:
+                _sleep(min(5.0, max(elastic_timeout / 10.0, 0.1)))
+                world = available_slots()
+            if world < min_np:
+                raise RuntimeError(
+                    f"elastic job needs min_np={min_np} slots but only "
+                    f"{world} were available after waiting "
+                    f"{elastic_timeout:.0f}s") from last_err
+        if i == 0 and num_proc is not None:
+            world = num_proc
+        if max_np is not None:
+            world = min(world, max_np)
+        if world < 1:
+            raise RuntimeError("no slots available") from last_err
+        try:
+            return attempt(world, i)
+        except Exception as e:  # gang failed — reset and re-size
+            last_err = e
+    raise RuntimeError(
+        f"elastic job failed after {reset_limit + 1} attempts "
+        f"(reset_limit={reset_limit})") from last_err
+
+
+def run_elastic(fn: Callable, args=(), kwargs=None,
+                num_proc: Optional[int] = None,
+                min_np: Optional[int] = None,
+                max_np: Optional[int] = None, reset_limit: int = 3,
+                elastic_timeout: float = 600.0,
+                master_port: int = 29571, force_cpu_jax: bool = True,
+                extra_env: Optional[dict] = None) -> List[Any]:
+    """Elastic Horovod-on-Spark (reference ``spark/runner.py:303``
+    ``run_elastic``).
+
+    Spark's barrier mode gang-schedules every task of a stage, so
+    elasticity maps to STAGE boundaries rather than the per-worker
+    respawn ``hvtrun --min-np`` does: a task failure tears the whole
+    attempt down, the world is re-sized to the slots available at retry
+    (scale down after executor loss, up after new executors join,
+    clamped to ``[min_np, max_np]``), and ``fn`` re-runs with
+    ``HVT_ELASTIC_ATTEMPT`` advanced in its environment. ``fn`` should
+    restore from its last commit/checkpoint on a non-zero attempt —
+    exactly what an ``@hvt.elastic.run`` function does after a reset.
+    ``reset_limit`` bounds the number of resets.
+    """
+    _require_pyspark()
+    from pyspark.sql import SparkSession
+
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+
+    def available_slots() -> int:
+        return int(sc.defaultParallelism)
+
+    def attempt(world: int, attempt_idx: int):
+        env = dict(extra_env or {})
+        env["HVT_ELASTIC_ATTEMPT"] = str(attempt_idx)
+        # fresh port per attempt: a dying gang can leave the previous
+        # control-star port in TIME_WAIT on the master host
+        return run(fn, args=args, kwargs=kwargs, num_proc=world,
+                   master_port=master_port + attempt_idx,
+                   force_cpu_jax=force_cpu_jax, extra_env=env)
+
+    return _elastic_attempt_loop(attempt, available_slots,
+                                 num_proc=num_proc, min_np=min_np,
+                                 max_np=max_np, reset_limit=reset_limit,
+                                 elastic_timeout=elastic_timeout)

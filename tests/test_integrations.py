@@ -578,3 +578,121 @@ def test_keras_out_of_core_rejects_validation():
             tf.keras.Sequential([tf.keras.layers.Dense(1)]),
             feature_cols=["a"], label_col="y", validation=0.2,
             out_of_core=True)
+
+
+# --------------------------------------------------- spark run_elastic
+
+def test_elastic_attempt_loop_resizes_and_recovers():
+    """Gang fails once → world re-sized from the (shrunken) slot pool
+    and retried; attempt indices advance (reference run_elastic
+    reset-and-resume at stage boundaries)."""
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    calls = []
+    pool = [4, 2]  # 4 slots at first, 2 after the failure
+
+    def attempt(world, idx):
+        calls.append((world, idx))
+        if idx == 0:
+            raise RuntimeError("executor lost")
+        return [f"r{i}" for i in range(world)]
+
+    out = _elastic_attempt_loop(attempt, lambda: pool.pop(0),
+                                min_np=2, max_np=4, reset_limit=2)
+    assert calls == [(4, 0), (2, 1)]
+    assert out == ["r0", "r1"]
+
+
+def test_elastic_attempt_loop_min_np_violation_raises():
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    def attempt(world, idx):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="min_np=3"):
+        _elastic_attempt_loop(attempt, lambda: 2, min_np=3,
+                              reset_limit=2, elastic_timeout=0.0)
+
+
+def test_elastic_attempt_loop_waits_out_transient_min_np_dip():
+    """A momentary dip below min_np (executor replacement in flight) is
+    waited out instead of killing the job."""
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    pool = [1, 1, 3]  # dips below min_np=2, then recovers
+
+    def attempt(world, idx):
+        return ["ok"] * world
+
+    clock = [0.0]
+    out = _elastic_attempt_loop(
+        attempt, lambda: pool.pop(0) if pool else 3, min_np=2,
+        elastic_timeout=60.0, _sleep=lambda s: clock.__setitem__(
+            0, clock[0] + s), _monotonic=lambda: clock[0])
+    assert out == ["ok"] * 3
+
+
+def test_elastic_attempt_loop_min_gt_max_rejected_upfront():
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    with pytest.raises(ValueError, match="min_np"):
+        _elastic_attempt_loop(lambda w, i: [], lambda: 16, min_np=4,
+                              max_np=2)
+
+
+def test_elastic_attempt_loop_retries_capped_at_num_proc():
+    """With no explicit max_np, a reset must not outgrow the requested
+    world (launch.py convention: max_np defaults to num_proc)."""
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    seen = []
+
+    def attempt(world, idx):
+        seen.append(world)
+        if idx == 0:
+            raise RuntimeError("lost")
+        return ["ok"] * world
+
+    _elastic_attempt_loop(attempt, lambda: 64, num_proc=2,
+                          reset_limit=1)
+    assert seen == [2, 2]
+
+
+def test_elastic_attempt_loop_reset_limit_exhausted():
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    n = [0]
+
+    def attempt(world, idx):
+        n[0] += 1
+        raise RuntimeError("persistent failure")
+
+    with pytest.raises(RuntimeError, match="after 3 attempts"):
+        _elastic_attempt_loop(attempt, lambda: 2, reset_limit=2)
+    assert n[0] == 3
+
+
+def test_elastic_attempt_loop_first_attempt_prefers_num_proc():
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    seen = []
+
+    def attempt(world, idx):
+        seen.append(world)
+        return ["ok"] * world
+
+    _elastic_attempt_loop(attempt, lambda: 8, num_proc=3, max_np=6)
+    assert seen == [3]
+
+
+def test_spark_run_elastic_gated():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError:
+        pass
+    else:
+        pytest.skip("pyspark installed; gating not applicable")
+    from horovod_tpu.spark import run_elastic
+
+    with pytest.raises(ImportError, match="pyspark"):
+        run_elastic(lambda: None, num_proc=2)
