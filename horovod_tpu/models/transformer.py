@@ -42,8 +42,18 @@ class GPTConfig:
     # einsum-softmax path: O(seq) memory, no materialized score matrix.
     # Requires the local sequence to be the full, contiguous sequence
     # (its causal mask is positional-by-block) — leave False under
-    # sequence parallelism, where ring attention owns the schedule.
+    # plain GSPMD sequence parallelism; combine with ring_mesh to get
+    # flash + SP (the ring schedule owns the blocks there).
     use_flash: bool = False
+    # Explicit ring-attention sequence parallelism: set to the
+    # jax.sharding.Mesh the model runs under (must carry an 'sp' axis).
+    # Attention then runs parallel/sequence.py's ring schedule under
+    # shard_map — K/V shards stream over ICI with lax.ppermute instead
+    # of GSPMD's allgather of the full K/V, and use_flash=True runs the
+    # pallas kernel per block. Peak attention memory is O(seq/N).
+    # hash/eq exclude nothing: Mesh is hashable, so the config stays a
+    # valid jit-static argument.
+    ring_mesh: Optional[object] = None
 
 
 def _rotary(x, positions):
@@ -87,24 +97,28 @@ class Attention(nn.Module):
         q = _rotary(q, positions)
         k = _rotary(k, positions)
 
-        if cfg.use_flash:
+        if cfg.ring_mesh is not None:
+            from horovod_tpu.parallel.sequence import ring_attention
+
+            out = ring_attention(q, k, v, mesh=cfg.ring_mesh,
+                                 causal=True,
+                                 scale=1.0 / np.sqrt(head_dim),
+                                 use_flash=cfg.use_flash)
+        elif cfg.use_flash:
             from horovod_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True,
                                   scale=1.0 / np.sqrt(head_dim))
-            return nn.DenseGeneral(cfg.d_model, axis=(-2, -1),
-                                   use_bias=False, dtype=cfg.dtype,
-                                   param_dtype=jnp.float32, name="o")(out)
-
-        scores = jnp.einsum("...qhd,...khd->...hqk", q, k,
-                            preferred_element_type=jnp.float32)
-        scores = scores / np.sqrt(head_dim)
-        qpos = positions[..., :, None]
-        kpos = positions[..., None, :]
-        causal = (kpos <= qpos)[..., None, :, :]
-        scores = jnp.where(causal, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-        out = jnp.einsum("...hqk,...khd->...qhd", probs, v)
+        else:
+            scores = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                                preferred_element_type=jnp.float32)
+            scores = scores / np.sqrt(head_dim)
+            qpos = positions[..., :, None]
+            kpos = positions[..., None, :]
+            causal = (kpos <= qpos)[..., None, :, :]
+            scores = jnp.where(causal, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("...hqk,...khd->...qhd", probs, v)
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False,
                                dtype=cfg.dtype, param_dtype=jnp.float32,
                                name="o")(out)

@@ -129,6 +129,9 @@ def _elastic_attempt_loop(attempt, available_slots, num_proc=None,
         max_np = num_proc
     if (min_np is not None and max_np is not None and min_np > max_np):
         raise ValueError(f"min_np ({min_np}) > max_np ({max_np})")
+    if (min_np is not None and num_proc is not None
+            and num_proc < min_np):
+        raise ValueError(f"num_proc ({num_proc}) < min_np ({min_np})")
     last_err = None
     for i in range(reset_limit + 1):
         world = available_slots()

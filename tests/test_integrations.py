@@ -696,3 +696,11 @@ def test_spark_run_elastic_gated():
 
     with pytest.raises(ImportError, match="pyspark"):
         run_elastic(lambda: None, num_proc=2)
+
+
+def test_elastic_attempt_loop_num_proc_below_min_rejected():
+    from horovod_tpu.spark.runner import _elastic_attempt_loop
+
+    with pytest.raises(ValueError, match="num_proc"):
+        _elastic_attempt_loop(lambda w, i: [], lambda: 16, num_proc=2,
+                              min_np=4, max_np=8)

@@ -4,6 +4,7 @@ cover the benchmark/flagship models and the driver entry contract)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 
@@ -310,3 +311,41 @@ class TestTpuBatchNorm:
         t, f = run("tpu"), run("flax")
         assert all(np.isfinite(t)) and all(np.isfinite(f))
         np.testing.assert_allclose(t, f, rtol=0.05, atol=0.02)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_gpt_ring_mesh_matches_plain(use_flash):
+    """GPTConfig.ring_mesh swaps GSPMD attention for the explicit ring
+    schedule (flash per block when use_flash) — logits and gradients
+    must match the plain model."""
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as PS
+
+    from horovod_tpu.models import GPT, GPTConfig
+    from horovod_tpu.parallel.mesh import make_parallel_mesh
+
+    mesh = make_parallel_mesh(sp=8)
+    cfg = GPTConfig(vocab_size=64, n_layers=2, d_model=32, n_heads=2,
+                    d_ff=64, dtype=jnp.float32)
+    tokens = jnp.asarray(np.random.RandomState(2).randint(0, 64, (2, 32)))
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    cfg_ring = dataclasses.replace(cfg, ring_mesh=mesh,
+                                   use_flash=use_flash)
+    model_r = GPT(cfg_ring)
+    tokens_sp = jax.device_put(tokens,
+                               NamedSharding(mesh, PS(None, "sp")))
+
+    def loss(m, p, t):
+        return (m.apply(p, t).astype(jnp.float32) ** 2).mean()
+
+    l0, g0 = jax.value_and_grad(lambda p: loss(model, p, tokens))(params)
+    l1, g1 = jax.value_and_grad(
+        lambda p: loss(model_r, p, tokens_sp))(params)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l0),
+                               rtol=2e-5, atol=2e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-5)
