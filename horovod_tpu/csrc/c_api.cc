@@ -26,6 +26,8 @@ void hvt_shutdown() { Engine::Get().Shutdown(); }
 int hvt_initialized() { return Engine::Get().initialized() ? 1 : 0; }
 int hvt_rank() { return Engine::Get().rank(); }
 int hvt_size() { return Engine::Get().size(); }
+int hvt_local_rank() { return Engine::Get().local_rank(); }
+int hvt_local_size() { return Engine::Get().local_size(); }
 
 // Returns handle >= 0, or -1 when the engine is not initialized.
 int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,

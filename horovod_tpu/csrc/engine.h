@@ -62,6 +62,12 @@ class Engine {
   bool initialized() const { return initialized_.load(); }
   int rank() const { return rank_; }
   int size() const { return size_; }
+  int local_rank() const { return topo_.my_local; }
+  int local_size() const {
+    return topo_.local_group.empty()
+               ? 1
+               : static_cast<int>(topo_.local_group.size());
+  }
   const ParameterManager& autotune() const { return autotune_; }
   bool cache_enabled() const { return cache_enabled_.load(); }
   bool prefer_flat() const { return prefer_flat_.load(); }

@@ -30,6 +30,8 @@ extern "C" {
 int hvt_initialized();
 int hvt_rank();
 int hvt_size();
+int hvt_local_rank();
+int hvt_local_size();
 int hvt_submit(const char* name, int op, int reduce, int dtype, int ndims,
                const long long* dims, const void* data, long long nbytes,
                int root_rank, double prescale, double postscale,
@@ -423,6 +425,12 @@ class HvtScalarOp : public OpKernel {
 
 static int SizeOrOne() { return hvt_initialized() ? hvt_size() : 1; }
 static int RankOrZero() { return hvt_initialized() ? hvt_rank() : 0; }
+static int LocalSizeOrOne() {
+  return hvt_initialized() ? hvt_local_size() : 1;
+}
+static int LocalRankOrZero() {
+  return hvt_initialized() ? hvt_local_rank() : 0;
+}
 
 #define HVT_DTYPES \
   "{uint8, int8, int32, int64, half, bfloat16, float, double, bool}"
@@ -503,6 +511,14 @@ REGISTER_OP("HvtSize").Output("size: int32").SetIsStateful().SetShapeFn(
     shape_inference::ScalarShape);
 REGISTER_OP("HvtRank").Output("rank: int32").SetIsStateful().SetShapeFn(
     shape_inference::ScalarShape);
+REGISTER_OP("HvtLocalSize")
+    .Output("local_size: int32")
+    .SetIsStateful()
+    .SetShapeFn(shape_inference::ScalarShape);
+REGISTER_OP("HvtLocalRank")
+    .Output("local_rank: int32")
+    .SetIsStateful()
+    .SetShapeFn(shape_inference::ScalarShape);
 
 REGISTER_KERNEL_BUILDER(Name("HvtAllreduce").Device(DEVICE_CPU),
                         HvtAllreduceOp);
@@ -519,5 +535,9 @@ REGISTER_KERNEL_BUILDER(Name("HvtSize").Device(DEVICE_CPU),
                         HvtScalarOp<SizeOrOne>);
 REGISTER_KERNEL_BUILDER(Name("HvtRank").Device(DEVICE_CPU),
                         HvtScalarOp<RankOrZero>);
+REGISTER_KERNEL_BUILDER(Name("HvtLocalSize").Device(DEVICE_CPU),
+                        HvtScalarOp<LocalSizeOrOne>);
+REGISTER_KERNEL_BUILDER(Name("HvtLocalRank").Device(DEVICE_CPU),
+                        HvtScalarOp<LocalRankOrZero>);
 
 }  // namespace hvt_tf

@@ -71,6 +71,19 @@ def broadcast_object(obj=None, root_rank=0, name=None,
     return _deserialize(np.asarray(data))
 
 
+def broadcast_object_fn(root_rank=0, name=None,
+                        process_set=C.global_process_set):
+    """Returns ``bcast(obj)`` closing over the broadcast parameters
+    (reference ``torch/functions.py:155`` / ``tensorflow/functions.py``)
+    — handy as a callback where the root/name are fixed up front."""
+
+    def _bcast(obj=None):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+
+    return _bcast
+
+
 def broadcast_parameters(params, root_rank=0,
                          process_set=C.global_process_set):
     """Broadcast a pytree of arrays (model params / optimizer state) from

@@ -48,7 +48,8 @@ from horovod_tpu.common.basics import (cross_rank, cross_size,  # noqa: F401
 # re-exported here for reference API parity (tensorflow/functions.py:
 # allgather_object / broadcast_object)
 from horovod_tpu.ops.functions import (allgather_object,  # noqa: F401
-                                       broadcast_object)
+                                       broadcast_object,
+                                       broadcast_object_fn)
 from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
 from horovod_tpu.tensorflow.sync_batch_norm import \
     SyncBatchNormalization  # noqa: F401
@@ -217,6 +218,63 @@ def rank_op():
     if native_ops.available():
         return native_ops.rank_op()
     return _tf.constant(rank(), dtype=_tf.int32)
+
+
+def local_size_op():
+    """Graph-time dynamic local size (reference ``mpi_ops.cc:787``)."""
+    _require_tf()
+    from horovod_tpu.tensorflow import native_ops
+    if native_ops.available():
+        return native_ops.local_size_op()
+    return _tf.constant(local_size(), dtype=_tf.int32)
+
+
+def local_rank_op():
+    """Graph-time dynamic local rank (reference ``mpi_ops.cc:817``)."""
+    _require_tf()
+    from horovod_tpu.tensorflow import native_ops
+    if native_ops.available():
+        return native_ops.local_rank_op()
+    return _tf.constant(local_rank(), dtype=_tf.int32)
+
+
+def grouped_allreduce(tensors, name=None, average=True,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None):
+    """Allreduce a list of tensors (reference
+    ``tensorflow/mpi_ops.py:grouped_allreduce``): one result per input.
+    Native path: per-tensor in-graph ops with indexed names — the engine
+    fuses them under its threshold; numpy path rides the engine's atomic
+    fusion group."""
+    tensors = list(tensors)
+    if not tensors:
+        return []
+    _require_tf()
+    nat = _native()
+    if nat is not None:
+        # name=None must stay None: graph mode then falls back to unique
+        # per-node names (two unnamed groups in one tf.function would
+        # otherwise collide on a baked default); eager auto-names rotate
+        # per call. One resolved `nat` keeps the whole group on one path.
+        return [nat.allreduce(_tf.convert_to_tensor(t),
+                              name=f"{name}.{i}" if name else None,
+                              op=nat.AVERAGE if average else nat.SUM,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
+                for i, t in enumerate(tensors)]
+    import numpy as np
+
+    from horovod_tpu.ops import collective_ops as C
+
+    outs = C.grouped_allreduce(
+        [np.asarray(t) for t in tensors],
+        name=name or "tf.grouped_allreduce",
+        op=C.Average if average else C.Sum,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        process_set=process_set or C.global_process_set)
+    return [_tf.convert_to_tensor(np.asarray(o)) for o in outs]
 
 
 def broadcast_variables(variables, root_rank=0):

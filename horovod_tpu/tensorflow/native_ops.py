@@ -186,6 +186,14 @@ def rank_op():
     return _load().hvt_rank()
 
 
+def local_size_op():
+    return _load().hvt_local_size()
+
+
+def local_rank_op():
+    return _load().hvt_local_rank()
+
+
 def _register_gradients():
     """Gradient registrations, mirroring reference tensorflow/mpi_ops.py:
     allreduce grad = allreduce of the gradient (:116), broadcast grad =

@@ -24,6 +24,7 @@ from horovod_tpu.torch import elastic
 from horovod_tpu.torch.compression import Compression
 from horovod_tpu.torch.functions import (allgather_object,
                                          broadcast_object,
+                                         broadcast_object_fn,
                                          broadcast_optimizer_state,
                                          broadcast_parameters)
 from horovod_tpu.torch.mpi_ops import (Adasum, Average, Max, Min, Product,
@@ -36,7 +37,9 @@ from horovod_tpu.torch.mpi_ops import (Adasum, Average, Max, Min, Product,
                                        broadcast_async_, grouped_allgather,
                                        grouped_allgather_async,
                                        grouped_allreduce,
-                                       grouped_allreduce_async, join, poll,
+                                       grouped_allreduce_,
+                                       grouped_allreduce_async,
+                                       grouped_allreduce_async_, join, poll,
                                        reducescatter, reducescatter_async,
                                        synchronize)
 from horovod_tpu.torch.optimizer import DistributedOptimizer
@@ -47,6 +50,7 @@ __all__ = [
     "local_size", "cross_rank", "cross_size",
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
     "grouped_allreduce", "grouped_allreduce_async",
+    "grouped_allreduce_", "grouped_allreduce_async_",
     "allgather", "allgather_async", "grouped_allgather",
     "grouped_allgather_async",
     "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
@@ -55,6 +59,7 @@ __all__ = [
     "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp",
     "DistributedOptimizer", "Compression", "SyncBatchNorm",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "broadcast_object_fn",
     "allgather_object",
     "ProcessSet", "global_process_set", "add_process_set",
     "remove_process_set",

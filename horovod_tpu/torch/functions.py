@@ -91,6 +91,18 @@ def broadcast_optimizer_state(optimizer, root_rank=0,
     optimizer.load_state_dict(state_dict)
 
 
+def broadcast_object_fn(root_rank=0, name=None,
+                        process_set=global_process_set):
+    """Returns ``bcast(obj)`` closing over the broadcast parameters
+    (reference ``torch/functions.py:155``)."""
+
+    def _bcast(obj=None):
+        return broadcast_object(obj, root_rank=root_rank, name=name,
+                                process_set=process_set)
+
+    return _bcast
+
+
 def broadcast_object(obj=None, root_rank=0, name=None,
                      process_set=global_process_set):
     """Pickle → byte tensor → size bcast → payload bcast → unpickle

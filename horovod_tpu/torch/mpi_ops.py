@@ -36,6 +36,7 @@ __all__ = [
     "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp",
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
     "grouped_allreduce", "grouped_allreduce_async",
+    "grouped_allreduce_", "grouped_allreduce_async_",
     "allgather", "allgather_async", "grouped_allgather",
     "grouped_allgather_async",
     "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
@@ -172,6 +173,36 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
                       prescale_factor=1.0, postscale_factor=1.0,
                       process_set=global_process_set):
     return synchronize(grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set))
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             process_set=global_process_set):
+    """In-place async grouped allreduce (reference
+    ``torch/mpi_ops.py:361``): each tensor is overwritten with its
+    reduced value on completion."""
+    h = grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)
+
+    def _copy_back(results):
+        for t, r in zip(tensors, results):
+            t.copy_(r)
+        return list(tensors)
+
+    return _MappedHandle(h, _copy_back)
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=global_process_set):
+    """Synchronous in-place grouped allreduce (reference
+    ``torch/mpi_ops.py:392``)."""
+    return synchronize(grouped_allreduce_async_(
         tensors, average=average, name=name, op=op,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
         process_set=process_set))

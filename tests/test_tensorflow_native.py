@@ -296,3 +296,34 @@ def test_native_zero_width_rows_keep_true_row_count():
         assert tuple(out.shape) == (n, 0), out.shape
         assert list(recv.numpy()) == [1] * n
     """)
+
+
+def test_native_local_ops_and_grouped_allreduce():
+    run_tf_workers("""
+        assert int(hvd.local_size_op()) == n     # single host: local == world
+        assert int(hvd.local_rank_op()) == r
+        outs = hvd.grouped_allreduce(
+            [tf.fill([2], float(r + 1)), tf.fill([3], float(2 * (r + 1)))],
+            name="ga", average=False)
+        s = sum(i + 1 for i in range(n))
+        np.testing.assert_allclose(outs[0].numpy(), float(s))
+        np.testing.assert_allclose(outs[1].numpy(), float(2 * s))
+    """)
+
+
+def test_native_two_unnamed_grouped_allreduces_in_one_tf_function():
+    # two name=None groups traced into ONE step must land on distinct
+    # per-node names (a baked default would collide and mis-pair)
+    run_tf_workers("""
+        @tf.function
+        def step(a, b):
+            g1 = hvd.grouped_allreduce([a], average=False)
+            g2 = hvd.grouped_allreduce([b], average=False)
+            return g1[0], g2[0]
+
+        o1, o2 = step(tf.fill([2], float(r + 1)),
+                      tf.fill([2], float(100 * (r + 1))))
+        s = sum(i + 1 for i in range(n))
+        np.testing.assert_allclose(o1.numpy(), float(s))
+        np.testing.assert_allclose(o2.numpy(), float(100 * s))
+    """)

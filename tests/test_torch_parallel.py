@@ -190,3 +190,25 @@ def test_torch_allreduce_process_set_4proc():
         expect = (1 + 3) if r % 2 == 0 else (2 + 4)
         assert torch.allclose(y, torch.full((3,), float(expect))), y
     """, np=4)
+
+
+def test_torch_grouped_allreduce_inplace():
+    # reference torch/mpi_ops.py:361-392 grouped_allreduce_(_async_):
+    # each tensor is overwritten with its reduced value
+    run_torch_workers("""
+        ts = [torch.full((3,), float(r + 1)),
+              torch.full((2,), float(10 * (r + 1)))]
+        out = hvd.grouped_allreduce_(ts, name="gip", op=hvd.Sum)
+        exp0 = float(sum(i + 1 for i in range(n)))
+        assert torch.allclose(ts[0], torch.full((3,), exp0)), ts[0]
+        assert torch.allclose(ts[1], torch.full((2,), 10 * exp0)), ts[1]
+        assert out[0] is ts[0] and out[1] is ts[1]  # in-place contract
+    """)
+
+
+def test_torch_broadcast_object_fn():
+    run_torch_workers("""
+        bcast = hvd.broadcast_object_fn(root_rank=1, name="bofn")
+        got = bcast({"v": r * 10} if r == 1 else None)
+        assert got == {"v": 10}, got
+    """)
