@@ -125,6 +125,23 @@ def LearningRateWarmupCallback(initial_lr, warmup_epochs=5,
                                 verbose=verbose))
 
 
+def BestModelCheckpoint(monitor="val_loss", verbose=0, mode="auto",
+                        save_freq="epoch", filepath=None):
+    """Checkpoint only the best model by ``monitor`` (reference
+    ``keras/callbacks.py:151`` BestModelCheckpoint — a ModelCheckpoint
+    pinned to save_best_only). Typically combined with a rank gate:
+    only rank 0's callback list should include it."""
+    _require_keras()
+    if filepath is None:
+        raise ValueError("BestModelCheckpoint requires filepath= "
+                         "(the reference injects it from the estimator "
+                         "store; standalone use must name the target)")
+    return _keras.callbacks.ModelCheckpoint(
+        filepath=filepath, monitor=monitor, verbose=verbose,
+        save_best_only=True, save_weights_only=False, mode=mode,
+        save_freq=save_freq)
+
+
 def CommitStateCallback(state, batches_per_commit=1):
     """Commit elastic state every N batches (reference
     ``_keras/elastic.py`` CommitStateCallbackImpl): a host failure rolls

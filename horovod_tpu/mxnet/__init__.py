@@ -14,6 +14,9 @@ while the Gluon ``DistributedTrainer`` subclass materializes only when
 
 from __future__ import annotations
 
+from horovod_tpu.ops.functions import (allgather_object,  # noqa: F401
+                                       broadcast_object,
+                                       broadcast_object_fn)
 from horovod_tpu.common.basics import (cross_rank, cross_size,  # noqa: F401
                                        init, is_initialized, local_rank,
                                        local_size, rank, shutdown, size)

@@ -405,3 +405,20 @@ def test_graph_mode_aggregation_rejects_changed_variable_list():
     step_a(tf.constant([1.0, 1.0]))
     with pytest.raises(Exception, match="different variable list"):
         step_b(tf.constant([1.0, 1.0]))
+
+
+def test_keras_best_model_checkpoint(tmp_path):
+    """BestModelCheckpoint parity (reference keras/callbacks.py:151):
+    saves only when the monitored metric improves."""
+    import horovod_tpu.keras as hvt_keras
+
+    path = str(tmp_path / "best.keras")
+    model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+    model.compile(optimizer="sgd", loss="mse")
+    cb = hvt_keras.BestModelCheckpoint(monitor="loss", filepath=path)
+    X = np.random.RandomState(0).randn(64, 3).astype(np.float32)
+    y = (X @ np.asarray([1.0, -1.0, 0.5], np.float32))
+    model.fit(X, y, epochs=3, verbose=0, callbacks=[cb])
+    assert tf.io.gfile.exists(path)
+    with pytest.raises(ValueError, match="filepath"):
+        hvt_keras.BestModelCheckpoint(monitor="loss")
