@@ -89,7 +89,8 @@ def calibrate_matmul_tflops(platform):
 
 
 def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
-                dtype_name, seq_len=1024, use_flash=False):
+                dtype_name, seq_len=1024, use_flash=False,
+                chunked_ce=False):
     """GPT train-step throughput on a dp mesh (tokens/sec/chip) — the
     flagship-model counterpart of the ResNet measurement. FLOPs/token by
     the standard training estimate 6N + 12·L·d_model·seq (dense matmuls
@@ -125,8 +126,17 @@ def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
     opt_state = jax.device_put(tx.init(params), repl)
 
     def loss_fn(params):
-        logits = model.apply({"params": params}, tokens)
         targets = jnp.roll(tokens, -1, axis=-1)
+        if chunked_ce:
+            # fuse the vocab projection into a sequence-chunked CE: the
+            # [B, S, V] logits tensor is never materialized (losses.py)
+            from horovod_tpu.ops.losses import softmax_cross_entropy_fused
+
+            hidden = model.apply({"params": params}, tokens,
+                                 return_hidden=True)
+            return softmax_cross_entropy_fused(
+                hidden[:, :-1], params["embedding"], targets[:, :-1])
+        logits = model.apply({"params": params}, tokens)
         return optax.softmax_cross_entropy_with_integer_labels(
             logits[:, :-1], targets[:, :-1]).mean()
 
@@ -289,6 +299,10 @@ def main():
     p.add_argument("--flash", action="store_true",
                    help="gpt: pallas fused attention instead of the "
                         "einsum-softmax path")
+    p.add_argument("--chunked-ce", action="store_true",
+                   help="gpt: sequence-chunked fused cross-entropy — the "
+                        "[B,S,V] logits tensor is never materialized "
+                        "(ops/losses.py); frees HBM for larger batches")
     p.add_argument("--bn-impl", default="tpu", choices=["tpu", "flax"],
                    help="resnet batch norm: 'tpu' = bf16-traffic "
                         "fp32-accumulated TpuBatchNorm (default), 'flax' "
@@ -367,7 +381,8 @@ def main():
         if gpt:
             return measure_gpt(devs, bs, iters, args.num_batches_per_iter,
                                dtype_name, args.seq_len,
-                               use_flash=args.flash)
+                               use_flash=args.flash,
+                               chunked_ce=args.chunked_ce)
         return measure(args.model, devs, bs, iters,
                        args.num_batches_per_iter, dtype_name,
                        args.image_size, norm_impl=args.bn_impl)

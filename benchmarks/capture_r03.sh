@@ -31,4 +31,7 @@ HVT_FLASH_INTERPRET=0 run env HVT_FLASH_INTERPRET=0 python bench.py --model gpt 
 # 5. flash at longer context where the win should grow
 run env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --no-scaling --flash --seq-len 2048 --batch-size 4
 run python bench.py --model gpt --no-scaling --seq-len 2048 --batch-size 4
+# 6. chunked fused CE: logits never materialized -> room for bigger batch
+run python bench.py --model gpt --no-scaling --chunked-ce
+run python bench.py --model gpt --no-scaling --chunked-ce --batch-size 16
 echo "=== capture_r03 done $(date -u) ===" >> "$LOG"

@@ -152,7 +152,12 @@ class GPT(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
+        """Logits by default; ``return_hidden=True`` returns the final
+        (post-ln) hidden states instead, for memory-bounded losses that
+        fuse the vocab projection (``ops.losses
+        .softmax_cross_entropy_fused`` with the tied embedding) — the
+        [batch, seq, vocab] logits tensor is then never materialized."""
         cfg = self.cfg
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[-1]), tokens.shape)
@@ -165,6 +170,8 @@ class GPT(nn.Module):
         for i in range(cfg.n_layers):
             x = block(cfg, name=f"block_{i}")(x, positions)
         x = RMSNorm(name="ln_f")(x)
+        if return_hidden:
+            return x
         logits = jnp.einsum("...ld,vd->...lv", x.astype(jnp.float32), emb)
         return logits
 

@@ -1,0 +1,73 @@
+"""Memory-bounded LM losses.
+
+``softmax_cross_entropy_fused`` computes the language-model loss straight
+from hidden states and the (tied) embedding matrix WITHOUT materializing
+the full ``[batch, seq, vocab]`` logits tensor: the sequence axis is
+processed in chunks under ``lax.scan`` with per-chunk rematerialization,
+so peak activation memory is ``[batch, chunk, vocab]`` in the forward
+AND the backward (autodiff of a remat'd scan body recomputes the chunk's
+logits instead of keeping them alive).
+
+Why it matters on TPU: at vocab 32k, seq 1k, bs 8 the logits tensor is
+~1 GB of fp32 HBM that exists only to be softmaxed once — the classic
+memory-bound tail of an LM step. Bounding it frees HBM for larger
+per-chip batches (the lever that raises MFU). No reference counterpart
+(the reference ships no model/loss code).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def softmax_cross_entropy_fused(hidden, emb, targets, *, chunk=128):
+    """Mean token cross-entropy of ``hidden @ emb.T`` against ``targets``.
+
+    Args:
+      hidden: [batch, seq, d_model] final hidden states (any float dtype;
+        the projection accumulates in fp32).
+      emb: [vocab, d_model] output/tied embedding matrix.
+      targets: [batch, seq] int target ids.
+      chunk: sequence-chunk length; peak logits memory is
+        [batch, chunk, vocab]. Sequences that are not a chunk multiple
+        are zero-padded and masked — the chunk size (and therefore the
+        memory bound and MXU tile shape) is honored for ANY seq.
+
+    Returns the scalar mean loss over all tokens. Differentiable w.r.t.
+    ``hidden`` and ``emb``; gradients match the unchunked computation.
+    """
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    b, s, d = hidden.shape
+    chunk = min(chunk, s)
+    pad = (-s) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    # 1 for real tokens, 0 for padding — padded positions contribute 0
+    # to the sum regardless of their (garbage) logits
+    mask = (jnp.arange(s + pad) < s).astype(jnp.float32)
+    mask = jnp.broadcast_to(mask, (b, s + pad))
+    n_chunks = (s + pad) // chunk
+
+    # [n_chunks, B, chunk, ...] scan layout
+    hs = jnp.moveaxis(hidden.reshape(b, n_chunks, chunk, d), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(b, n_chunks, chunk), 1, 0)
+    ms = jnp.moveaxis(mask.reshape(b, n_chunks, chunk), 1, 0)
+
+    @jax.checkpoint
+    def chunk_loss(h, t, w):
+        logits = jnp.einsum("bcd,vd->bcv", h.astype(jnp.float32),
+                            emb.astype(jnp.float32))
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        return ((lse - tgt) * w).sum()
+
+    def body(acc, xs):
+        h, t, w = xs
+        return acc + chunk_loss(h, t, w), None
+
+    total, _ = lax.scan(body, jnp.float32(0.0), (hs, ts, ms))
+    return total / (b * s)
