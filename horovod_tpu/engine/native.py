@@ -39,6 +39,11 @@ _engine_inited = False
 
 
 def _lib_path():
+    # HVT_CORE_LIB: alternate engine build (the sanitizer CI matrix —
+    # `make -C horovod_tpu/csrc tsan/asan` → build-tsan/build-asan)
+    override = os.environ.get("HVT_CORE_LIB")
+    if override:
+        return override
     here = os.path.dirname(os.path.abspath(__file__))
     return os.path.join(os.path.dirname(here), "csrc", "build",
                         "libhvt_core.so")
@@ -51,11 +56,18 @@ def _load():
             return _lib
         _load_attempted = True
         path = _lib_path()
+        explicit = bool(os.environ.get("HVT_CORE_LIB"))
         if not os.path.exists(path):
+            if explicit:
+                # an explicit override silently degrading would let a
+                # sanitizer run "pass" without exercising the engine
+                raise OSError(f"HVT_CORE_LIB={path} does not exist")
             return None
         try:
             lib = ctypes.CDLL(path)
         except OSError:
+            if explicit:
+                raise
             return None
         lib.hvt_init.argtypes = [ctypes.c_int, ctypes.c_int,
                                  ctypes.c_char_p, ctypes.c_int,

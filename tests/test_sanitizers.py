@@ -1,0 +1,84 @@
+"""Sanitizer smoke over the C++ engine (SURVEY §5.2: sanitizers as a CI
+matrix choice). Builds the engine with -fsanitize=thread, then drives a
+2-proc job that hammers the engine from multiple submitter threads —
+any data race in the engine-thread/submitter/waiter interplay fails the
+job via TSAN_OPTIONS exitcode. Cross-PROCESS shm synchronization is
+outside TSAN's model; the progress-word design + interleave stress
+tests cover that."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tests.test_engine_integration import REPO, _PORT
+
+try:
+    TSAN_LIB = subprocess.run(["gcc", "-print-file-name=libtsan.so"],
+                              capture_output=True, text=True
+                              ).stdout.strip()
+except (OSError, subprocess.SubprocessError):  # no gcc → skip below
+    TSAN_LIB = ""
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isabs(TSAN_LIB) or not os.path.exists(TSAN_LIB),
+    reason="libtsan not available")
+
+WORKER = textwrap.dedent("""
+    import sys, threading
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import horovod_tpu as hvt
+    hvt.init()
+    r, n = hvt.rank(), hvt.size()
+
+    def worker(tid):
+        for i in range(25):
+            res = np.asarray(hvt.allreduce(
+                np.full((64,), float(r + 1), np.float32), op=hvt.Sum,
+                name=f"t{{tid}}.{{i}}"))
+            np.testing.assert_allclose(
+                res, float(sum(k + 1 for k in range(n))))
+
+    ths = [threading.Thread(target=worker, args=(t,)) for t in range(2)]
+    [t.start() for t in ths]
+    [t.join() for t in ths]
+    print(f"rank {{r}}: TSAN OK")
+""").format(repo=REPO)
+
+
+@pytest.mark.timeout(600)
+def test_engine_threading_clean_under_tsan(tmp_path):
+    rc = subprocess.run(["make", "-C",
+                         os.path.join(REPO, "horovod_tpu", "csrc"),
+                         "tsan"], capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    worker = tmp_path / "w.py"
+    worker.write_text(WORKER)
+    report = str(tmp_path / "tsan_report")
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "HVT_CORE_LIB": os.path.join(REPO, "horovod_tpu", "csrc",
+                                     "build-tsan", "libhvt_core.so"),
+        "LD_PRELOAD": TSAN_LIB,
+        # halt_on_error off: collect everything, judge by report files +
+        # forced exitcode on any finding
+        "TSAN_OPTIONS": f"exitcode=66 log_path={report}",
+        "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "",
+    })
+    _PORT[0] += 1
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", "2",
+         "--master-port", str(_PORT[0]), sys.executable, str(worker)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    reports = [f for f in os.listdir(tmp_path) if f.startswith("tsan_report")]
+    assert proc.returncode == 0 and not reports, (
+        f"rc={proc.returncode} reports={reports}\n{proc.stdout[-2000:]}"
+        f"\n{proc.stderr[-2000:]}")
+    assert proc.stdout.count("TSAN OK") == 2, proc.stdout[-1000:]
