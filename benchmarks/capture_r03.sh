@@ -1,37 +1,8 @@
 #!/bin/bash
-# Round-3 TPU capture: waits for the axon tunnel, then immediately runs
-# the full benchmark set (VERDICT r2 #1-3) and appends everything to the
-# log. Designed to run detached so no human latency sits between tunnel
-# recovery and capture — the round-2 outage ate the capture window.
-LOG=${1:-/tmp/r03_capture.log}
-cd "$(dirname "$0")/.." || exit 1
-echo "=== capture_r03 started $(date -u) ===" >> "$LOG"
-for i in $(seq 1 60); do
-  if timeout 120 python -c "import jax; print(jax.devices())" >> "$LOG" 2>&1; then
-    echo "=== TUNNEL UP $(date -u) — capturing ===" >> "$LOG"
-    break
-  fi
-  echo "capture probe $i: tunnel down $(date -u)" >> "$LOG"
-  if [ "$i" = 60 ]; then echo "=== gave up ===" >> "$LOG"; exit 1; fi
-  sleep 540
-done
-run() {
-  echo "--- $* ($(date -u)) ---" >> "$LOG"
-  timeout 2400 "$@" >> "$LOG" 2>&1
-  echo "--- rc=$? ---" >> "$LOG"
-}
-# 1. ResNet-50, new TpuBatchNorm (the MFU>=0.5 attempt)
-run python bench.py --no-scaling
-# 2. A/B: stock flax BN (the round-2 0.394 configuration)
-run python bench.py --no-scaling --bn-impl flax
-# 3. GPT einsum baseline
-run python bench.py --model gpt --no-scaling
-# 4. GPT with the COMPILED pallas flash kernel (first compiled run on axon)
-HVT_FLASH_INTERPRET=0 run env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --no-scaling --flash
-# 5. flash at longer context where the win should grow
-run env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --no-scaling --flash --seq-len 2048 --batch-size 4
-run python bench.py --model gpt --no-scaling --seq-len 2048 --batch-size 4
-# 6. chunked fused CE: logits never materialized -> room for bigger batch
-run python bench.py --model gpt --no-scaling --chunked-ce
-run python bench.py --model gpt --no-scaling --chunked-ce --batch-size 16
-echo "=== capture_r03 done $(date -u) ===" >> "$LOG"
+# Superseded by capture_r03b.sh (data-plane-gated capture): the v1 gate
+# — jax.devices() answering — proved insufficient on 2026-07-31, when
+# the control plane listed the chip while every compile/execute RPC
+# blocked forever (BENCH_NOTES.md). v2 gates each run on an end-to-end
+# tiny matmul instead. This shim keeps old invocations working; the
+# benchmark run list lives in ONE place (capture_r03b.sh).
+exec bash "$(dirname "$0")/capture_r03b.sh" "$@"

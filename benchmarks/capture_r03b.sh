@@ -1,0 +1,54 @@
+#!/bin/bash
+# Round-3 TPU capture, v2: every heavy benchmark is gated behind a cheap
+# DATA-PLANE sanity probe (benchmarks/tpu_sanity.py). The round-2/3
+# outages showed jax.devices() can answer while compile/execute RPCs
+# block forever — v1 would then burn a 40-minute timeout per run against
+# a wedged tunnel. v2 probes (3-minute bound) before each run, re-probes
+# on failure, and keeps a per-run ledger so partial captures survive.
+LOG=${1:-/tmp/r03_capture.log}
+cd "$(dirname "$0")/.." || exit 1
+# Single-pilot rule: disarm any v1 pipeline (and its in-flight bench)
+# still probing from an earlier session — two capture loops sharing the
+# one chip would corrupt each other's timings.
+for pid in $(pgrep -f "capture_r03.sh" | grep -vw $$); do
+  pkill -TERM -P "$pid" 2>/dev/null
+  kill "$pid" 2>/dev/null
+done
+pkill -f "timeout 2400 python bench.py" 2>/dev/null
+echo "=== capture_r03b started $(date -u) ===" >> "$LOG"
+
+sane() {
+  timeout 180 python benchmarks/tpu_sanity.py >> "$LOG" 2>&1
+}
+
+wait_sane() {
+  # Probe until the data plane answers; 9-minute spacing like the
+  # round-2 watcher. Bounded at ~8h so the script eventually exits.
+  for i in $(seq 1 55); do
+    if sane; then return 0; fi
+    echo "probe $i: data plane wedged/down $(date -u)" >> "$LOG"
+    sleep 540
+  done
+  echo "=== gave up waiting for data plane $(date -u) ===" >> "$LOG"
+  exit 1
+}
+
+run() {
+  wait_sane
+  echo "--- $* ($(date -u)) ---" >> "$LOG"
+  timeout 2400 "$@" >> "$LOG" 2>&1
+  echo "--- rc=$? ($(date -u)) ---" >> "$LOG"
+}
+
+# Ordered by information value: headline ResNet first (VERDICT #1/#2),
+# then the BN A/B, then GPT einsum vs compiled flash (VERDICT #3), then
+# long-context flash, then the fused chunked-CE runs.
+run python bench.py --no-scaling
+run python bench.py --no-scaling --bn-impl flax
+run python bench.py --model gpt --no-scaling
+run env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --no-scaling --flash
+run env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --no-scaling --flash --seq-len 2048 --batch-size 4
+run python bench.py --model gpt --no-scaling --seq-len 2048 --batch-size 4
+run python bench.py --model gpt --no-scaling --chunked-ce
+run python bench.py --model gpt --no-scaling --chunked-ce --batch-size 16
+echo "=== capture_r03b done $(date -u) ===" >> "$LOG"

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Data-plane sanity probe for the tunneled TPU.
+
+``jax.devices()`` answering does NOT mean the chip can run work: during
+the round-2/3 outages the control plane kept listing the device while
+every compile/execute RPC blocked forever. This probe jits one tiny
+matmul end-to-end (compile + execute + readback) and exits 0 only if the
+result comes back. Run it under ``timeout`` — a wedged tunnel blocks
+here, not 40 minutes into a benchmark.
+"""
+
+import sys
+import time
+
+
+def main():
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()
+    t1 = time.perf_counter()
+    if d[0].platform == "cpu":
+        # Silent CPU fallback (TPU plugin failed fast): the matmul would
+        # succeed instantly and open the gate onto a dead TPU.
+        print(f"sanity FAIL: backend fell back to cpu ({d})")
+        return 1
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    v = float(f(x))
+    t2 = time.perf_counter()
+    print(f"sanity ok: {d[0].platform} devices={len(d)} "
+          f"init {t1 - t0:.1f}s exec {t2 - t1:.1f}s value {v:.0f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
