@@ -10,11 +10,22 @@ cd "$(dirname "$0")/.." || exit 1
 # Single-pilot rule: disarm any v1 pipeline (and its in-flight bench)
 # still probing from an earlier session — two capture loops sharing the
 # one chip would corrupt each other's timings.
-for pid in $(pgrep -f "capture_r03.sh" | grep -vw $$); do
+# Exclude our whole ancestor chain, not just $$: a non-exec wrapper
+# (nohup timeout ... capture_r03b.sh) matches the pattern too, and
+# killing it would tear down this very instance at startup.
+self_and_ancestors=$$
+p=$$
+while [ "$p" -gt 1 ]; do
+  p=$(awk '{print $4}' "/proc/$p/stat" 2>/dev/null) || break
+  [ -n "$p" ] || break
+  self_and_ancestors="$self_and_ancestors|$p"
+done
+for pid in $(pgrep -f "capture_r03b?\.sh" | grep -Evw "$self_and_ancestors"); do
   pkill -TERM -P "$pid" 2>/dev/null
   kill "$pid" 2>/dev/null
 done
-pkill -f "timeout 2400 python bench.py" 2>/dev/null
+# loose match: also catches env-wrapped runs (timeout 2400 env HVT_... python bench.py)
+pkill -f "timeout 2400 .*python bench\.py" 2>/dev/null
 echo "=== capture_r03b started $(date -u) ===" >> "$LOG"
 
 sane() {
@@ -23,9 +34,17 @@ sane() {
 
 wait_sane() {
   # Probe until the data plane answers; 9-minute spacing like the
-  # round-2 watcher. Bounded at ~8h so the script eventually exits.
+  # round-2 watcher. Bounded at ~11h (55 x (180s probe + 540s sleep))
+  # so the script eventually exits. A deterministic LOCAL failure
+  # (tpu_sanity exit 2: import error, broken env) bails immediately —
+  # retrying cannot fix those.
   for i in $(seq 1 55); do
-    if sane; then return 0; fi
+    sane; rc=$?
+    if [ "$rc" -eq 0 ]; then return 0; fi
+    if [ "$rc" -eq 2 ]; then
+      echo "=== local failure (sanity rc=2), bailing $(date -u) ===" >> "$LOG"
+      exit 2
+    fi
     echo "probe $i: data plane wedged/down $(date -u)" >> "$LOG"
     sleep 540
   done

@@ -39,4 +39,14 @@ def main():
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # Exit codes: 0 = data plane sane; 1 = wedged/fallback (retryable —
+    # the tunnel may recover); 2 = local deterministic failure (import
+    # error, broken env — retrying cannot help, callers should bail).
+    # Only import/syntax errors are deterministic: a flapping tunnel can
+    # surface as OSError subclasses (ConnectionReset/Refused, Timeout)
+    # during jax init, and those are exactly the retryable class.
+    try:
+        sys.exit(main())
+    except (ImportError, SyntaxError) as e:
+        print(f"sanity LOCAL-FAIL: {type(e).__name__}: {e}")
+        sys.exit(2)

@@ -206,7 +206,13 @@ def rank() -> int:
     local = jax.local_devices()
     if not local:
         return 0
-    return min(d.id for d in local)
+    # Slot index = POSITION of this process's first device in the global
+    # id order, not the raw id: TPU ids are contiguous slot numbers, but
+    # multi-process CPU/GPU backends offset ids per process (e.g. CPU
+    # ids jump by 131072 per process), so counting smaller ids is the
+    # platform-independent form.
+    mine = min(d.id for d in local)
+    return sum(1 for d in jax.devices() if d.id < mine)
 
 
 def local_rank() -> int:

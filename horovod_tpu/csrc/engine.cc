@@ -460,6 +460,59 @@ bool Engine::RunCycle() {
 // coordinator (rank 0)
 // --------------------------------------------------------------------------
 
+// Fold rank `r`'s cached-hit announcement for `pos` into the slow-path
+// negotiation of the tensor cached there, as if the rank had announced a
+// full Request with the cached params (a hit certifies its params matched
+// the cache at announce time). This is the liveness valve for MIXED
+// hit/miss states: the cache-enabled flag is applied at a frame boundary
+// on every rank, but two ranks can announce the SAME tensor in frames on
+// opposite sides of an autotuner flip — one as a hit, one as a miss.
+// Without folding, the hit waits for all-ranks-hit and the miss waits for
+// all-ranks-request, and both starve forever (observed as the
+// test_autotune_engine_integration stall: rank 0 wedged 60 s on g1).
+// The reference's CacheCoordinator avoids the state by synchronizing hit
+// bitvectors before acting (response_cache.cc); we reconcile instead.
+void Engine::HitToArrival(int r, int64_t pos, double now_sec) {
+  const CachedParams* p = cache_.ParamsAt(static_cast<int32_t>(pos));
+  if (!p) return;  // position already evicted; the eviction broadcast
+                   // re-opened the name on rank r, which re-announces a
+                   // plain miss next cycle
+  const std::string& name = cache_.NameAt(static_cast<int32_t>(pos));
+  Request q;
+  q.rank = r;
+  q.op = p->op;
+  q.reduce = p->reduce;
+  q.name = name;
+  q.dtype = p->dtype;
+  q.shape = p->shape;
+  q.root_rank = p->root_rank;
+  q.prescale = p->prescale;
+  q.postscale = p->postscale;
+  q.splits = p->splits;
+  // only ungrouped global-set allreduces are cacheable → the negotiation
+  // key is the bare name (no process-set suffix)
+  RegisterArrival(name, r, std::move(q), now_sec);
+}
+
+// Single home of the negotiation-arrival bookkeeping, shared by the
+// request loop and the hit-fold path so the two can never diverge.
+// Returns false when the rank was already counted for this key.
+bool Engine::RegisterArrival(const std::string& key, int r, Request q,
+                             double now_sec) {
+  auto& tc = counts_[key];
+  if (tc.seen.empty()) tc.seen.assign(size_, false);
+  if (tc.seen[r]) return false;
+  tc.seen[r] = true;
+  if (tc.first_seen_sec == 0) tc.first_seen_sec = now_sec;
+  if (timeline_.active()) {
+    if (tc.count == 0) timeline_.NegotiateStart(q.name, OpName(q.op));
+    timeline_.NegotiateRankReady(q.name, r);
+  }
+  tc.requests.push_back(std::move(q));
+  tc.count++;
+  return true;
+}
+
 std::vector<Response> Engine::Coordinate(
     const std::vector<std::vector<uint8_t>>& frames) {
   std::vector<Response> out;
@@ -476,7 +529,18 @@ std::vector<Response> Engine::Coordinate(
     auto hits = rd.i64vec();
     auto invalids = rd.i64vec();
     auto reqs = DecodeRequestList(rd);
-    for (auto pos : hits) hit_pending_[r].insert(pos);
+    for (auto pos : hits) {
+      // mixed hit/miss reconciliation, hit-after-miss direction: the
+      // tensor cached at `pos` is already in slow-path negotiation
+      // (some rank announced it as a miss), so fold this hit into that
+      // negotiation instead of parking it on the fast path it can
+      // never complete
+      const CachedParams* cp = cache_.ParamsAt(static_cast<int32_t>(pos));
+      if (cp && counts_.count(cache_.NameAt(static_cast<int32_t>(pos))))
+        HitToArrival(r, pos, now);
+      else
+        hit_pending_[r].insert(pos);
+    }
     for (auto pos : invalids)
       if (pos >= 0) pending_evictions_.push_back(pos);
     for (auto& q : reqs) {
@@ -489,18 +553,18 @@ std::vector<Response> Engine::Coordinate(
         ck += '\x01';
         for (auto mr : q.members) ck += std::to_string(mr) + ",";
       }
-      auto& tc = counts_[ck];
-      if (tc.seen.empty()) tc.seen.assign(size_, false);
-      if (tc.seen[r]) continue;
-      tc.seen[r] = true;
-      tc.requests.push_back(q);
-      if (tc.first_seen_sec == 0) tc.first_seen_sec = now;
-      if (timeline_.active()) {
-        if (tc.count == 0)
-          timeline_.NegotiateStart(q.name, OpName(q.op));
-        timeline_.NegotiateRankReady(q.name, r);
+      if (!RegisterArrival(ck, r, q, now)) continue;
+      // miss-after-hit direction: other ranks may have announced this
+      // tensor as a cached hit in an earlier frame (before an autotuner
+      // cache flip, or with a since-diverged param set). Fold those hits
+      // into this fresh negotiation; param disagreements then surface as
+      // BuildResponse errors instead of a starved protocol.
+      if (q.members.empty()) {
+        int32_t cpos = cache_.PositionOf(q.name);
+        if (cpos >= 0)
+          for (int r2 = 0; r2 < size_; ++r2)
+            if (hit_pending_[r2].erase(cpos)) HitToArrival(r2, cpos, now);
       }
-      tc.count++;
     }
   }
 
@@ -688,20 +752,44 @@ std::vector<Response> Engine::Coordinate(
       resp.numels = {p->shape.num_elements()};
       out.push_back(resp);
     }
+  } else {
+    // Some rank joined: it will never announce its remaining tensors,
+    // so the all-ranks-hit fast path above can never fire again. Fold
+    // every outstanding hit into slow-path negotiation — its required
+    // count excludes joined ranks — so cached tensors cannot starve
+    // behind a join (reference JoinOp + CacheCoordinator interplay).
+    for (int r = 0; r < size_; ++r) {
+      std::set<int64_t> hp;
+      hp.swap(hit_pending_[r]);
+      for (auto pos : hp) HitToArrival(r, pos, now);
+    }
   }
 
   // slow path: tensors every active participant announced (the global
-  // set, or the request's process-set members)
+  // set, or the request's process-set members). EVERY active participant
+  // must be individually seen — a raw count would let announcements from
+  // since-JOINED ranks (e.g. an async submit followed by join, or a
+  // folded hit from the join branch above) stand in for active ranks
+  // that never announced, firing a collective half its participants
+  // haven't entered.
   std::vector<std::string> complete;
   for (auto& [name, tc] : counts_) {
     const auto& mem = tc.requests[0].members;
-    int required = active;
-    if (!mem.empty()) {
-      required = 0;
+    bool all_seen = true;
+    int required = 0;
+    auto need = [&](int r2) {
+      required++;
+      all_seen = all_seen &&
+                 (r2 < static_cast<int>(tc.seen.size()) && tc.seen[r2]);
+    };
+    if (mem.empty()) {
+      for (int r2 = 0; r2 < size_; ++r2)
+        if (!rank_joined_[r2]) need(r2);
+    } else {
       for (auto mr : mem)
-        if (mr >= 0 && mr < size_ && !rank_joined_[mr]) required++;
+        if (mr >= 0 && mr < size_ && !rank_joined_[mr]) need(static_cast<int>(mr));
     }
-    if (tc.count >= required && required > 0) complete.push_back(name);
+    if (all_seen && required > 0) complete.push_back(name);
   }
   for (auto& name : complete) {
     auto& tc = counts_[name];

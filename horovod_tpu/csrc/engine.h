@@ -106,6 +106,9 @@ class Engine {
   Response BuildResponse(const std::vector<Request>& reqs);
   void FuseResponses(std::vector<Response>& responses);
   void CheckStalls();
+  void HitToArrival(int rank, int64_t pos, double now_sec);
+  bool RegisterArrival(const std::string& key, int rank, Request q,
+                       double now_sec);
 
   // first backend whose Enabled() accepts the response (never null —
   // the ring fallback accepts everything)

@@ -159,6 +159,37 @@ def test_autotune_engine_integration():
     assert "AUTOTUNE-SAMPLES-" in out
 
 
+def test_autotune_cache_flip_race_stress_2proc():
+    """Liveness pin for the mixed hit/miss wedge (round-3 CI flake):
+    with the tuner flipping cache-enabled as often as every cycle and
+    rank-staggered submission jitter, two ranks routinely announce the
+    SAME tensor in frames on opposite sides of a flip — one as a cached
+    hit, one as a plain miss. Pre-fold coordinators starved both paths
+    (rank 0 wedged 60 s on g1, then the 90 s timeout). The coordinator
+    now folds hits into the slow-path negotiation (Engine::HitToArrival),
+    so this must complete every step regardless of flip timing."""
+    from tests.test_engine_integration import run_workers
+
+    out = run_workers("""
+        import time
+        for step in range(200):
+            # stagger ranks into different engine frames so hit/miss
+            # announcements straddle tuner flips
+            time.sleep(0.0015 * ((r + step) % 3))
+            x = np.full((128,), float(r + 1), np.float32)
+            res = np.asarray(hvt.allreduce(x, name=f"g{step % 4}",
+                                           average=True))
+            np.testing.assert_allclose(res, (1 + n) / 2.0)
+    """,
+        timeout=150,
+        extra_env={"HVT_AUTOTUNE": "1",
+                   "HVT_AUTOTUNE_WARMUP_SAMPLES": "1",
+                   "HVT_AUTOTUNE_CYCLES_PER_SAMPLE": "1",
+                   "HVT_AUTOTUNE_MAX_SAMPLES": "500",
+                   "HVT_CYCLE_TIME_MS": "1"})
+    assert "WORKER-0-DONE" in out and "WORKER-1-DONE" in out
+
+
 def test_autotune_four_knobs_converge_and_stay_synchronized_4proc():
     """Widened tuning surface (reference parameter_manager.h:60-78):
     {fusion threshold, cycle time, cache enabled, backend preference}.

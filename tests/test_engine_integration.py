@@ -17,13 +17,31 @@ pytestmark = pytest.mark.skipif(
     not os.path.exists(LIB),
     reason="C++ engine not built (make -C horovod_tpu/csrc)")
 
-_PORT = [29600]
+# Per-pytest-process port base: two concurrent pytest invocations (e.g. a
+# stress loop alongside a normal run) must not race for the same master
+# port — rank 0's control/coordinator listener binds it exclusively. The
+# pid spreads bases apart; _next_port() additionally probe-binds so a
+# base collision degrades to a skipped port, not a failed test.
+_PORT = [20000 + (os.getpid() * 641) % 10000]
+
+
+def _next_port():
+    import socket
+    while True:
+        _PORT[0] += 1
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", _PORT[0]))
+                return _PORT[0]
+            except OSError:
+                continue
 
 
 def run_workers(body, np=2, timeout=90, extra_env=None, expect_rc=0,
                 launcher_args=()):
     """Write a worker script and launch it with hvtrun -np N."""
-    _PORT[0] += 1
+    _next_port()
     script = textwrap.dedent(f"""
         import os, sys
         sys.path.insert(0, {REPO!r})
@@ -132,6 +150,60 @@ def test_adasum_start_level_2proc():
         res = np.asarray(hvt.allreduce(x, op=hvt.Adasum, name="asl"))
         np.testing.assert_allclose(res, [2.0, 1.0], rtol=1e-6)
     """, extra_env={"HVT_ADASUM_START_LEVEL": "2"})
+
+
+def test_join_with_cached_hit_does_not_starve_2proc():
+    """Liveness pin: a rank announcing a CACHED HIT while the peer joins
+    must still complete. The all-ranks-hit fast path can never fire once
+    a rank is joined (it will never announce), so the coordinator must
+    fold outstanding hits into slow-path negotiation whose required count
+    excludes joined ranks (engine.cc Coordinate else-branch). Before that
+    fold existed this wedged deterministically: step 1 caches 'g', rank 1
+    joins, rank 0's second submit of 'g' is a hit that waits forever for
+    a peer hit that cannot come."""
+    run_workers("""
+        # step 1: negotiate + cache 'g' on both ranks
+        res = np.asarray(hvt.allreduce(np.ones((3,), np.float32),
+                                       op=hvt.Sum, name="g"))
+        np.testing.assert_allclose(res, 2.0)
+        if r == 0:
+            # step 2: identical params → cache hit, peer joined → zeros
+            res = np.asarray(hvt.allreduce(np.ones((3,), np.float32),
+                                           op=hvt.Sum, name="g"))
+            np.testing.assert_allclose(res, 1.0)
+        last = hvt.join()
+        assert last == 0, last
+    """)
+
+
+def test_async_submit_then_join_pairs_with_late_peer_2proc():
+    """Correctness pin (round-4 review finding): an announcement from a
+    since-joined rank must NOT stand in for an active rank that never
+    announced. Rank 1 submits 'g' async then joins; rank 0 submits 'g'
+    later. The collective must pair BOTH submissions (each rank sees the
+    full sum), not fire per-rank half-results: completion requires every
+    ACTIVE participant individually seen (engine.cc slow-path all_seen),
+    not a raw announcement count."""
+    run_workers("""
+        import time
+        # step 1: negotiate + cache 'g' so rank 1's re-submit is a hit
+        res = np.asarray(hvt.allreduce(np.ones((4,), np.float32),
+                                       op=hvt.Sum, name="g"))
+        np.testing.assert_allclose(res, 2.0)
+        if r == 1:
+            h = hvt.allreduce_async(np.full((4,), 5.0, np.float32),
+                                    op=hvt.Sum, name="g")
+            last = hvt.join()
+            res = np.asarray(hvt.synchronize(h))
+            np.testing.assert_allclose(res, 8.0)  # 5 (self) + 3 (rank 0)
+        else:
+            time.sleep(0.5)  # let rank 1's announce + join land first
+            res = np.asarray(hvt.allreduce(np.full((4,), 3.0, np.float32),
+                                           op=hvt.Sum, name="g"))
+            np.testing.assert_allclose(res, 8.0)
+            last = hvt.join()
+        assert last == 0, last
+    """)
 
 
 def test_join_uneven_steps_2proc():

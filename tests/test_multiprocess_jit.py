@@ -1,0 +1,140 @@
+"""Multi-process COMPILED-path validation (VERDICT r3 missing #2).
+
+Everything else in the suite exercises the compiled path single-process
+(virtual multi-device meshes); the reference's core scenario is N
+*processes*, one per accelerator, initialized per process
+(reference ``horovod/common/basics.py:33-65``). Here ``hvtrun --backend
+jax`` launches 2 real CPU processes; ``hvt.init()`` joins them into one
+JAX cluster via ``jax.distributed.initialize``
+(``common/basics.py:87``), and a jit-compiled training step runs over a
+mesh spanning BOTH processes — multi-controller SPMD, the exact
+architecture of a real multi-host TPU pod, with XLA inserting the
+gradient psum across the process boundary. Each worker asserts parity
+with a numpy computation of the full global batch.
+"""
+
+import os
+
+from tests.test_engine_integration import run_workers
+
+
+def test_jax_distributed_jit_train_step_2proc():
+    out = run_workers("""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        # hvt.init() already ran jax.distributed.initialize: the two
+        # processes now form one cluster with one global device list
+        assert jax.process_count() == 2, jax.process_count()
+        devs = jax.devices()
+        assert len(devs) == 2, devs
+
+        mesh = Mesh(np.array(devs), ("dp",))
+        batch_sh = NamedSharding(mesh, P("dp"))
+        repl_sh = NamedSharding(mesh, P())
+
+        # deterministic global batch; each process hosts its half
+        GB, D = 8, 4
+        rs = np.random.RandomState(7)
+        X = rs.randn(GB, D).astype(np.float32)
+        y = rs.randn(GB).astype(np.float32)
+        w0 = rs.randn(D).astype(np.float32)
+
+        half = GB // n
+        Xg = jax.make_array_from_process_local_data(
+            batch_sh, X[r * half:(r + 1) * half], (GB, D))
+        yg = jax.make_array_from_process_local_data(
+            batch_sh, y[r * half:(r + 1) * half], (GB,))
+        wg = jax.device_put(jnp.asarray(w0), repl_sh)
+
+        @jax.jit
+        def step(w, Xb, yb):
+            def loss_fn(w):
+                return jnp.mean((Xb @ w - yb) ** 2)
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            # XLA's autodiff of the batch-sharded mean inserts the
+            # cross-PROCESS psum here — the compiled analog of the
+            # reference's per-gradient allreduce
+            return w - 0.1 * g, loss, g
+
+        w1, loss, g = step(wg, Xg, yg)
+
+        # numpy ground truth on the full global batch
+        resid = X @ w0 - y
+        exp_loss = float(np.mean(resid ** 2))
+        exp_g = 2.0 / GB * (X.T @ resid)
+        np.testing.assert_allclose(float(loss), exp_loss, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g), exp_g, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(w1), w0 - 0.1 * exp_g,
+                                   rtol=1e-4)
+
+        # second step on the updated params: the cluster survives
+        # repeated dispatch (compiled executable reuse across processes)
+        w2, loss2, _ = step(w1, Xg, yg)
+        assert float(loss2) < float(loss)
+        print(f"JIT-2PROC-OK loss {float(loss):.6f}", flush=True)
+    """, launcher_args=("--backend", "jax"))
+    assert out.count("JIT-2PROC-OK") == 2, out[-2000:]
+
+
+def test_jax_distributed_optimizer_parity_2proc():
+    """hvt's DistributedOptimizer on the pjit path (axis_name=None: XLA
+    already summed the grads) across 2 real processes must match a
+    single-process optax run on the full batch."""
+    out = run_workers("""
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        import horovod_tpu.jax as hvt_jax
+
+        devs = jax.devices()
+        mesh = Mesh(np.array(devs), ("dp",))
+        batch_sh = NamedSharding(mesh, P("dp"))
+        repl_sh = NamedSharding(mesh, P())
+
+        GB, D = 8, 3
+        rs = np.random.RandomState(11)
+        X = rs.randn(GB, D).astype(np.float32)
+        y = rs.randn(GB).astype(np.float32)
+        w0 = rs.randn(D).astype(np.float32)
+
+        half = GB // n
+        Xg = jax.make_array_from_process_local_data(
+            batch_sh, X[r * half:(r + 1) * half], (GB, D))
+        yg = jax.make_array_from_process_local_data(
+            batch_sh, y[r * half:(r + 1) * half], (GB,))
+
+        opt = hvt_jax.DistributedOptimizer(optax.sgd(0.05),
+                                           axis_name=None)
+        params = jax.device_put({"w": jnp.asarray(w0)}, repl_sh)
+        state = jax.jit(opt.init)(params)
+
+        @jax.jit
+        def step(params, state, Xb, yb):
+            def loss_fn(p):
+                return jnp.mean((Xb @ p["w"] - yb) ** 2)
+            g = jax.grad(loss_fn)(params)
+            updates, state2 = opt.update(g, state, params)
+            return optax.apply_updates(params, updates), state2
+
+        for _ in range(3):
+            params, state = step(params, state, Xg, yg)
+
+        # single-process reference: plain optax on the full batch
+        ref_opt = optax.sgd(0.05)
+        ref_p = {"w": jnp.asarray(w0)}
+        ref_s = ref_opt.init(ref_p)
+        for _ in range(3):
+            g = jax.grad(
+                lambda p: jnp.mean((jnp.asarray(X) @ p["w"]
+                                    - jnp.asarray(y)) ** 2))(ref_p)
+            u, ref_s = ref_opt.update(g, ref_s, ref_p)
+            ref_p = optax.apply_updates(ref_p, u)
+
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   np.asarray(ref_p["w"]), rtol=1e-4)
+        print("OPT-2PROC-OK", flush=True)
+    """, launcher_args=("--backend", "jax"))
+    assert out.count("OPT-2PROC-OK") == 2, out[-2000:]
