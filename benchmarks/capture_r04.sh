@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-4 capture: full armed benchmark set, sequential (one chip), one
+# JSON line per run under benchmarks/r04/. Every heavy run is gated
+# behind the cheap data-plane probe (benchmarks/tpu_sanity.py): the
+# round-2/3 outages showed jax.devices() can answer while every
+# compile/execute RPC blocks, so a device listing is not a gate.
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/r04
+mkdir -p "$OUT"
+
+# Single-pilot rule, newest-starter-wins: disarm ANY earlier capture
+# generation (and its in-flight bench) before touching the chip — two
+# capture loops sharing the one chip corrupt each other's timings.
+# Exclude our whole ancestor chain, not just $$: a non-exec wrapper
+# (nohup timeout ... capture_r04.sh) matches the pattern too, and
+# killing it would tear down this very instance at startup.
+self_and_ancestors=$$
+p=$$
+while [ "$p" -gt 1 ]; do
+  p=$(awk '{print $4}' "/proc/$p/stat" 2>/dev/null) || break
+  [ -n "$p" ] || break
+  self_and_ancestors="$self_and_ancestors|$p"
+done
+for pid in $(pgrep -f "capture_r0[0-9]b?\.sh" | grep -Evw "$self_and_ancestors"); do
+  pkill -TERM -P "$pid" 2>/dev/null
+  kill "$pid" 2>/dev/null
+done
+pkill -f "timeout 2400 .*python bench\.py" 2>/dev/null
+echo "=== capture_r04 started $(date -u) ===" >> "$OUT/capture.log"
+
+sane() {
+  timeout 180 python benchmarks/tpu_sanity.py >> "$OUT/capture.log" 2>&1
+}
+
+wait_sane() {
+  # Probe until the data plane answers, 9-minute spacing; bounded at
+  # ~11h (55 x (180s probe + 540s sleep)). tpu_sanity rc=2 is a
+  # deterministic local failure (import error) — bail immediately.
+  for i in $(seq 1 55); do
+    sane; rc=$?
+    if [ "$rc" -eq 0 ]; then return 0; fi
+    if [ "$rc" -eq 2 ]; then
+      echo "=== local failure (sanity rc=2), bailing $(date -u) ===" >> "$OUT/capture.log"
+      exit 2
+    fi
+    echo "probe $i: data plane wedged/down $(date -u)" >> "$OUT/capture.log"
+    sleep 540
+  done
+  echo "=== gave up waiting for data plane $(date -u) ===" >> "$OUT/capture.log"
+  exit 1
+}
+
+run() {
+  local name="$1"; shift
+  wait_sane
+  echo "=== $name: $* ($(date -u +%H:%M:%S)) ===" >> "$OUT/capture.log"
+  timeout 2400 "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "rc=$? $name done $(date -u +%H:%M:%S)" >> "$OUT/capture.log"
+}
+
+# Ordered by information value: headline ResNet + BN A/B, GPT einsum vs
+# compiled-pallas flash (1024 and, at batch 4 for HBM fit, 2048), then
+# the fused chunked-CE runs including the 2x batch it frees HBM for.
+run resnet_tpu_bn   python bench.py
+run resnet_flax_bn  python bench.py --bn-impl flax
+run gpt_einsum      python bench.py --model gpt
+run gpt_flash       env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --flash
+run gpt_flash_2048  env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --flash --seq-len 2048 --batch-size 4
+run gpt_einsum_2048 python bench.py --model gpt --seq-len 2048 --batch-size 4
+run gpt_chunked_ce  python bench.py --model gpt --chunked-ce
+run gpt_chunked_2x  python bench.py --model gpt --chunked-ce --batch-size 16
+echo "=== capture_r04 done $(date -u) ===" >> "$OUT/capture.log"

@@ -324,13 +324,19 @@ def xla_built() -> bool:
     return True
 
 
-def start_timeline(file_path: str, mark_cycles: bool = False):
+def start_timeline(file_path: str, mark_cycles: bool = False,
+                   xla_profiler: bool = True):
     """Begin recording a Chrome-trace timeline (reference
-    ``operations.cc:738``, ``basics.py:75``)."""
+    ``operations.cc:738``, ``basics.py:75``).
+
+    ``xla_profiler=True`` (default) also arms an XLA/PJRT profiler
+    session writing device activity to ``<file_path>.xplane/``; pass
+    ``False`` for the control-plane-only trace (e.g. when you manage
+    your own ``jax.profiler`` session or want zero device overhead)."""
     _ensure_init()
     from horovod_tpu.utils import timeline as _tl
 
-    _tl.start(file_path, mark_cycles=mark_cycles)
+    _tl.start(file_path, mark_cycles=mark_cycles, xla_profiler=xla_profiler)
 
 
 def stop_timeline():

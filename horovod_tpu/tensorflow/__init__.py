@@ -50,6 +50,8 @@ from horovod_tpu.common.basics import (cross_rank, cross_size,  # noqa: F401
 from horovod_tpu.ops.functions import (allgather_object,  # noqa: F401
                                        broadcast_object,
                                        broadcast_object_fn)
+from horovod_tpu.ops.collective_ops import (Adasum, Average,  # noqa: F401
+                                            Max, Min, Product, Sum)
 from horovod_tpu.tensorflow.compression import Compression  # noqa: F401
 from horovod_tpu.tensorflow.sync_batch_norm import \
     SyncBatchNormalization  # noqa: F401
@@ -460,6 +462,24 @@ class DistributedGradientTape:
         return outs[0] if single else outs
 
 
+def _accumulate_eager(agg, grads):
+    """Sum ``grads`` into the numpy accumulator list ``agg`` (None entries
+    pass through) — the eager local-aggregation step shared by the
+    gradient-allreduce and Adasum delta optimizers (reference
+    ``gradient_aggregation_eager.py``)."""
+    if agg is None:
+        return [None if g is None else np.asarray(g).copy() for g in grads]
+    if len(grads) != len(agg):
+        raise ValueError(
+            "apply_gradients called with a different number of gradients "
+            "than the aggregation in flight")
+    for i, g in enumerate(grads):
+        if g is not None:
+            agg[i] = (np.asarray(g).copy() if agg[i] is None
+                      else agg[i] + np.asarray(g))
+    return agg
+
+
 class _DistributedOptimizer:
     """Eager optimizer wrapper: allreduce gradients in
     ``apply_gradients`` before delegating to the wrapped optimizer —
@@ -492,20 +512,7 @@ class _DistributedOptimizer:
         return getattr(self._opt, name)
 
     def _aggregate(self, grads):
-        if self._agg is None:
-            self._agg = [None if g is None else np.asarray(g).copy()
-                         for g in grads]
-        else:
-            if len(grads) != len(self._agg):
-                raise ValueError(
-                    "apply_gradients called with a different number of "
-                    "gradients than the aggregation in flight")
-            for i, g in enumerate(grads):
-                if g is not None:
-                    if self._agg[i] is None:
-                        self._agg[i] = np.asarray(g).copy()
-                    else:
-                        self._agg[i] = self._agg[i] + np.asarray(g)
+        self._agg = _accumulate_eager(self._agg, grads)
         self._agg_count += 1
 
     def apply_gradients(self, grads_and_vars, **kwargs):
@@ -579,6 +586,78 @@ class _DistributedOptimizer:
         return self._opt.apply_gradients(zip(reduced, variables), **kwargs)
 
 
+class _DistributedAdasumOptimizer:
+    """Adasum delta-optimizer (reference ``tensorflow/__init__.py:471-567``
+    ``_DistributedAdasumOptimizer``): run the wrapped optimizer LOCALLY,
+    then combine the resulting parameter *deltas* across ranks with the
+    scale-invariant Adasum operator and apply ``start + combined_delta``.
+    Unlike the gradient-allreduce wrapper this preserves each worker's
+    full local optimizer dynamics (momentum/Adam statistics see the local
+    gradient), which is the point of the delta formulation — the same
+    flow as the torch analog (``horovod_tpu/torch/optimizer.py``
+    ``_DistributedAdasumOptimizer``)."""
+
+    def __init__(self, optimizer, compression=Compression.none,
+                 backward_passes_per_step=1):
+        if backward_passes_per_step < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._opt = optimizer
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        self._agg = None
+        self._agg_count = 0
+
+    def __getattr__(self, item):  # delegate lr, get_config, etc.
+        return getattr(self._opt, item)
+
+    def apply_gradients(self, grads_and_vars, **kwargs):
+        from horovod_tpu.common.basics import process_size
+        from horovod_tpu.ops import collective_ops as C
+
+        gv = list(grads_and_vars)
+        grads = [g for g, _ in gv]
+        variables = [v for _, v in gv]
+        if any(_is_indexed_slices(g) for g in grads if g is not None):
+            raise ValueError(
+                "DistributedOptimizer(op=Adasum) does not support sparse "
+                "(IndexedSlices) gradients — the delta combine needs "
+                "dense parameter deltas")
+        if self.backward_passes_per_step > 1:
+            if _TF_AVAILABLE and not _tf.executing_eagerly():
+                raise RuntimeError(
+                    "DistributedOptimizer(op=Adasum) with "
+                    "backward_passes_per_step > 1 supports eager "
+                    "execution only")
+            self._agg = _accumulate_eager(self._agg, grads)
+            self._agg_count += 1
+            if self._agg_count < self.backward_passes_per_step:
+                return None
+            grads = self._agg
+            self._agg = None
+            self._agg_count = 0
+            gv = list(zip(grads, variables))
+
+        if process_size() == 1:  # no combine → no snapshots needed
+            return self._opt.apply_gradients(gv, **kwargs)
+        live = [(g, v) for g, v in gv if g is not None]
+        starts = [_tf.identity(v) for _, v in live] if _TF_AVAILABLE else \
+            [np.asarray(v).copy() for _, v in live]
+        result = self._opt.apply_gradients(gv, **kwargs)
+        deltas = [v - s for (_, v), s in zip(live, starts)]
+        # names must be rank-identical: variable names, never id()s
+        names = [f"adasum.delta.{i}.{getattr(v, 'name', None) or 'var'}"
+                 for i, (_, v) in enumerate(live)]
+        combined = _allreduce_grads(
+            deltas, op=C.Adasum, compression=self._compression,
+            name_prefix="adasum.delta", names=names)
+        for (_, v), s, d in zip(live, starts, combined):
+            if _TF_AVAILABLE:
+                v.assign(s + _tf.cast(d, s.dtype))
+            else:
+                v.assign(s + np.asarray(d, dtype=np.asarray(s).dtype))
+        return result
+
+
 def DistributedOptimizer(optimizer, name=None, use_locking=False,
                          device_dense="", device_sparse="",
                          compression=Compression.none,
@@ -588,10 +667,28 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                          process_set=None):
     """Wrap an (eager/keras-style) optimizer so ``apply_gradients``
     exchanges gradients across workers first (reference
-    ``tensorflow/__init__.py:568``). Graph-mode (TF1 ``compute_gradients``
-    rewriting) is not provided — use ``DistributedGradientTape`` for
-    custom loops, or the JAX binding for compiled TPU training."""
+    ``tensorflow/__init__.py:568``). ``op=Adasum`` returns the delta
+    optimizer (reference ``tensorflow/__init__.py:471-567``): local
+    optimizer step, then scale-invariant Adasum combine of the parameter
+    deltas. Graph-mode (TF1 ``compute_gradients`` rewriting) is not
+    provided — use ``DistributedGradientTape`` for custom loops, or the
+    JAX binding for compiled TPU training."""
     del name, use_locking, device_dense, device_sparse
+    from horovod_tpu.ops import collective_ops as C
+
+    if op is C.Adasum:
+        if process_set not in (None, C.global_process_set):
+            raise ValueError(
+                "DistributedOptimizer(op=Adasum) does not accept a "
+                "process_set (reference restriction)")
+        if prescale_factor != 1.0 or postscale_factor != 1.0:
+            raise ValueError(
+                "DistributedOptimizer(op=Adasum) does not accept "
+                "prescale/postscale factors — scaling a delta changes "
+                "the local update, not the wire payload")
+        return _DistributedAdasumOptimizer(
+            optimizer, compression=compression,
+            backward_passes_per_step=backward_passes_per_step)
     return _DistributedOptimizer(
         optimizer, compression=compression, op=op,
         backward_passes_per_step=backward_passes_per_step,

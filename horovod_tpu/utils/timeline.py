@@ -78,13 +78,33 @@ class _TimelineState:
         self.writer.join(timeout=5)
 
 
-def start(path, mark_cycles=False):
-    """Begin recording (reference ``operations.cc:738`` horovod_start_timeline)."""
+def start(path, mark_cycles=False, xla_profiler=True):
+    """Begin recording (reference ``operations.cc:738`` horovod_start_timeline).
+
+    With ``xla_profiler=True`` (default) an XLA/PJRT profiler session is
+    armed alongside the engine timeline (SURVEY §5.1: "same per-tensor
+    lifecycle trace, plus hooks into XLA/PJRT profiler sessions"): device
+    activity of every compiled step lands as an xplane trace under
+    ``<path>.xplane/`` (TensorBoard / xprof readable), so one
+    ``hvt.start_timeline()`` captures the control plane AND the compiled
+    data plane. Armed best-effort: a profiler that cannot start (another
+    session already active, backend without profiling) never blocks the
+    engine timeline.
+    """
     global _state
     with _state_lock:
         if _state is not None:
             return
         _state = _TimelineState(path, mark_cycles)
+        _state.xla_profiling = False
+        if xla_profiler:
+            try:
+                import jax
+
+                jax.profiler.start_trace(path + ".xplane")
+                _state.xla_profiling = True
+            except Exception:
+                pass
 
 
 def stop():
@@ -92,6 +112,13 @@ def stop():
     with _state_lock:
         if _state is None:
             return
+        if getattr(_state, "xla_profiling", False):
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
         _state.close()
         _state = None
 

@@ -172,3 +172,51 @@ def test_adasum_subset_with_start_level(world_mesh):
     for r in range(4):
         np.testing.assert_allclose(out[r], expected, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(out[4:], x[4:], rtol=1e-6)
+
+
+def test_tf_adasum_delta_optimizer_matches_torch_2proc():
+    """The TF Adasum delta-optimizer (tensorflow/__init__.py
+    _DistributedAdasumOptimizer, reference tensorflow/__init__.py:471-567)
+    must produce bit-comparable results to the torch delta optimizer
+    (torch/optimizer.py:248) on the same arrays: same start, same
+    per-rank gradients, same wrapped-SGD step, both deltas combined by
+    the engine's Adasum operator."""
+    import importlib.util
+
+    import pytest
+
+    if importlib.util.find_spec("tensorflow") is None:
+        pytest.skip("tensorflow not installed")
+    from tests.test_engine_integration import run_workers
+
+    out = run_workers("""
+        import torch
+        import horovod_tpu.torch as hvt_torch
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as hvt_tf
+
+        start = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        grad = (np.array([1.0, 0.0, 2.0, -1.0], np.float32) if r == 0
+                else np.array([0.5, 1.0, -1.0, 2.0], np.float32))
+
+        p = torch.nn.Parameter(torch.tensor(start))
+        topt = hvt_torch.DistributedOptimizer(
+            torch.optim.SGD([p], lr=0.5), op=hvt_torch.Adasum)
+        p.grad = torch.tensor(grad)
+        topt.step()
+        torch_result = p.detach().numpy()
+
+        v = tf.Variable(start)
+        fopt = hvt_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.5), op=hvt_tf.Adasum)
+        fopt.apply_gradients([(tf.constant(grad), v)])
+        tf_result = v.numpy()
+
+        np.testing.assert_allclose(tf_result, torch_result,
+                                   rtol=1e-5, atol=1e-6)
+        # both moved off the local-only update (the combine did run)
+        local_only = start - 0.5 * grad
+        assert not np.allclose(tf_result, local_only)
+        print(f"TF-TORCH-ADASUM-OK-{r}", flush=True)
+    """, timeout=240)
+    assert "TF-TORCH-ADASUM-OK-0" in out and "TF-TORCH-ADASUM-OK-1" in out

@@ -71,6 +71,47 @@ def test_distributed_optimizer_real_keras_training():
     assert losses[-1] < losses[0] * 1e-3, (losses[0], losses[-1])
 
 
+def test_adasum_delta_optimizer_single_process_is_local_step():
+    """op=Adasum returns the delta optimizer (reference
+    tensorflow/__init__.py:471-567); with one process the combine is a
+    no-op and the result must be EXACTLY the wrapped optimizer's local
+    update (momentum statistics intact)."""
+    from horovod_tpu.tensorflow import _DistributedAdasumOptimizer
+
+    v = tf.Variable([1.0, 2.0, 3.0])
+    v_ref = tf.Variable([1.0, 2.0, 3.0])
+    opt = hvt_tf.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.5, momentum=0.9), op=hvt_tf.Adasum)
+    assert isinstance(opt, _DistributedAdasumOptimizer)
+    ref = tf.keras.optimizers.SGD(0.5, momentum=0.9)
+    for _ in range(3):
+        g = tf.constant([0.1, -0.2, 0.3])
+        opt.apply_gradients([(g, v)])
+        ref.apply_gradients([(g, v_ref)])
+    np.testing.assert_allclose(v.numpy(), v_ref.numpy(), rtol=1e-6)
+
+
+def test_adasum_delta_optimizer_aggregation_and_guards():
+    v = tf.Variable([0.0, 0.0])
+    opt = hvt_tf.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                      op=hvt_tf.Adasum,
+                                      backward_passes_per_step=2)
+    g = tf.constant([1.0, 2.0])
+    assert opt.apply_gradients([(g, v)]) is None       # aggregate only
+    np.testing.assert_allclose(v.numpy(), 0.0)          # no update yet
+    opt.apply_gradients([(g, v)])
+    np.testing.assert_allclose(v.numpy(), [-2.0, -4.0])  # summed grads
+
+    with pytest.raises(ValueError, match="process_set"):
+        from horovod_tpu.ops.collective_ops import global_process_set
+        ps = type(global_process_set)([0])
+        hvt_tf.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                    op=hvt_tf.Adasum, process_set=ps)
+    with pytest.raises(ValueError, match="prescale"):
+        hvt_tf.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),
+                                    op=hvt_tf.Adasum, prescale_factor=2.0)
+
+
 def test_distributed_optimizer_aggregation_with_real_optimizer():
     v = tf.Variable([0.0, 0.0])
     opt = hvt_tf.DistributedOptimizer(tf.keras.optimizers.SGD(1.0),

@@ -36,6 +36,31 @@ def test_timeline_produces_valid_chrome_trace(tmp_path):
         assert b == e_
 
 
+def test_timeline_arms_xla_profiler_session(tmp_path):
+    """SURVEY §5.1: start_timeline() must also open an XLA/PJRT profiler
+    session so compiled-path device activity is captured alongside the
+    engine control-plane trace — one command, both views."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "tl.json")
+    hvt.start_timeline(path)
+    # run a compiled step inside the session so the xplane has content
+    jax.jit(lambda x: (x @ x).sum())(jnp.ones((64, 64))).block_until_ready()
+    hvt.stop_timeline()
+
+    # chrome trace written...
+    with open(path) as f:
+        json.load(f)
+    # ...and a populated xplane trace directory next to it
+    produced = glob.glob(str(tmp_path / "tl.json.xplane") + "/**/*",
+                         recursive=True)
+    assert any(p.endswith(".xplane.pb") or "trace" in p.lower()
+               for p in produced), produced
+
+
 def test_timeline_start_stop_idempotent(tmp_path):
     path = str(tmp_path / "t2.json")
     hvt.start_timeline(path)
