@@ -69,4 +69,9 @@ run gpt_flash_2048  env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --flas
 run gpt_einsum_2048 python bench.py --model gpt --seq-len 2048 --batch-size 4
 run gpt_chunked_ce  python bench.py --model gpt --chunked-ce
 run gpt_chunked_2x  python bench.py --model gpt --chunked-ce --batch-size 16
+# long-context frontier: at 4096 the [B,H,S,S] einsum score tensor is
+# where flash's HBM advantage should finally show (or einsum OOMs,
+# which is the enablement headline)
+run gpt_einsum_4096 python bench.py --model gpt --seq-len 4096 --batch-size 2
+run gpt_flash_4096  env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --seq-len 4096 --batch-size 2 --flash
 echo "=== capture_r04 done $(date -u) ===" >> "$OUT/capture.log"

@@ -686,6 +686,12 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                 "DistributedOptimizer(op=Adasum) does not accept "
                 "prescale/postscale factors — scaling a delta changes "
                 "the local update, not the wire payload")
+        if average_aggregated_gradients:
+            raise ValueError(
+                "DistributedOptimizer(op=Adasum) does not support "
+                "average_aggregated_gradients — the delta optimizer "
+                "SUMS locally aggregated gradients before its single "
+                "local step (divide your learning rate instead)")
         return _DistributedAdasumOptimizer(
             optimizer, compression=compression,
             backward_passes_per_step=backward_passes_per_step)

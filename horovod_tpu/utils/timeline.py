@@ -117,8 +117,14 @@ def stop():
                 import jax
 
                 jax.profiler.stop_trace()
-            except Exception:
-                pass
+            except Exception as e:
+                # a failed trace DUMP is data loss the user asked for —
+                # never silent (unlike best-effort start)
+                import sys
+
+                print(f"horovod_tpu: XLA profiler trace dump failed "
+                      f"({type(e).__name__}: {e}); the .xplane trace "
+                      f"may be empty or partial", file=sys.stderr)
         _state.close()
         _state = None
 
