@@ -86,7 +86,17 @@ def _sane_rates(rates, flops_per_item=None, n_chips=1):
     n0 = len(rates)
     if flops_per_item:
         cap = 1000e12 * max(1, n_chips)
-        rates = [r for r in rates if r * flops_per_item <= cap] or rates
+        plausible = [r for r in rates if r * flops_per_item <= cap]
+        if not plausible:
+            # EVERY iter implies an impossible rate: the backend is
+            # wedged past what any filter can repair — say so loudly
+            # instead of letting a clean-looking record through
+            print("# WARNING: every timing iter implies >1000 TFLOP/s/"
+                  "chip — the backend did not actually execute the "
+                  "work; this record is NOT a measurement",
+                  file=sys.stderr)
+            return rates
+        rates = plausible
     med = float(np.median(rates))
     sane = [r for r in rates if r <= 50 * med]
     if len(sane) != n0:

@@ -8,20 +8,29 @@ cd "$(dirname "$0")/.." || exit 1
 OUT=benchmarks/r04
 mkdir -p "$OUT"
 
-# Single-pilot rule, newest-starter-wins: disarm ANY earlier capture
-# generation (and its in-flight bench) before touching the chip — two
-# capture loops sharing the one chip corrupt each other's timings.
-# Exclude our whole ancestor chain, not just $$: a non-exec wrapper
-# (nohup timeout ... capture_r04.sh) matches the pattern too, and
-# killing it would tear down this very instance at startup.
-self_and_ancestors=$$
-p=$$
-while [ "$p" -gt 1 ]; do
-  p=$(awk '{print $4}' "/proc/$p/stat" 2>/dev/null) || break
-  [ -n "$p" ] || break
-  self_and_ancestors="$self_and_ancestors|$p"
-done
-for pid in $(pgrep -f "capture_r0[0-9]b?\.sh" | grep -Evw "$self_and_ancestors"); do
+# Single-pilot rule, newest-starter-wins: disarm any earlier capture
+# (and its in-flight bench) before touching the chip — two capture
+# loops sharing the one chip corrupt each other's timings. A PIDFILE
+# identifies the incumbent precisely; name-pattern pgrep is NOT safe
+# here — it also matches launching shells and non-exec wrappers whose
+# cmdline merely contains the script name (observed killing the
+# launcher twice in round 4).
+PIDFILE=/tmp/hvt_capture.pid
+if [ -f "$PIDFILE" ]; then
+  old=$(cat "$PIDFILE" 2>/dev/null)
+  # identity-check the incumbent before killing: a recycled PID must
+  # not take down an unrelated process tree
+  if [ -n "$old" ] && [ "$old" != "$$" ] && kill -0 "$old" 2>/dev/null \
+     && grep -qa "capture_r0" "/proc/$old/cmdline" 2>/dev/null; then
+    pkill -TERM -P "$old" 2>/dev/null
+    kill "$old" 2>/dev/null
+  fi
+fi
+echo $$ > "$PIDFILE"
+trap 'rm -f "$PIDFILE"' EXIT
+# legacy generations (r03/r03b) predate the pidfile; their names can't
+# match our own launch wrappers
+for pid in $(pgrep -f "capture_r0[0-3]b?\.sh" | grep -vw $$); do
   pkill -TERM -P "$pid" 2>/dev/null
   kill "$pid" 2>/dev/null
 done

@@ -263,16 +263,23 @@ void ShmLocalBackend::Barrier(const std::vector<int>& group) {
   words[rank_].v.store(val, std::memory_order_release);
   for (int g : group) {
     if (g == rank_) continue;
-    // brief spin for the common in-step case, then sleep-wait: ranks
-    // skewed by compute must not burn a core the computing rank needs
-    // (TCP recv would have slept in the kernel)
+    // brief spin for the common in-step case, then sleep-wait with
+    // exponential backoff: ranks skewed by compute must not burn a core
+    // the computing rank needs (TCP recv would have slept in the
+    // kernel). On an oversubscribed host (CI: 2 ranks, 1 core) a FIXED
+    // short nap still wakes the waiter hundreds of times per phase,
+    // stealing quanta and cache from the worker mid-memcpy — backoff to
+    // 2 ms caps the steal at harmless while keeping in-step latency low.
     int spins = 0;
-    struct timespec nap = {0, 50'000};  // 50 µs
+    long nap_ns = 20'000;  // 20 µs, doubling to 2 ms
     while (words[g].v.load(std::memory_order_acquire) < val) {
-      if (++spins < 512)
+      if (++spins < 512) {
         sched_yield();
-      else
+      } else {
+        struct timespec nap = {0, nap_ns};
         nanosleep(&nap, nullptr);
+        if (nap_ns < 2'000'000) nap_ns *= 2;
+      }
     }
   }
 }

@@ -24,28 +24,28 @@ _spec.loader.exec_module(engine_scaling)
 
 
 @pytest.mark.timeout(1500)
-def test_shm_not_slower_than_ring_at_16mb_2proc():
-    def measure_once():
-        shm_ms, ring_ms = [], []
-        for _ in range(3):  # interleaved pairs: noise hits both alike
-            shm_ms.append(engine_scaling.run_job(
-                2, True, {"16MB": 1 << 22}, 4, REPO)["16MB"]["hit_ms"])
-            ring_ms.append(engine_scaling.run_job(
-                2, False, {"16MB": 1 << 22}, 4, REPO)["16MB"]["hit_ms"])
-        return (float(np.median(shm_ms)), float(np.median(ring_ms)),
-                shm_ms, ring_ms)
-
-    # shm is ~25-35% faster here when the box is quiet (round-2 and
-    # round-3 measurements); 1.2x headroom absorbs scheduler noise while
-    # still catching a plane that actually lost its advantage. One
-    # re-measure: a single noisy window (CI shares one core) must not
-    # fail the build; a REAL regression fails both rounds.
-    attempts = []
-    for _ in range(2):
-        shm, ring, shm_ms, ring_ms = measure_once()
-        attempts.append((shm, ring, shm_ms, ring_ms))
-        if shm <= ring * 1.2:
-            return
-    raise AssertionError(
-        f"shm 16MB allreduce lost to loopback TCP in both rounds — the "
-        f"single-copy shm plane should not lose: {attempts}")
+def test_shm_not_slower_than_ring_at_16mb_and_64mb_2proc():
+    """No retry loop (round-4): the historical flake source was the shm
+    barrier's FIXED 50µs nap stealing quanta from the working rank on
+    the oversubscribed 1-core box — worst exactly at big payloads (the
+    round-3 '64 MB cliff': shm 1024 ms vs ring 391 ms hit). With
+    exponential backoff (backends.cc Barrier) five interleaved rounds
+    measured shm >= ring at BOTH sizes (medians 39.7 vs 42.3 ms at
+    16 MB, 370.7 vs 391.2 ms at 64 MB), so the pin now covers both and
+    a single interleaved-median round suffices."""
+    sizes = {"16MB": 1 << 22, "64MB": 1 << 24}
+    shm_ms = {k: [] for k in sizes}
+    ring_ms = {k: [] for k in sizes}
+    for _ in range(3):  # interleaved pairs: noise hits both alike
+        r_shm = engine_scaling.run_job(2, True, sizes, 4, REPO)
+        r_ring = engine_scaling.run_job(2, False, sizes, 4, REPO)
+        for k in sizes:
+            shm_ms[k].append(r_shm[k]["hit_ms"])
+            ring_ms[k].append(r_ring[k]["hit_ms"])
+    for k in sizes:
+        shm = float(np.median(shm_ms[k]))
+        ring = float(np.median(ring_ms[k]))
+        assert shm <= ring * 1.25, (
+            f"shm {k} allreduce lost to loopback TCP (median {shm:.1f} "
+            f"vs {ring:.1f} ms; raw {shm_ms[k]} vs {ring_ms[k]}) — the "
+            f"single-copy shm plane should not lose")

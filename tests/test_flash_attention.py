@@ -161,3 +161,46 @@ def test_combined_o_and_lse_gradient():
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_seq_tile_divisibility_invariants():
+    """Round-4 review pin: the streamed tile must divide the sequence
+    AND be a multiple of both block sizes — the kernels walk
+    ``tile // block`` sub-blocks, so a remainder would silently drop
+    sequence positions (wrong results, no error)."""
+    from horovod_tpu.ops.flash_attention import _seq_tile
+
+    for s, bq, bk in [(768, 384, 256), (1024, 128, 128),
+                      (8192, 128, 128), (384, 96, 128), (256, 256, 128),
+                      (6144, 128, 512)]:
+        t = _seq_tile(s, bq, bk)
+        assert s % t == 0 and t % bq == 0 and t % bk == 0, (s, bq, bk, t)
+
+
+def test_flash_multi_tile_matches_dense_768_mixed_blocks():
+    """The review's concrete miss case: s=768, block_q=384, block_k=256
+    forces a tile that is a multiple of both; fwd AND grads must match
+    the dense reference (pre-fix, dq dropped K positions 256..383)."""
+    import os
+
+    os.environ["HVT_FLASH_SEQ_TILE"] = "256"  # force multi-tile paths
+    try:
+        rs = np.random.RandomState(3)
+        q = jnp.asarray(rs.randn(1, 768, 2, 32), jnp.float32)
+        k = jnp.asarray(rs.randn(1, 768, 2, 32), jnp.float32)
+        v = jnp.asarray(rs.randn(1, 768, 2, 32), jnp.float32)
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, causal=True,
+                                   block_q=384, block_k=256).sum()
+
+        def loss_dense(q, k, v):
+            return _dense(q, k, v, causal=True).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-2, atol=2e-2)
+    finally:
+        del os.environ["HVT_FLASH_SEQ_TILE"]
