@@ -19,7 +19,7 @@ TPU-first choices:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Union
 
 import flax.linen as nn
 import jax
@@ -38,13 +38,20 @@ class GPTConfig:
     max_seq_len: int = 1024
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False  # jax.checkpoint each block (HBM ↔ FLOPs trade)
-    # pallas fused attention (ops/flash_attention.py) instead of the
-    # einsum-softmax path: O(seq) memory, no materialized score matrix.
-    # Requires the local sequence to be the full, contiguous sequence
-    # (its causal mask is positional-by-block) — leave False under
-    # plain GSPMD sequence parallelism; combine with ring_mesh to get
-    # flash + SP (the ring schedule owns the blocks there).
-    use_flash: bool = False
+    # Attention implementation. False (default) = einsum-softmax; True =
+    # pallas flash kernel; "auto" = pick per sequence length from the
+    # measured v5-lite crossover — einsum wins up to 2048 (MFU 0.85 vs
+    # 0.78 at 1024), flash wins beyond (1.5x at 4096; at 8192 the einsum
+    # path crashes the TPU worker outright). "auto" only upgrades to
+    # flash on a real TPU backend (elsewhere the kernel runs in pallas
+    # interpret mode, far slower than einsum). Flash requires the LOCAL
+    # sequence to be the full, contiguous sequence (its causal mask is
+    # positional-by-block): under plain GSPMD sequence parallelism the
+    # trace-time shape cannot reveal the sharding, so neither True nor
+    # "auto" is safe there — keep False, or use ring_mesh, where flash
+    # composes with SP correctly (the ring schedule owns the blocks and
+    # "auto" decides by the per-shard block length).
+    use_flash: Union[bool, str] = False
     # Explicit ring-attention sequence parallelism: set to the
     # jax.sharding.Mesh the model runs under (must carry an 'sp' axis).
     # Attention then runs parallel/sequence.py's ring schedule under
@@ -54,6 +61,29 @@ class GPTConfig:
     # hash/eq exclude nothing: Mesh is hashable, so the config stays a
     # valid jit-static argument.
     ring_mesh: Optional[object] = None
+
+
+# Measured crossover on v5-lite (BENCH_NOTES.md round 4): einsum wins at
+# seq<=2048, flash from 4096 up (and is the only path that RUNS at 8192)
+_FLASH_AUTO_THRESHOLD = 2048
+
+
+def _resolve_flash(use_flash, local_seq) -> bool:
+    """Resolve GPTConfig.use_flash ("auto" | bool) for a given local
+    sequence length (a static trace-time shape, so the choice compiles
+    away). "auto" upgrades to flash only on a real TPU backend — the
+    crossover was measured there, and off-TPU the kernel runs in pallas
+    interpret mode, far slower than einsum."""
+    if isinstance(use_flash, str):
+        if use_flash != "auto":
+            raise ValueError(
+                f"use_flash must be True, False, or 'auto'; got "
+                f"{use_flash!r}")
+        import jax
+
+        return (local_seq > _FLASH_AUTO_THRESHOLD
+                and jax.default_backend() == "tpu")
+    return bool(use_flash)
 
 
 def _rotary(x, positions):
@@ -100,11 +130,16 @@ class Attention(nn.Module):
         if cfg.ring_mesh is not None:
             from horovod_tpu.parallel.sequence import ring_attention
 
+            # "auto" decides by the PER-SHARD block length the ring
+            # schedule actually attends over, not the logical sequence
+            sp = dict(cfg.ring_mesh.shape).get("sp", 1)
             out = ring_attention(q, k, v, mesh=cfg.ring_mesh,
                                  causal=True,
                                  scale=1.0 / np.sqrt(head_dim),
-                                 use_flash=cfg.use_flash)
-        elif cfg.use_flash:
+                                 use_flash=_resolve_flash(
+                                     cfg.use_flash,
+                                     q.shape[-3] // sp))
+        elif _resolve_flash(cfg.use_flash, q.shape[-3]):
             from horovod_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=True,
