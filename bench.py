@@ -54,7 +54,12 @@ HARNESS_VERSION = "r4.2"
 
 # Theoretical training FLOPs (fwd+bwd+update ≈ 3x forward; ResNet-50 fwd ≈
 # 4.1 GFLOP/img @224², ResNet-101 ≈ 7.8) — the MFU numerator.
-FLOPS_PER_IMG = {"resnet50": 12.3e9, "resnet101": 23.4e9}
+# Training FLOPs (3x forward, forward = 2x MACs), algorithmic counts at
+# the model's native resolution (224; inception_v3 scales from 299).
+FLOPS_PER_IMG = {"resnet50": 12.3e9, "resnet101": 23.4e9,
+                 "vgg16": 46.5e9, "inception_v3": 17.1e9}
+NATIVE_IMG_SIZE = {"resnet50": 224, "resnet101": 224, "vgg16": 224,
+                   "inception_v3": 299}
 
 
 def _compiled_flops(lowered_compiled):
@@ -244,13 +249,16 @@ def measure(model_name, devices, per_chip_batch, num_iters,
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import horovod_tpu as hvt
-    from horovod_tpu.models import ResNet50, ResNet101
+    from horovod_tpu.models import (InceptionV3, ResNet50, ResNet101,
+                                    VGG16)
     from horovod_tpu.parallel.mesh import make_parallel_mesh
 
     n = len(devices)
     mesh = make_parallel_mesh(devices=devices, dp=n)
     dtype = jnp.float32 if dtype_name == "fp32" else jnp.bfloat16
-    model_cls = ResNet50 if model_name == "resnet50" else ResNet101
+    model_cls = {"resnet50": ResNet50, "resnet101": ResNet101,
+                 "vgg16": VGG16,
+                 "inception_v3": InceptionV3}[model_name]
     model = model_cls(num_classes=1000, dtype=dtype, norm_impl=norm_impl)
 
     global_batch = per_chip_batch * n
@@ -310,7 +318,7 @@ def measure(model_name, devices, per_chip_batch, num_iters,
     # (~1.9x the algorithmic count), so it is reported separately as a
     # cross-check, never fed into mfu.
     flops_per_img = (FLOPS_PER_IMG[model_name]
-                     * (image_size / 224.0) ** 2)
+                     * (image_size / NATIVE_IMG_SIZE[model_name]) ** 2)
     total_flops = _compiled_flops(compiled)
     xla_flops_per_img = (total_flops / global_batch
                          if total_flops is not None else None)
@@ -340,21 +348,23 @@ def measure(model_name, devices, per_chip_batch, num_iters,
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet101", "gpt"])
+                   choices=["resnet50", "resnet101", "vgg16",
+                            "inception_v3", "gpt"])
     p.add_argument("--seq-len", type=int, default=1024,
                    help="sequence length for --model gpt")
     p.add_argument("--batch-size", type=int, default=None,
                    help="per-chip batch size. Defaults per model: 256 for "
                         "resnet (measured best on v5-lite: MFU 0.38 vs "
-                        "0.34 at 128; BN statistics passes are the "
-                        "residual non-conv cost — see docstring), 8 for "
-                        "gpt (8x1024 tokens/chip/step)")
+                        "0.34 at 128; bs 512 re-measured worse), 64 for "
+                        "vgg16 and 128 for inception_v3 (HBM fit at "
+                        "224/299), 8 for gpt (8x1024 tokens/chip/step)")
     p.add_argument("--num-iters", type=int, default=5)
     p.add_argument("--num-batches-per-iter", type=int, default=10)
     p.add_argument("--fp32", action="store_true",
                    help="use float32 instead of bfloat16")
-    p.add_argument("--image-size", type=int, default=224,
-                   help="square input resolution (224 = reference recipe; "
+    p.add_argument("--image-size", type=int, default=None,
+                   help="square input resolution (default: the model's "
+                        "native size — 224, or 299 for inception_v3; "
                         "smaller for CPU harness validation)")
     p.add_argument("--no-scaling", action="store_true",
                    help="skip the 1→N chip scaling sweep")
@@ -449,9 +459,15 @@ def main():
                        args.num_batches_per_iter, dtype_name,
                        args.image_size, norm_impl=args.bn_impl)
 
+    if not gpt and args.image_size is None:
+        args.image_size = NATIVE_IMG_SIZE[args.model]
     bs = args.batch_size
     if bs is None:
-        bs = 8 if gpt else 256  # per-model default; user values win
+        # per-model defaults; user values win. vgg16's early 224x64
+        # activation maps are ~4x resnet's per image, inception runs at
+        # 299 - both need smaller per-chip batches to fit HBM.
+        bs = {"gpt": 8, "vgg16": 64, "inception_v3": 128}.get(
+            args.model, 256)
 
     # Interleaved calibration: the in-harness matmul ceiling on a tunneled
     # rig drifts run-to-run (76 vs 111 TFLOP/s observed half an hour

@@ -79,8 +79,6 @@ def _resolve_flash(use_flash, local_seq) -> bool:
             raise ValueError(
                 f"use_flash must be True, False, or 'auto'; got "
                 f"{use_flash!r}")
-        import jax
-
         return (local_seq > _FLASH_AUTO_THRESHOLD
                 and jax.default_backend() == "tpu")
     return bool(use_flash)

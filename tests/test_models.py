@@ -352,8 +352,8 @@ def test_gpt_ring_mesh_matches_plain(use_flash):
 
 
 def test_gpt_use_flash_auto_resolves_by_sequence_length(monkeypatch):
-    """use_flash="auto" (the default) picks the measured winner per
-    sequence length: einsum at/below the 2048 crossover, the flash
+    """use_flash="auto" (opt-in; the default stays False) picks the
+    measured winner per sequence length: einsum at/below the 2048 crossover, the flash
     kernel above (at 8192 the einsum path crashes the TPU worker, so
     auto is also a safety rail). Verified by instrumenting the kernel
     entry point."""
@@ -401,3 +401,52 @@ def test_gpt_use_flash_auto_resolves_by_sequence_length(monkeypatch):
         np.random.RandomState(0).randint(0, 64, (1, 128)))
     model.apply(params, tokens_long)
     assert calls, "auto must use the flash kernel at long sequences"
+
+
+def test_vgg16_and_inception_forward_backward():
+    """Benchmark-trio parity (reference docs/benchmarks.rst:13-14 runs
+    Inception V3 + VGG-16 + ResNet): both models train a step at reduced
+    resolution with finite loss/grads; the canonical param counts at
+    native resolution are asserted below (VGG16-BN 138.4M incl. the
+    4096-wide FCs; InceptionV3 23.8M)."""
+    import optax
+
+    from horovod_tpu.models import InceptionV3, VGG16
+
+    # canonical param counts at native resolution: a silently altered
+    # tower width would otherwise keep loss/grads finite while bench.py
+    # benchmarks a different model than the reference trio
+    def n_params(model, size):
+        var = jax.eval_shape(
+            lambda: model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, size, size, 3), jnp.float32),
+                               train=True))
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(var["params"]))
+
+    assert abs(n_params(VGG16(num_classes=1000, dtype=jnp.float32), 224)
+               - 138.36e6) < 0.3e6
+    assert abs(n_params(InceptionV3(num_classes=1000, dtype=jnp.float32),
+                        299) - 23.83e6) < 0.1e6
+
+    rs = np.random.RandomState(0)
+    for model, size in [(VGG16(num_classes=10, dtype=jnp.float32), 32),
+                        (InceptionV3(num_classes=10, dtype=jnp.float32),
+                         299)]:
+        x = jnp.asarray(rs.randn(2, size, size, 3), jnp.float32)
+        y = jnp.asarray(rs.randint(0, 10, (2,)))
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        params, bstats = variables["params"], variables["batch_stats"]
+
+        def loss_fn(p):
+            logits, _ = model.apply(
+                {"params": p, "batch_stats": bstats}, x, train=True,
+                mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        l, g = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(l))
+        leaves = jax.tree.leaves(g)
+        assert leaves and all(np.all(np.isfinite(np.asarray(p)))
+                              for p in leaves)
