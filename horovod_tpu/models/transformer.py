@@ -34,6 +34,18 @@ class GPTConfig:
     n_layers: int = 4
     d_model: int = 256
     n_heads: int = 4
+    # Grouped-query attention (LLaMA-2/Mistral lineage): number of K/V
+    # heads; None → n_heads (standard MHA), 1 → MQA. Must divide
+    # n_heads. Shrinks the K/V projection params and K/V HBM traffic by
+    # n_heads/n_kv_heads. The flash FORWARD and dQ kernels serve GQA
+    # zero-copy (K/V block index-map aliasing: head hi reads kv head
+    # hi // group); the flash backward emits per-query-head dK/dV then
+    # group-sums (one transient full-h gradient array), and the
+    # ring-mesh and einsum paths broadcast K/V to full heads before
+    # attending — budget those paths at n_heads. With tensor
+    # parallelism pass tp_size to param_partition_spec: K/V replicate
+    # when n_kv_heads < tp (Megatron MQA layout).
+    n_kv_heads: Optional[int] = None
     d_ff: int = 1024
     max_seq_len: int = 1024
     dtype: jnp.dtype = jnp.bfloat16
@@ -119,15 +131,27 @@ class Attention(nn.Module):
         dense = lambda feats, name: nn.DenseGeneral(
             feats, axis=-1, use_bias=False, dtype=cfg.dtype,
             param_dtype=jnp.float32, name=name)
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        if cfg.n_heads % n_kv:
+            raise ValueError(
+                f"n_kv_heads ({n_kv}) must divide n_heads "
+                f"({cfg.n_heads})")
         q = dense((cfg.n_heads, head_dim), "q")(x)
-        k = dense((cfg.n_heads, head_dim), "k")(x)
-        v = dense((cfg.n_heads, head_dim), "v")(x)
+        k = dense((n_kv, head_dim), "k")(x)
+        v = dense((n_kv, head_dim), "v")(x)
         q = _rotary(q, positions)
         k = _rotary(k, positions)
 
         if cfg.ring_mesh is not None:
             from horovod_tpu.parallel.sequence import ring_attention
 
+            if n_kv != cfg.n_heads:
+                # the ring schedule streams K/V shards per full head
+                # set today; broadcast first (XLA fuses the repeat).
+                # Exploiting GQA's smaller ICI payload in the ring is a
+                # future optimization.
+                k = jnp.repeat(k, cfg.n_heads // n_kv, axis=-2)
+                v = jnp.repeat(v, cfg.n_heads // n_kv, axis=-2)
             # "auto" decides by the PER-SHARD block length the ring
             # schedule actually attends over, not the logical sequence
             sp = dict(cfg.ring_mesh.shape).get("sp", 1)
@@ -140,9 +164,14 @@ class Attention(nn.Module):
         elif _resolve_flash(cfg.use_flash, q.shape[-3]):
             from horovod_tpu.ops.flash_attention import flash_attention
 
+            # the kernel serves GQA zero-copy (K/V head index aliasing)
             out = flash_attention(q, k, v, causal=True,
                                   scale=1.0 / np.sqrt(head_dim))
         else:
+            if n_kv != cfg.n_heads:
+                # XLA turns the repeat into a broadcast inside the dot
+                k = jnp.repeat(k, cfg.n_heads // n_kv, axis=-2)
+                v = jnp.repeat(v, cfg.n_heads // n_kv, axis=-2)
             scores = jnp.einsum("...qhd,...khd->...hqk", q, k,
                                 preferred_element_type=jnp.float32)
             scores = scores / np.sqrt(head_dim)
@@ -209,7 +238,7 @@ class GPT(nn.Module):
         return logits
 
 
-def param_partition_spec(params, *, tp_axis="tp"):
+def param_partition_spec(params, *, tp_axis="tp", tp_size=None):
     """PartitionSpec pytree for Megatron-style tensor parallelism.
 
     Column-parallel: q/k/v and MLP up kernels shard their output dim over
@@ -217,13 +246,23 @@ def param_partition_spec(params, *, tp_axis="tp"):
     their input dim, so XLA inserts exactly one psum per row-parallel
     matmul (the NCCL-allreduce-per-layer pattern, compiled).
     Embedding shards the vocab dim. Norm scales replicate.
+
+    ``tp_size`` (the mesh's tp axis size, when known): a head axis not
+    divisible by it — GQA/MQA K/V kernels with ``n_kv_heads < tp`` —
+    falls back to REPLICATED K/V, the standard Megatron MQA layout
+    (every tp rank holds the shared K/V heads; only Q/out shard).
+    Without ``tp_size`` the spec assumes divisibility, matching the
+    pre-GQA behavior.
     """
 
-    def spec_for(path):
+    def spec_for(path, leaf):
         names = [getattr(p, "key", None) for p in path]
         if "embedding" in names:
             return P(tp_axis, None)
         if any(n in ("q", "k", "v") for n in names):
+            heads = leaf.shape[1] if hasattr(leaf, "shape") else None
+            if tp_size and heads is not None and heads % tp_size:
+                return P()                     # replicated GQA K/V
             return P(None, tp_axis, None)      # (d_model, heads, head_dim)
         if "o" in names:
             return P(tp_axis, None, None)      # (heads, head_dim, d_model)
@@ -233,5 +272,4 @@ def param_partition_spec(params, *, tp_axis="tp"):
             return P(tp_axis, None)
         return P()
 
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: spec_for(path), params)
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -296,6 +296,11 @@ def _seq_tile(s, block_q, block_k):
 
 def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, out_dtype):
     b, h, s, d = q.shape
+    # Grouped-query attention is served ZERO-COPY: query head hi reads
+    # K/V head hi // group through the block index map — no repeat
+    # materialization, and the shared K/V tile stays VMEM-resident
+    # across the group's consecutive hi grid steps.
+    group = h // k.shape[1]
     # K/V stream through the grid's sequential LAST axis in VMEM tiles;
     # scratch accumulators carry the online softmax across tiles
     tile = _seq_tile(s, block_q, block_k)
@@ -303,7 +308,7 @@ def _flash_fwd_impl(q, k, v, scale, causal, block_q, block_k, out_dtype):
     qspec = pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ti: (bi, hi, qi, 0))
     kvspec = pl.BlockSpec((1, 1, tile, d),
-                          lambda bi, hi, qi, ti: (bi, hi, ti, 0))
+                          lambda bi, hi, qi, ti: (bi, hi // group, ti, 0))
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           block_k=block_k),
@@ -338,11 +343,13 @@ def _flash_bwd(scale, causal, block_q, block_k, out_dtype, res, cot):
     delta = delta - dlse.astype(jnp.float32)
 
     # dq: grid (b, h, qi, ti) — K/V tiles stream past each Q block.
+    # GQA reads the shared K/V head zero-copy via the index map.
+    group = h // k.shape[1]
     tile = _seq_tile(s, block_q, block_k)
     q_by_qi = pl.BlockSpec((1, 1, block_q, d),
                            lambda bi, hi, qi, ti: (bi, hi, qi, 0))
     kv_tile = pl.BlockSpec((1, 1, tile, d),
-                           lambda bi, hi, qi, ti: (bi, hi, ti, 0))
+                           lambda bi, hi, qi, ti: (bi, hi // group, ti, 0))
     vec_by_qi = pl.BlockSpec((1, 1, block_q, 1),
                              lambda bi, hi, qi, ti: (bi, hi, qi, 0))
     dq = pl.pallas_call(
@@ -358,26 +365,38 @@ def _flash_bwd(scale, causal, block_q, block_k, out_dtype, res, cot):
     )(q, k, v, do, lse, delta)
 
     # dk/dv: grid (b, h, ki, ti) — Q/dO/lse/delta tiles stream past
-    # each K/V block (the reduction axis must be LAST)
-    kv_at_ki = pl.BlockSpec((1, 1, block_k, d),
-                            lambda bi, hi, ki, ti: (bi, hi, ki, 0))
+    # each K/V block (the reduction axis must be LAST). Under GQA the
+    # kernel still reads the shared K/V head zero-copy but emits
+    # per-QUERY-head gradients (full h), which are then group-summed —
+    # each K/V head's gradient is the sum over its query group.
+    kv_in_ki = pl.BlockSpec((1, 1, block_k, d),
+                            lambda bi, hi, ki, ti: (bi, hi // group, ki, 0))
+    dkv_out_ki = pl.BlockSpec((1, 1, block_k, d),
+                              lambda bi, hi, ki, ti: (bi, hi, ki, 0))
     q_tile = pl.BlockSpec((1, 1, tile, d),
                           lambda bi, hi, ki, ti: (bi, hi, ti, 0))
     vec_tile = pl.BlockSpec((1, 1, tile, 1),
                             lambda bi, hi, ki, ti: (bi, hi, ti, 0))
+    full_shape = (b, h, s, d)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q),
         grid=(b, h, s // block_k, s // tile),
-        in_specs=[kv_at_ki, kv_at_ki, q_tile, q_tile, vec_tile,
+        in_specs=[kv_in_ki, kv_in_ki, q_tile, q_tile, vec_tile,
                   vec_tile],
-        out_specs=[kv_at_ki, kv_at_ki],
-        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        out_specs=[dkv_out_ki, dkv_out_ki],
+        out_shape=[jax.ShapeDtypeStruct(full_shape, k.dtype),
+                   jax.ShapeDtypeStruct(full_shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
     )(k, v, q, do, lse, delta)
+    if group > 1:
+        h_kv = h // group
+        dk = dk.astype(jnp.float32).reshape(
+            b, h_kv, group, s, d).sum(axis=2).astype(k.dtype)
+        dv = dv.astype(jnp.float32).reshape(
+            b, h_kv, group, s, d).sum(axis=2).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -418,6 +437,11 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     the combine unrounded; the matmuls still run on bf16 operands.
     """
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            f"GQA requires n_heads ({h}) divisible by n_kv_heads "
+            f"({h_kv})")
     if scale is None:
         scale = d ** -0.5
     block_q = _blocks(s, block_q)

@@ -204,3 +204,40 @@ def test_flash_multi_tile_matches_dense_768_mixed_blocks():
                                        rtol=2e-2, atol=2e-2)
     finally:
         del os.environ["HVT_FLASH_SEQ_TILE"]
+
+
+@pytest.mark.parametrize("h,h_kv", [(4, 2), (4, 1), (6, 3)])
+def test_flash_gqa_matches_dense_repeat(h, h_kv):
+    """Grouped-query attention: the kernel reads shared K/V heads
+    zero-copy (index-map aliasing); output AND all grads — including
+    dk/dv through the per-query-head group-sum — must equal dense
+    attention over repeat-expanded K/V."""
+    rs = np.random.RandomState(7)
+    S, D = 256, 16
+    q = jnp.asarray(rs.randn(2, S, h, D), jnp.float32)
+    k = jnp.asarray(rs.randn(2, S, h_kv, D), jnp.float32)
+    v = jnp.asarray(rs.randn(2, S, h_kv, D), jnp.float32)
+    g = h // h_kv
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        kr = jnp.repeat(k, g, axis=-2)
+        vr = jnp.repeat(v, g, axis=-2)
+        return (_dense(q, kr, vr, causal=True) ** 2).sum()
+
+    lf, gf = jax.value_and_grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    ld, gd = jax.value_and_grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(float(lf), float(ld), rtol=1e-4)
+    for a, b in zip(gf, gd):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_flash_gqa_rejects_indivisible_heads():
+    q = jnp.zeros((1, 128, 4, 8), jnp.float32)
+    kv = jnp.zeros((1, 128, 3, 8), jnp.float32)
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, kv, kv)
