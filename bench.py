@@ -152,7 +152,7 @@ def calibrate_matmul_tflops(platform):
 
 def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
                 dtype_name, seq_len=1024, use_flash=False,
-                chunked_ce=False):
+                chunked_ce=False, n_kv_heads=None):
     """GPT train-step throughput on a dp mesh (tokens/sec/chip) — the
     flagship-model counterpart of the ResNet measurement. FLOPs/token by
     the standard training estimate 6N + 12·L·d_model·seq (dense matmuls
@@ -171,8 +171,8 @@ def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
     mesh = make_parallel_mesh(devices=devices, dp=n)
     dtype = jnp.float32 if dtype_name == "fp32" else jnp.bfloat16
     cfg = GPTConfig(vocab_size=32768, n_layers=12, d_model=768, n_heads=12,
-                    d_ff=3072, max_seq_len=seq_len, dtype=dtype,
-                    use_flash=use_flash)
+                    n_kv_heads=n_kv_heads, d_ff=3072, max_seq_len=seq_len,
+                    dtype=dtype, use_flash=use_flash)
     model = GPT(cfg)
     global_batch = per_chip_batch * n
     rng = np.random.RandomState(0)
@@ -350,6 +350,10 @@ def main():
     p.add_argument("--model", default="resnet50",
                    choices=["resnet50", "resnet101", "vgg16",
                             "inception_v3", "gpt"])
+    p.add_argument("--n-kv-heads", type=int, default=None,
+                   help="gpt: grouped-query attention K/V head count "
+                        "(default: n_heads=12, i.e. standard MHA; must "
+                        "divide 12)")
     p.add_argument("--seq-len", type=int, default=1024,
                    help="sequence length for --model gpt")
     p.add_argument("--batch-size", type=int, default=None,
@@ -454,7 +458,8 @@ def main():
             return measure_gpt(devs, bs, iters, args.num_batches_per_iter,
                                dtype_name, args.seq_len,
                                use_flash=args.flash,
-                               chunked_ce=args.chunked_ce)
+                               chunked_ce=args.chunked_ce,
+                               n_kv_heads=args.n_kv_heads)
         return measure(args.model, devs, bs, iters,
                        args.num_batches_per_iter, dtype_name,
                        args.image_size, norm_impl=args.bn_impl)
@@ -555,7 +560,8 @@ def main():
             "chips": n,
             "platform": platform,
             **({"seq_len": args.seq_len, "flash": bool(args.flash),
-                "chunked_ce": bool(args.chunked_ce)} if gpt else
+                "chunked_ce": bool(args.chunked_ce),
+                "n_kv_heads": args.n_kv_heads} if gpt else
                {"image_size": args.image_size, "bn_impl": args.bn_impl}),
         },
         # GPT has no reference-published absolute number; the ResNet
