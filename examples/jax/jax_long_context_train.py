@@ -33,6 +33,9 @@ def main():
     p.add_argument("--seq", type=int, default=2048,
                    help="global sequence length")
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=None,
+                   help="grouped-query attention: K/V head count "
+                        "(default = --heads, i.e. MHA; must divide it)")
     p.add_argument("--head-dim", type=int, default=64)
     p.add_argument("--batch", type=int, default=2)
     p.add_argument("--steps", type=int, default=10)
@@ -45,6 +48,10 @@ def main():
     if args.seq % args.sp != 0:
         p.error(f"--seq ({args.seq}) must be divisible by --sp "
                 f"({args.sp}) — each device owns one sequence shard")
+    kv = args.heads if args.kv_heads is None else args.kv_heads
+    if kv < 1 or args.heads % kv:
+        p.error(f"--kv-heads ({kv}) must be >= 1 and divide --heads "
+                f"({args.heads})")
 
     hvt.init()
     mesh = make_parallel_mesh(sp=args.sp)
@@ -59,19 +66,27 @@ def main():
     x = jax.device_put(x, NamedSharding(mesh, spec))
     target = jax.device_put(target, NamedSharding(mesh, spec))
 
+    kv_dim = kv * d
     params = {
         "wq": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
-        "wk": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
-        "wv": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
+        "wk": jnp.asarray(rng.randn(dm, kv_dim) / np.sqrt(dm), jnp.float32),
+        "wv": jnp.asarray(rng.randn(dm, kv_dim) / np.sqrt(dm), jnp.float32),
         "wo": jnp.asarray(rng.randn(dm, dm) / np.sqrt(dm), jnp.float32),
     }
     tx = optax.adam(3e-3)
     opt = tx.init(params)
 
     def attn_block(p, x):
-        proj = lambda w: (x @ w.astype(x.dtype)).reshape(b, s, h, d)
-        o = ring_attention(proj(p["wq"]), proj(p["wk"]), proj(p["wv"]),
-                           mesh=mesh, causal=True,
+        proj = lambda w, nh: (x @ w.astype(x.dtype)).reshape(b, s, nh, d)
+        q = proj(p["wq"], h)
+        k = proj(p["wk"], kv)
+        v = proj(p["wv"], kv)
+        if kv != h:
+            # the ring schedule streams full head sets; broadcast K/V
+            # (GQA still shrinks the projections and their grads)
+            k = jnp.repeat(k, h // kv, axis=-2)
+            v = jnp.repeat(v, h // kv, axis=-2)
+        o = ring_attention(q, k, v, mesh=mesh, causal=True,
                            use_flash=not args.no_flash)
         return o.reshape(b, s, dm) @ p["wo"].astype(x.dtype)
 
@@ -92,7 +107,8 @@ def main():
     final = float(loss)
     assert np.isfinite(final), "training diverged"
     print(f"final loss {final:.5f} (seq={s} over {args.sp}-way ring, "
-          f"flash={'off' if args.no_flash else 'on'})", flush=True)
+          f"flash={'off' if args.no_flash else 'on'}, "
+          f"heads={h}/{kv} kv)", flush=True)
 
 
 if __name__ == "__main__":
