@@ -109,6 +109,15 @@ def _rotary(x, positions):
                             x1 * sin + x2 * cos], axis=-1)
 
 
+def _repeat_kv(k, v, group):
+    """Broadcast GQA K/V heads to the full query head count (no-op for
+    MHA). The flash path never calls this — its kernel aliases the
+    shared heads zero-copy."""
+    if group == 1:
+        return k, v
+    return (jnp.repeat(k, group, axis=-2), jnp.repeat(v, group, axis=-2))
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-6
 
@@ -145,13 +154,11 @@ class Attention(nn.Module):
         if cfg.ring_mesh is not None:
             from horovod_tpu.parallel.sequence import ring_attention
 
-            if n_kv != cfg.n_heads:
-                # the ring schedule streams K/V shards per full head
-                # set today; broadcast first (XLA fuses the repeat).
-                # Exploiting GQA's smaller ICI payload in the ring is a
-                # future optimization.
-                k = jnp.repeat(k, cfg.n_heads // n_kv, axis=-2)
-                v = jnp.repeat(v, cfg.n_heads // n_kv, axis=-2)
+            # the ring schedule streams K/V shards per full head set
+            # today; broadcast first (XLA fuses the repeat). Exploiting
+            # GQA's smaller ICI payload in the ring is a future
+            # optimization.
+            k, v = _repeat_kv(k, v, cfg.n_heads // n_kv)
             # "auto" decides by the PER-SHARD block length the ring
             # schedule actually attends over, not the logical sequence
             sp = dict(cfg.ring_mesh.shape).get("sp", 1)
@@ -168,10 +175,8 @@ class Attention(nn.Module):
             out = flash_attention(q, k, v, causal=True,
                                   scale=1.0 / np.sqrt(head_dim))
         else:
-            if n_kv != cfg.n_heads:
-                # XLA turns the repeat into a broadcast inside the dot
-                k = jnp.repeat(k, cfg.n_heads // n_kv, axis=-2)
-                v = jnp.repeat(v, cfg.n_heads // n_kv, axis=-2)
+            # XLA turns the repeat into a broadcast inside the dot
+            k, v = _repeat_kv(k, v, cfg.n_heads // n_kv)
             scores = jnp.einsum("...qhd,...khd->...hqk", q, k,
                                 preferred_element_type=jnp.float32)
             scores = scores / np.sqrt(head_dim)

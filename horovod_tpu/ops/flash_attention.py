@@ -55,6 +55,16 @@ def _interpret() -> bool:
 # tile axis.
 
 
+def _causal_n_eff(qi, block_q, ti, tile, block_k, n_sub):
+    """Number of k sub-blocks of this tile a causal Q block attends to
+    (sub-blocks entirely above the diagonal are skipped, same 128-block
+    granularity as the resident design). Shared by the fwd and dQ
+    kernels; the dkv kernel uses the dual (`start`) form."""
+    return jnp.clip(
+        ((qi + 1) * block_q - ti * tile + block_k - 1) // block_k,
+        0, n_sub)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
                 l_ref, *, scale, causal, block_k):
     block_q = q_ref.shape[2]
@@ -94,14 +104,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
             return acc_new, m_new, l_new
 
         n_sub = tile // block_k
-        if causal:
-            # sub-blocks entirely above the diagonal are skipped at
-            # 128-block granularity, exactly like the resident design
-            n_eff = jnp.clip(
-                ((qi + 1) * block_q - ti * tile + block_k - 1) // block_k,
-                0, n_sub)
-        else:
-            n_eff = n_sub
+        n_eff = (_causal_n_eff(qi, block_q, ti, tile, block_k, n_sub)
+                 if causal else n_sub)
         acc, m, l = jax.lax.fori_loop(
             0, n_eff, body, (acc_ref[...], m_ref[:, 0], l_ref[:, 0]))
         acc_ref[...] = acc
@@ -162,12 +166,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 preferred_element_type=jnp.float32) * scale
 
         n_sub = tile // block_k
-        if causal:
-            n_eff = jnp.clip(
-                ((qi + 1) * block_q - ti * tile + block_k - 1) // block_k,
-                0, n_sub)
-        else:
-            n_eff = n_sub
+        n_eff = (_causal_n_eff(qi, block_q, ti, tile, block_k, n_sub)
+                 if causal else n_sub)
         dq_acc_ref[...] = jax.lax.fori_loop(0, n_eff, body,
                                             dq_acc_ref[...])
 
