@@ -50,7 +50,9 @@ BASELINE_IMG_SEC_PER_DEVICE = 1656.82 / 16.0
 #       return early on the tunneled backend); zero blocks excluded.
 # r4.2: model timing loops force the same readback + median-anchored
 #       implausible-iter filter (_sane_rates).
-HARNESS_VERSION = "r4.2"
+# r4.3: default steps-per-iter 10 -> 32 (amortizes the param-copy
+#       critical path; +4-5% on both models) and echoed in config.
+HARNESS_VERSION = "r4.3"
 
 # Theoretical training FLOPs (fwd+bwd+update ≈ 3x forward; ResNet-50 fwd ≈
 # 4.1 GFLOP/img @224², ResNet-101 ≈ 7.8) — the MFU numerator.
@@ -363,7 +365,14 @@ def main():
                         "vgg16 and 128 for inception_v3 (HBM fit at "
                         "224/299), 8 for gpt (8x1024 tokens/chip/step)")
     p.add_argument("--num-iters", type=int, default=5)
-    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=32,
+                   help="training steps compiled into ONE program per "
+                        "timed iter. Bigger amortizes the serialized "
+                        "parameter-copy critical path across steps "
+                        "(measured sweep, v5-lite: resnet50 2466 img/s "
+                        "@10 -> 2574 @32 -> 2612 @128; gpt 83.3k tok/s "
+                        "@10 -> 87.4k @32 -> 88.5k @64; 32 balances "
+                        "gain vs runtime)")
     p.add_argument("--fp32", action="store_true",
                    help="use float32 instead of bfloat16")
     p.add_argument("--image-size", type=int, default=None,
@@ -557,6 +566,7 @@ def main():
             "model": args.model,
             "dtype": dtype_name,
             "batch_per_chip": bs,
+            "steps_per_iter": args.num_batches_per_iter,
             "chips": n,
             "platform": platform,
             **({"seq_len": args.seq_len, "flash": bool(args.flash),
