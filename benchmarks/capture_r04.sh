@@ -63,24 +63,32 @@ run() {
   local name="$1"; shift
   wait_sane
   echo "=== $name: $* ($(date -u +%H:%M:%S)) ===" >> "$OUT/capture.log"
-  timeout 2400 "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  # wait_sane just gated the data plane; skip bench.py's own probe loop
+  HVT_SKIP_DEVICE_PROBE=1 timeout 2400 "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
   echo "rc=$? $name done $(date -u +%H:%M:%S)" >> "$OUT/capture.log"
 }
 
-# Ordered by information value: headline ResNet + BN A/B, GPT einsum vs
-# compiled-pallas flash (1024 and, at batch 4 for HBM fit, 2048), then
-# the fused chunked-CE runs including the 2x batch it frees HBM for.
+# Ordered by information value: headline ResNet + BN A/B, the rest of
+# the reference benchmark trio + ResNet-101 (the one head-to-head
+# absolute number), GPT einsum vs compiled-pallas flash across the
+# measured crossover (1024/2048/4096; batch scaled for HBM fit), the
+# fused chunked-CE runs, the seq-8192 flash-only point (einsum crashes
+# the TPU worker there — do NOT add an einsum_8192 run), and GQA.
 run resnet_tpu_bn   python bench.py
 run resnet_flax_bn  python bench.py --bn-impl flax
+run resnet101       python bench.py --model resnet101
+run vgg16           python bench.py --model vgg16
+run inception_v3    python bench.py --model inception_v3
 run gpt_einsum      python bench.py --model gpt
 run gpt_flash       env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --flash
 run gpt_flash_2048  env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --flash --seq-len 2048 --batch-size 4
 run gpt_einsum_2048 python bench.py --model gpt --seq-len 2048 --batch-size 4
 run gpt_chunked_ce  python bench.py --model gpt --chunked-ce
 run gpt_chunked_2x  python bench.py --model gpt --chunked-ce --batch-size 16
-# long-context frontier: at 4096 the [B,H,S,S] einsum score tensor is
-# where flash's HBM advantage should finally show (or einsum OOMs,
-# which is the enablement headline)
+# long-context frontier: at 4096 flash's HBM advantage crosses over;
+# at 8192 it is the only path that runs at all
 run gpt_einsum_4096 python bench.py --model gpt --seq-len 4096 --batch-size 2
 run gpt_flash_4096  env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --seq-len 4096 --batch-size 2 --flash
+run gpt_flash_8192  env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --seq-len 8192 --batch-size 1 --flash
+run gpt_gqa_4096    env HVT_FLASH_INTERPRET=0 python bench.py --model gpt --seq-len 4096 --batch-size 2 --flash --n-kv-heads 2
 echo "=== capture_r04 done $(date -u) ===" >> "$OUT/capture.log"
