@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Calibration-gap probe: why does the in-harness matmul ceiling read
+~75 TFLOP/s when a v5-lite's paper bf16 peak is 197?
+
+Hypotheses swept here (VERDICT r4 #3):
+  H1 chain too short — each timed block is k_steps dependent 8192^3
+     matmuls (~45 ms at paper peak); the tunnel's per-dispatch RPC
+     latency is tens of ms, so short chains under-read badly. Sweep
+     k_steps 8..256: if the rate climbs with chain length and
+     asymptotes, the gap is dispatch overhead, not the chip.
+  H2 matrix too small/large for the MXU tiling — sweep m.
+  H3 accumulation dtype — bf16 operands accumulate in fp32 on the MXU
+     regardless; preferred_element_type=bf16 on the output would show
+     whether an output-convert pass taxes the chain.
+  H4 sustained throttling — a long run's per-block rates trending DOWN
+     over time would indicate clocks, not harness.
+
+Prints one JSON line per config plus a summary line. Run via the
+capture queue (gated behind tpu_sanity) — takes a few minutes.
+"""
+
+import json
+import sys
+import time
+
+
+def time_chain(m, k_steps, reps, out_dtype=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    x = jnp.asarray(np.random.RandomState(0).randn(m, m), jnp.bfloat16)
+    w = jnp.asarray(np.random.RandomState(1).randn(m, m), jnp.bfloat16)
+
+    if out_dtype is None:
+        def body(i, h):
+            return h @ w
+    else:
+        def body(i, h):
+            return lax.dot_general(
+                h, w, (((1,), (0,)), ((), ())),
+                preferred_element_type=out_dtype).astype(jnp.bfloat16)
+
+    @jax.jit
+    def chain(x, w):
+        return lax.fori_loop(0, k_steps, body, x)
+
+    float(jnp.sum(chain(x, w)))  # compile + settle
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(jnp.sum(chain(x, w)))  # forced readback (tunnel protocol)
+        dt = time.perf_counter() - t0
+        tflops = k_steps * 2 * m ** 3 / dt / 1e12
+        if tflops < 1000.0:
+            rates.append(tflops)
+    return rates
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    small = platform == "cpu"  # harness validation only
+
+    configs = []
+    # H1: chain length at the r4 calibration point (m=8192)
+    for k in ([2, 4] if small else [8, 32, 128, 256]):
+        configs.append({"m": 512 if small else 8192, "k_steps": k,
+                        "tag": "chain_len"})
+    # H2: matrix size at a long chain (dispatch amortized)
+    for m in ([256] if small else [2048, 4096, 16384]):
+        configs.append({"m": m, "k_steps": 4 if small else 64,
+                        "tag": "matrix_size"})
+    # H3: output dtype (fp32 accumulate + convert vs native)
+    configs.append({"m": 512 if small else 8192,
+                    "k_steps": 4 if small else 64,
+                    "out_dtype": "float32", "tag": "accum_out_fp32"})
+
+    results = []
+    for cfg in configs:
+        import jax.numpy as jnp
+
+        out_dtype = getattr(jnp, cfg["out_dtype"]) \
+            if "out_dtype" in cfg else None
+        reps = 2 if small else 5
+        rates = time_chain(cfg["m"], cfg["k_steps"], reps, out_dtype)
+        import numpy as np
+
+        rec = {"probe": "calib", "tag": cfg["tag"], "m": cfg["m"],
+               "k_steps": cfg["k_steps"],
+               "out_dtype": cfg.get("out_dtype", "default"),
+               "tflops_median": round(float(np.median(rates)), 2)
+               if rates else None,
+               "tflops_all": [round(r, 1) for r in rates],
+               "platform": platform}
+        results.append(rec)
+        print(json.dumps(rec))
+        sys.stdout.flush()
+
+    # H4: sustained run — 12 consecutive blocks at the best chain config;
+    # a downward trend = throttling, flat = no.
+    if not small:
+        sus = time_chain(8192, 128, 12)
+        print(json.dumps({"probe": "calib", "tag": "sustained_trend",
+                          "tflops_blocks": [round(r, 1) for r in sus],
+                          "platform": platform}))
+
+    best = max((r for r in results if r["tflops_median"]),
+               key=lambda r: r["tflops_median"], default=None)
+    print(json.dumps({"probe": "calib_summary",
+                      "best": best, "paper_peak_tflops": 197.0}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

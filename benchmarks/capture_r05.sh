@@ -1,0 +1,125 @@
+#!/bin/bash
+# Round-5 capture: QUEUE-DRIVEN armed benchmark pipeline, sequential
+# (one chip), one JSON line per run under benchmarks/r05/.
+#
+# Differences from capture_r04.sh (fixed run list):
+#   - Runs come from benchmarks/r05/queue.txt ("name<TAB>command..."),
+#     processed in order; completed names are recorded in done.txt.
+#     When the queue is exhausted the pipeline idles and re-polls, so
+#     new runs (e.g. post-optimization ResNet re-measures) are APPENDED
+#     to the queue instead of restarting the pipeline — restarting
+#     meant killing an in-flight TPU benchmark, and the round-2
+#     17-hour outage started right after exactly that.
+#   - Every heavy run stays gated behind the end-to-end data-plane
+#     probe (benchmarks/tpu_sanity.py): jax.devices() answering is NOT
+#     a gate — during the round-2/3/4 outages the control plane listed
+#     the device while every compile/execute RPC blocked forever.
+cd "$(dirname "$0")/.." || exit 1
+OUT=benchmarks/r05
+mkdir -p "$OUT"
+QUEUE="$OUT/queue.txt"
+DONE="$OUT/done.txt"
+touch "$QUEUE" "$DONE"
+
+# Single-pilot rule. PIDFILE identifies the incumbent precisely;
+# name-pattern pgrep is NOT safe (it also matches launching shells
+# whose cmdline contains the script name — observed self-kills in
+# round 4). Takeover policy (round-5 revision):
+#   - A live capture_r05 incumbent → REFUSE to start: the queue design
+#     makes relaunch unnecessary (append to queue.txt instead), and
+#     killing it could kill an in-flight TPU benchmark — the round-2
+#     17-hour tunnel wedge started right after exactly that.
+#   - An older-generation incumbent (fixed run list, no queue) → kill
+#     ONLY the supervisor script (never its children: an in-flight
+#     bench child becomes an orphan that finishes and writes its
+#     output), then DRAIN below before touching the chip.
+PIDFILE=/tmp/hvt_capture.pid
+if [ -f "$PIDFILE" ]; then
+  old=$(cat "$PIDFILE" 2>/dev/null)
+  if [ -n "$old" ] && [ "$old" != "$$" ] && kill -0 "$old" 2>/dev/null \
+     && grep -qa "capture_r0" "/proc/$old/cmdline" 2>/dev/null; then
+    if grep -qa "capture_r05" "/proc/$old/cmdline" 2>/dev/null; then
+      echo "capture_r05 already running (pid $old); append runs to" \
+           "$QUEUE instead of relaunching" >&2
+      exit 3
+    fi
+    kill "$old" 2>/dev/null
+  fi
+fi
+# Wait for the incumbent to actually die before claiming the pidfile:
+# its EXIT trap removes the pidfile, and firing AFTER our write would
+# delete OUR claim (observed once). The trap below also only removes
+# the file while it still holds our own pid, for the same race.
+for _ in 1 2 3 4 5; do
+  [ -n "${old:-}" ] && kill -0 "$old" 2>/dev/null || break
+  sleep 1
+done
+echo $$ > "$PIDFILE"
+trap '[ "$(cat "$PIDFILE" 2>/dev/null)" = "$$" ] && rm -f "$PIDFILE"' EXIT
+# DRAIN, don't kill: any orphaned heavy run (bench.py, calib_probe, or
+# any future queue entry — they all launch as `timeout 2400 env ...`)
+# keeps the chip; wait for it to finish or hit its own timeout before
+# probing. 2400 s timeout + margin bounds this at ~45 min.
+for _ in $(seq 1 90); do
+  pgrep -f "timeout 2400 env" >/dev/null 2>&1 || break
+  echo "draining in-flight heavy run before takeover $(date -u)" >> "$OUT/capture.log"
+  sleep 30
+done
+echo "=== capture_r05 started $(date -u) ===" >> "$OUT/capture.log"
+
+sane() {
+  timeout 180 python benchmarks/tpu_sanity.py >> "$OUT/capture.log" 2>&1
+}
+
+wait_sane() {
+  # Probe until the data plane answers, 9-minute spacing, bounded at
+  # ~11h. tpu_sanity rc=2 = deterministic local failure — bail.
+  for i in $(seq 1 66); do
+    sane; rc=$?
+    if [ "$rc" -eq 0 ]; then return 0; fi
+    if [ "$rc" -eq 2 ]; then
+      echo "=== local failure (sanity rc=2), bailing $(date -u) ===" >> "$OUT/capture.log"
+      exit 2
+    fi
+    echo "probe $i: data plane wedged/down $(date -u)" >> "$OUT/capture.log"
+    sleep 540
+  done
+  echo "=== gave up waiting for data plane $(date -u) ===" >> "$OUT/capture.log"
+  exit 1
+}
+
+run_one() {
+  local name="$1"; shift
+  wait_sane
+  echo "=== $name: $* ($(date -u +%H:%M:%S)) ===" >> "$OUT/capture.log"
+  # wait_sane just gated the data plane; skip bench.py's own probe loop
+  HVT_SKIP_DEVICE_PROBE=1 timeout 2400 env "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"
+  echo "rc=$? $name done $(date -u +%H:%M:%S)" >> "$OUT/capture.log"
+  echo "$name" >> "$DONE"
+}
+
+# Queue loop: process entries not yet in done.txt, in order; idle-poll
+# for appended work. Names must be unique — re-measuring a config
+# means appending a NEW name (e.g. resnet50_v2).
+idle_logged=0
+while true; do
+  next_name=""
+  while IFS=$'\t' read -r name cmd; do
+    [ -z "$name" ] && continue
+    case "$name" in \#*) continue ;; esac
+    if ! grep -qxF "$name" "$DONE" 2>/dev/null; then
+      next_name="$name"; next_cmd="$cmd"; break
+    fi
+  done < "$QUEUE"
+  if [ -n "$next_name" ]; then
+    idle_logged=0
+    # shellcheck disable=SC2086
+    run_one "$next_name" $next_cmd
+  else
+    if [ "$idle_logged" -eq 0 ]; then
+      echo "=== queue drained, idling $(date -u) ===" >> "$OUT/capture.log"
+      idle_logged=1
+    fi
+    sleep 120
+  fi
+done

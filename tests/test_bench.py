@@ -18,10 +18,12 @@ import bench
 def test_measure_multidevice_smoke():
     import jax
 
-    per_chip, total, std, flops_per_img, xla_flops, loss = bench.measure(
+    (per_chip, total, std, flops_per_img, xla_flops, loss,
+     suspect) = bench.measure(
         "resnet50", jax.devices()[:2], per_chip_batch=1, num_iters=1,
         num_batches_per_iter=1, dtype_name="fp32", image_size=32)
     assert per_chip > 0
+    assert suspect is False
     assert total == pytest.approx(per_chip * 2)
     assert np.isfinite(loss)
     # 32px analytic value: 12.3 GFLOP * (32/224)^2 ≈ 0.25 GFLOP
@@ -39,7 +41,7 @@ def test_main_scaling_sweep_and_json_schema(monkeypatch, capsys):
                      num_batches_per_iter, dtype_name, image_size=224,
                      norm_impl="tpu"):
         pc = per_chip_by_n[len(devices)]
-        return pc, pc * len(devices), 0.0, 12.3e9, 23.5e9, 1.23
+        return pc, pc * len(devices), 0.0, 12.3e9, 23.5e9, 1.23, False
 
     monkeypatch.setattr(bench, "measure", fake_measure)
     monkeypatch.setattr(bench, "calibrate_matmul_tflops", lambda p: 100.0)
@@ -64,6 +66,10 @@ def test_main_scaling_sweep_and_json_schema(monkeypatch, capsys):
     # 8 virtual devices → sweep over powers of two, efficiency vs n=1
     assert rec["scaling"]["n"] == [1, 2, 4, 8]
     assert rec["scaling"]["efficiency"] == [1.0, 0.95, 0.9, 0.85]
+    # r5.0 record fields: suspect flag always present; mfu_vs_peak is
+    # null on cpu (paper peak is a TPU spec)
+    assert rec["suspect"] is False
+    assert "mfu_vs_peak" in rec and rec["mfu_vs_peak"] is None
 
 
 def test_calibration_runs_on_cpu():
