@@ -173,7 +173,7 @@ def calibrate_matmul_tflops(platform):
 
 def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
                 dtype_name, seq_len=1024, use_flash=False,
-                chunked_ce=False, n_kv_heads=None):
+                chunked_ce=False, n_kv_heads=None, unroll=1):
     """GPT train-step throughput on a dp mesh (tokens/sec/chip) — the
     flagship-model counterpart of the ResNet measurement. FLOPs/token by
     the standard training estimate 6N + 12·L·d_model·seq (dense matmuls
@@ -230,9 +230,12 @@ def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
         return (optax.apply_updates(params, updates), opt_state), loss
 
     def block_fn(params, opt_state):
+        # unroll > 1 removes the while-loop barrier between consecutive
+        # steps so the scheduler can overlap step i's optimizer/stats
+        # tail with step i+1's matmuls (A/B lever; see --unroll)
         (params, opt_state), loss = lax.fori_loop(
             0, num_batches_per_iter, lambda i, c: train_step(c[0], None),
-            ((params, opt_state), jnp.float32(0)))
+            ((params, opt_state), jnp.float32(0)), unroll=unroll)
         return params, opt_state, loss
 
     block = jax.jit(block_fn, donate_argnums=(0, 1))
@@ -257,7 +260,7 @@ def measure_gpt(devices, per_chip_batch, num_iters, num_batches_per_iter,
 
 def measure(model_name, devices, per_chip_batch, num_iters,
             num_batches_per_iter, dtype_name, image_size=224,
-            norm_impl="tpu"):
+            norm_impl="tpu", conv0_s2d=False, unroll=1):
     """Train-step throughput on a dp mesh over ``devices``.
 
     Returns (per_chip_img_sec, img_sec_mean, img_sec_std, flops_per_img,
@@ -280,7 +283,10 @@ def measure(model_name, devices, per_chip_batch, num_iters,
     model_cls = {"resnet50": ResNet50, "resnet101": ResNet101,
                  "vgg16": VGG16,
                  "inception_v3": InceptionV3}[model_name]
-    model = model_cls(num_classes=1000, dtype=dtype, norm_impl=norm_impl)
+    extra = ({"conv0_space_to_depth": True}
+             if conv0_s2d and model_name.startswith("resnet") else {})
+    model = model_cls(num_classes=1000, dtype=dtype, norm_impl=norm_impl,
+                      **extra)
 
     global_batch = per_chip_batch * n
     rng = np.random.RandomState(0)
@@ -326,7 +332,8 @@ def measure(model_name, devices, per_chip_batch, num_iters,
         (params, batch_stats, opt_state), loss = lax.fori_loop(
             0, num_batches_per_iter,
             lambda i, c: train_step(c[0], None),
-            ((params, batch_stats, opt_state), jnp.float32(0)))
+            ((params, batch_stats, opt_state), jnp.float32(0)),
+            unroll=unroll)
         return params, batch_stats, opt_state, loss
 
     train_block = jax.jit(train_block_fn, donate_argnums=(0, 1, 2))
@@ -407,22 +414,41 @@ def main():
                    help="gpt: sequence-chunked fused cross-entropy — the "
                         "[B,S,V] logits tensor is never materialized "
                         "(ops/losses.py); frees HBM for larger batches")
+    p.add_argument("--conv0-s2d", action="store_true",
+                   help="resnet: numerically-identical space-to-depth "
+                        "stem (224x224x3 7x7/2 conv -> 112x112x12 4x4/1; "
+                        "the 3-channel stem starves the MXU contraction "
+                        "lanes — classic public-MLPerf TPU fix)")
+    p.add_argument("--unroll", type=int, default=1,
+                   help="unroll factor for the steps-per-iter fori_loop: "
+                        ">1 removes the while-loop barrier between steps "
+                        "so XLA can overlap step i's optimizer/BN-stats "
+                        "tail with step i+1's matmuls (compile time "
+                        "grows with the factor)")
     p.add_argument("--bn-impl", default="tpu", choices=["tpu", "flax"],
                    help="resnet batch norm: 'tpu' = bf16-traffic "
                         "fp32-accumulated TpuBatchNorm (default), 'flax' "
                         "= stock nn.BatchNorm (fp32 statistics AND "
                         "normalization passes) for A/B comparison")
-    p.add_argument("--force-cpu", action="store_true",
-                   help="run on a 2-device virtual CPU mesh (harness "
-                        "validation; the JAX_PLATFORMS env var alone does "
-                        "not override platform-pinning site plugins)")
+    p.add_argument("--force-cpu", nargs="?", const=2, default=None,
+                   type=int, metavar="N",
+                   help="run on an N-device virtual CPU mesh (default 2; "
+                        "harness validation, and with N=8 the 1→2→4→8 "
+                        "scaling-efficiency sweep exercises the metric of "
+                        "record's full shape. CPU-mesh numbers are "
+                        "RELATIVE-SHAPE-ONLY: virtual devices share one "
+                        "host's cores, so per-chip efficiency conflates "
+                        "collective overhead with core contention. The "
+                        "JAX_PLATFORMS env var alone does not override "
+                        "platform-pinning site plugins)")
     args = p.parse_args()
 
     import os
 
     if args.force_cpu:
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=2")
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.force_cpu}")
     elif os.environ.get("HVT_SKIP_DEVICE_PROBE"):
         pass  # an outer pipeline (capture_r04.sh wait_sane) already gated
     else:
@@ -508,10 +534,12 @@ def main():
                                dtype_name, args.seq_len,
                                use_flash=args.flash,
                                chunked_ce=args.chunked_ce,
-                               n_kv_heads=args.n_kv_heads)
+                               n_kv_heads=args.n_kv_heads,
+                               unroll=args.unroll)
         return measure(args.model, devs, bs, iters,
                        args.num_batches_per_iter, dtype_name,
-                       args.image_size, norm_impl=args.bn_impl)
+                       args.image_size, norm_impl=args.bn_impl,
+                       conv0_s2d=args.conv0_s2d, unroll=args.unroll)
 
     if not gpt and args.image_size is None:
         args.image_size = NATIVE_IMG_SIZE[args.model]
@@ -637,10 +665,12 @@ def main():
             "steps_per_iter": args.num_batches_per_iter,
             "chips": n,
             "platform": platform,
+            "unroll": args.unroll,
             **({"seq_len": args.seq_len, "flash": bool(args.flash),
                 "chunked_ce": bool(args.chunked_ce),
                 "n_kv_heads": args.n_kv_heads} if gpt else
-               {"image_size": args.image_size, "bn_impl": args.bn_impl}),
+               {"image_size": args.image_size, "bn_impl": args.bn_impl,
+                "conv0_s2d": bool(args.conv0_s2d)}),
         },
         # GPT has no reference-published absolute number; the ResNet
         # baseline stays the reference's 103.55 img/s/device
@@ -661,7 +691,14 @@ def main():
         f"flops_per_{unit_item}": round(flops_per_item / 1e9, 3),
         "xla_flops_per_img": (round(xla_flops_per_img / 1e9, 3)
                               if xla_flops_per_img is not None else None),
-        "scaling": {"n": sweep_n, "efficiency": sweep_eff},
+        "scaling": {"n": sweep_n, "efficiency": sweep_eff,
+                    # the sweep path itself is the metric of record
+                    # (BASELINE.md, reference docs/benchmarks.rst:13);
+                    # on a virtual CPU mesh the ratios conflate
+                    # collective overhead with host-core contention
+                    **({"caveat": "virtual CPU mesh: relative shape "
+                                  "only, devices share one host's cores"}
+                       if platform == "cpu" else {})},
     }))
 
 

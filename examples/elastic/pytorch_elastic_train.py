@@ -1,0 +1,88 @@
+"""PyTorch elastic training — the analog of reference
+``examples/elastic/pytorch/pytorch_mnist_elastic.py`` (one of
+BASELINE.json's benchmark configs):
+
+    hvtrun --min-np 2 --max-np 4 -np 2 \
+        --host-discovery-script ./discover.sh \
+        python examples/elastic/pytorch_elastic_train.py
+
+``TorchState`` snapshots model + optimizer + progress scalars; on worker
+loss the surviving ranks roll back to the last ``commit()`` and the job
+continues at the reduced (or grown) world size — reference
+``torch/elastic/state.py`` semantics.
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+import torch.optim as optim
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")   # one engine proc per slot
+
+import horovod_tpu.torch as hvd               # noqa: E402
+
+
+def make_model():
+    torch.manual_seed(0)
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--batches-per-epoch", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+
+    hvd.init()
+
+    model = make_model()
+    optimizer = optim.SGD(model.parameters(),
+                          lr=args.lr * hvd.size(), momentum=0.9)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    # synthetic regression task with a fixed ground truth so the loss
+    # decreases monotonically across elastic events
+    rs = np.random.RandomState(1234)
+    w_true = rs.randn(8, 4).astype(np.float32)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < args.epochs:
+            # resume mid-epoch after a restart (state.batch > 0)
+            for batch in range(state.batch, args.batches_per_epoch):
+                rs_b = np.random.RandomState(
+                    1000 * state.epoch + batch + hvd.rank())
+                x = torch.from_numpy(
+                    rs_b.randn(args.batch_size, 8).astype(np.float32))
+                y = x @ torch.from_numpy(w_true)
+                optimizer.zero_grad()
+                loss = F.mse_loss(model(x), y)
+                loss.backward()
+                optimizer.step()
+                state.batch = batch + 1
+                state.commit()    # snapshot + host-update check
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss {loss.item():.4f} "
+                      f"size={hvd.size()}")
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+        return model
+
+    state = hvd.elastic.TorchState(model=model, optimizer=optimizer,
+                                   epoch=0, batch=0)
+    train(state)
+    if hvd.rank() == 0:
+        print(f"done: epochs={args.epochs} final size={hvd.size()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -176,14 +176,54 @@ def UpdateBatchStateCallback(state):
 
 def DistributedOptimizer(optimizer, *args, **kwargs):
     """Wrap a Keras optimizer so ``apply_gradients`` exchanges gradients
-    across workers (reference ``keras/__init__.py:36`` — the reference
-    subclasses to override ``get_gradients``/``_aggregate_gradients``;
-    Keras 3 routes everything through ``apply_gradients``, which the
-    eager TF wrapper intercepts). Accepts the TF wrapper's kwargs
-    (compression, backward_passes_per_step, op, ...)."""
+    across workers (reference ``keras/__init__.py:36``). Accepts the TF
+    wrapper's kwargs (compression, backward_passes_per_step, op, ...).
+
+    The reference builds a dynamic subclass of the wrapped optimizer's
+    own class so Keras treats the result as a first-class optimizer;
+    the same trick is required here because Keras 3's
+    ``model.compile`` rejects anything that is not a
+    ``keras.optimizers.Optimizer`` instance. The subclass's
+    ``apply_gradients`` routes through the eager TF wrapper (which owns
+    compression / local aggregation / the collective exchange) and then
+    applies the reduced gradients via the original class's method. For
+    a non-Keras optimizer this falls back to returning the TF wrapper
+    directly (custom loops call ``apply_gradients`` themselves)."""
     from horovod_tpu import tensorflow as hvt_tf
 
-    return hvt_tf.DistributedOptimizer(optimizer, *args, **kwargs)
+    if not (_KERAS_AVAILABLE
+            and isinstance(optimizer, _keras.optimizers.Optimizer)):
+        return hvt_tf.DistributedOptimizer(optimizer, *args, **kwargs)
+
+    base = optimizer.__class__
+
+    class _ApplyDelegate:
+        """Stands in as the TF wrapper's inner optimizer: receives the
+        POST-exchange gradients and applies them with the plain Keras
+        method (bypassing the subclass override, or it would exchange
+        twice)."""
+
+        def __init__(self, keras_opt):
+            self._keras_opt = keras_opt
+
+        def apply_gradients(self, grads_and_vars, **kw):
+            return base.apply_gradients(self._keras_opt, grads_and_vars,
+                                        **kw)
+
+    def apply_gradients(self, grads_and_vars, **kw):
+        wrapper = self.__dict__.get("_hvt_wrapper")
+        if wrapper is None:
+            # built lazily so from_config()-created instances (Keras
+            # checkpoint restore) get wrapped too
+            wrapper = hvt_tf.DistributedOptimizer(
+                _ApplyDelegate(self), *args, **kwargs)
+            self.__dict__["_hvt_wrapper"] = wrapper
+        return wrapper.apply_gradients(list(grads_and_vars), **kw)
+
+    cls = type(base.__name__, (base,),
+               {"apply_gradients": apply_gradients,
+                "_hvt_distributed": True})
+    return cls.from_config(optimizer.get_config())
 
 
 def broadcast_global_variables(root_rank=0, model=None, variables=None):
@@ -265,7 +305,8 @@ def load_model(filepath, custom_optimizers=None, custom_objects=None,
     from horovod_tpu.tensorflow import _DistributedOptimizer
 
     opt = getattr(model, "optimizer", None)
-    if opt is not None and not isinstance(opt, _DistributedOptimizer):
+    if opt is not None and not isinstance(opt, _DistributedOptimizer) \
+            and not getattr(opt, "_hvt_distributed", False):
         model.optimizer = DistributedOptimizer(opt,
                                                compression=compression)
     return model

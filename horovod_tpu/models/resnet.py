@@ -24,10 +24,55 @@ from typing import Any, Callable, Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from .normalization import TpuBatchNorm
 
 ModuleDef = Any
+
+
+class _SpaceToDepthStem(nn.Module):
+    """MXU-friendly drop-in for the 7x7/2 stem conv.
+
+    The stem convolution has 3 input channels — the MXU's contraction
+    lanes run nearly empty there, and on TPU the stem is a measurable
+    slice of the whole ResNet step. The classic TPU fix (public MLPerf
+    ResNet submissions) is space-to-depth: fold a 2x2 pixel block into
+    the channel dim (224x224x3 -> 112x112x12) and apply the SAME
+    weights as an equivalent 4x4 stride-1 convolution. This is a pure
+    reindexing of the 7x7 stride-2 conv — numerically identical, pinned
+    by tests/test_models.py — with 4x the input channels per MXU pass.
+
+    The parameter keeps the standard ``(7, 7, 3, width)`` shape and the
+    ``{"conv_init": {"kernel"}}`` checkpoint layout of the ``nn.Conv``
+    it replaces; the kernel is rearranged at trace time (the rearrange
+    is fused into the weight convert XLA already performs).
+    """
+
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (7, 7, c, self.features), jnp.float32)
+        # pixels: (B, H, W, C) -> (B, H/2, W/2, 2*2*C), block-major (a, b, c)
+        x2 = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
+                                                    4 * c)
+        # weights: pad 7x7 -> 8x8 with one LEADING zero row/col so tap
+        # u maps to (dp, a) via u + 1 = 2*dp + a, then split each dim
+        # into (block, parity) and fold parity into channels
+        k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        k = k.reshape(4, 2, 4, 2, c, self.features)
+        k = k.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                  self.features)
+        # output i consumes folded rows i-2..i+1 -> padding (2, 1)
+        return lax.conv_general_dilated(
+            x2.astype(self.dtype), k.astype(self.dtype),
+            window_strides=(1, 1), padding=((2, 1), (2, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BottleneckBlock(nn.Module):
@@ -73,6 +118,10 @@ class ResNet(nn.Module):
     # (see models/normalization.py); "flax": stock nn.BatchNorm (fp32
     # statistics AND fp32 normalization passes) kept for parity checks.
     norm_impl: str = "tpu"
+    # Replace the 3-input-channel 7x7/2 stem with the numerically
+    # identical space-to-depth 4x4 form (see _SpaceToDepthStem). Same
+    # parameter shape and checkpoint layout either way.
+    conv0_space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -88,9 +137,12 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3),
-                                                              (3, 3)],
-                 name="conv_init")(x)
+        if self.conv0_space_to_depth:
+            x = _SpaceToDepthStem(self.width, dtype=self.dtype,
+                                  name="conv_init")(x)
+        else:
+            x = conv(self.width, (7, 7), strides=(2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])

@@ -75,25 +75,14 @@ class GPTConfig:
     ring_mesh: Optional[object] = None
 
 
-# Measured crossover on v5-lite (BENCH_NOTES.md round 4): einsum wins at
-# seq<=2048, flash from 4096 up (and is the only path that RUNS at 8192)
-_FLASH_AUTO_THRESHOLD = 2048
-
-
+# The crossover policy lives with the kernel (ops/flash_attention.py);
+# this lazy shim keeps the established `_resolve_flash` import path
+# without making every transformer import pay the pallas module load
+# (ops/flash_attention imports jax.experimental.pallas at module top).
 def _resolve_flash(use_flash, local_seq) -> bool:
-    """Resolve GPTConfig.use_flash ("auto" | bool) for a given local
-    sequence length (a static trace-time shape, so the choice compiles
-    away). "auto" upgrades to flash only on a real TPU backend — the
-    crossover was measured there, and off-TPU the kernel runs in pallas
-    interpret mode, far slower than einsum."""
-    if isinstance(use_flash, str):
-        if use_flash != "auto":
-            raise ValueError(
-                f"use_flash must be True, False, or 'auto'; got "
-                f"{use_flash!r}")
-        return (local_seq > _FLASH_AUTO_THRESHOLD
-                and jax.default_backend() == "tpu")
-    return bool(use_flash)
+    from horovod_tpu.ops.flash_attention import resolve_flash
+
+    return resolve_flash(use_flash, local_seq)
 
 
 def _rotary(x, positions):
@@ -159,15 +148,16 @@ class Attention(nn.Module):
             # GQA's smaller ICI payload in the ring is a future
             # optimization.
             k, v = _repeat_kv(k, v, cfg.n_heads // n_kv)
-            # "auto" decides by the PER-SHARD block length the ring
-            # schedule actually attends over, not the logical sequence
-            sp = dict(cfg.ring_mesh.shape).get("sp", 1)
+            # "auto" passes through UNRESOLVED: the ring shard function
+            # resolves it against its local (post-shard_map) block
+            # length, where the shape is unambiguous — dividing the
+            # trace-time shape by the mesh factor here would divide
+            # twice when a user invokes the model inside their own
+            # shard_map (ADVICE r4)
             out = ring_attention(q, k, v, mesh=cfg.ring_mesh,
                                  causal=True,
                                  scale=1.0 / np.sqrt(head_dim),
-                                 use_flash=_resolve_flash(
-                                     cfg.use_flash,
-                                     q.shape[-3] // sp))
+                                 use_flash=cfg.use_flash)
         elif _resolve_flash(cfg.use_flash, q.shape[-3]):
             from horovod_tpu.ops.flash_attention import flash_attention
 

@@ -30,6 +30,32 @@ _NEG_INF = -1e30
 _LANES = 128  # TPU vector lane width: scratch statistics are stored
               # broadcast across a full lane tile
 
+# Measured crossover on v5-lite (BENCH_NOTES.md round 4): einsum wins at
+# seq<=2048, flash from 4096 up (and is the only path that RUNS at 8192)
+FLASH_AUTO_THRESHOLD = 2048
+
+
+def resolve_flash(use_flash, local_seq) -> bool:
+    """Resolve a ``use_flash`` policy ("auto" | bool) for a given LOCAL
+    sequence length (a static trace-time shape, so the choice compiles
+    away). "auto" upgrades to flash only on a real TPU backend — the
+    crossover was measured there, and off-TPU the kernel runs in pallas
+    interpret mode, far slower than einsum.
+
+    ``local_seq`` must be the length the attention actually runs over:
+    the global length on a single device, the per-shard block length
+    under the ring schedule. The shard functions in
+    ``parallel/sequence.py`` resolve it themselves from their local
+    (post-shard_map) shapes, where it is unambiguous (ADVICE r4)."""
+    if isinstance(use_flash, str):
+        if use_flash != "auto":
+            raise ValueError(
+                f"use_flash must be True, False, or 'auto'; got "
+                f"{use_flash!r}")
+        return (local_seq > FLASH_AUTO_THRESHOLD
+                and jax.default_backend() == "tpu")
+    return bool(use_flash)
+
 
 def _interpret() -> bool:
     import os

@@ -73,11 +73,16 @@ def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
         (``ops/flash_attention.py``) instead of the einsum-softmax block
         step — O(shard) VMEM-resident scores instead of a materialized
         [Sq × Sk] tile. Blocks combine via the kernel's differentiable
-        logsumexp output.
+        logsumexp output. ``"auto"`` resolves by THIS function's local
+        shard length (``q.shape[1]``) against the measured crossover —
+        resolved here, under shard_map, where the per-device shape is
+        unambiguous regardless of who owns the shard_map (ADVICE r4).
 
     Returns [batch, seq_shard, heads, head_dim] in q.dtype.
     """
-    if use_flash:
+    from horovod_tpu.ops.flash_attention import resolve_flash
+
+    if resolve_flash(use_flash, q.shape[1]):
         return _ring_flash_shard(q, k, v, axis_name=axis_name,
                                  causal=causal, scale=scale)
     n = lax.axis_size(axis_name)
@@ -211,7 +216,11 @@ def ulysses_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
                               concat_axis=concat, tiled=True)
 
     qg, kg, vg = a2a(q, True), a2a(k, True), a2a(v, True)  # [B, S, H/N, D]
-    if attn_fn is None and use_flash:
+    # after the head exchange the local problem is FULL-sequence
+    # attention, so "auto" resolves against the gathered length
+    from horovod_tpu.ops.flash_attention import resolve_flash
+
+    if attn_fn is None and resolve_flash(use_flash, qg.shape[1]):
         from horovod_tpu.ops.flash_attention import flash_attention
 
         attn_fn = functools.partial(flash_attention, causal=causal,

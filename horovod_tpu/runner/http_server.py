@@ -132,6 +132,22 @@ class RendezvousServer:
                 else:
                     self._send(404)
 
+            def do_HEAD(self):
+                # existence probe for /kv paths: status + Content-Length
+                # only, no body (HTTPStore.exists uses this so checking
+                # a checkpoint's existence doesn't download it)
+                parts = self.path.strip("/").split("/")
+                if len(parts) >= 3 and parts[0] == "kv":
+                    v = store.get(parts[1], "/".join(parts[2:]))
+                    code, n = (404, 0) if v is None else (200, len(v))
+                    self.send_response(code)
+                    self.send_header("Content-Length", str(n))
+                    self.end_headers()
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
                 if len(parts) >= 3 and parts[0] == "kv":

@@ -9,4 +9,5 @@ from horovod_tpu.spark.estimator import (JaxEstimator, JaxModel,  # noqa: F401,E
 from horovod_tpu.spark.runner import (run, run_elastic,  # noqa: F401
                                       slot_envs_from_task_infos)  # noqa: F401,E501
 from horovod_tpu.spark.store import (DBFSLocalStore, FilesystemStore,  # noqa: F401,E501
-                                     HDFSStore, LocalStore, Store)
+                                     GCSStore, HDFSStore, HTTPStore,
+                                     LocalStore, RemoteStore, Store)

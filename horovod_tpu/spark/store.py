@@ -32,11 +32,18 @@ class Store:
 
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
-        """Factory by path scheme (reference ``store.py:144``)."""
+        """Factory by path scheme (reference ``store.py:144``):
+        ``hdfs://`` → HDFSStore, ``dbfs:/`` → DBFSLocalStore,
+        ``gs://`` → GCSStore, ``http(s)://`` → HTTPStore,
+        anything else (incl. ``file://``) → FilesystemStore."""
         if prefix_path.startswith("hdfs://"):
             return HDFSStore(prefix_path, *args, **kwargs)
         if prefix_path.startswith("dbfs:/"):
             return DBFSLocalStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith("gs://"):
+            return GCSStore(prefix_path, *args, **kwargs)
+        if prefix_path.startswith(("http://", "https://")):
+            return HTTPStore(prefix_path, *args, **kwargs)
         return FilesystemStore(prefix_path, *args, **kwargs)
 
     # -- layout ------------------------------------------------------------
@@ -272,6 +279,161 @@ class HDFSStore(Store):
                         self.write(f"{dst_dir}/{fn}", f.read())
 
         return sync
+
+
+class RemoteStore(Store):
+    """Base for stores whose backing filesystem is NOT locally mounted
+    (reference ``store.py`` splits the same way: path-layout logic shared,
+    ``exists/read/write/sync_fn`` remote). Subclasses implement the four
+    IO primitives against their service; the POSIX-style layout methods
+    live here."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path.rstrip("/")
+        self._runs = self.prefix_path + "/runs"
+
+    def _data(self, name, idx):
+        p = f"{self.prefix_path}/{name}"
+        return p if idx is None else f"{p}.{idx}"
+
+    def get_train_data_path(self, idx=None) -> str:
+        return self._data("intermediate_train_data", idx)
+
+    def get_val_data_path(self, idx=None) -> str:
+        return self._data("intermediate_val_data", idx)
+
+    def get_test_data_path(self, idx=None) -> str:
+        return self._data("intermediate_test_data", idx)
+
+    def get_runs_path(self) -> str:
+        return self._runs
+
+    def get_run_path(self, run_id: str) -> str:
+        return f"{self._runs}/{run_id}"
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/" \
+               f"{self.get_checkpoint_filename()}"
+
+    def get_logs_path(self, run_id: str) -> str:
+        return f"{self.get_run_path(run_id)}/logs"
+
+    def sync_fn(self, run_id: str):
+        run_path = self.get_run_path(run_id)
+
+        def sync(local_dir: str):
+            for root, _dirs, files in os.walk(local_dir):
+                rel = os.path.relpath(root, local_dir)
+                dst = (run_path if rel == "."
+                       else f"{run_path}/{rel.replace(os.sep, '/')}")
+                for fn in files:
+                    with open(os.path.join(root, fn), "rb") as f:
+                        self.write(f"{dst}/{fn}", f.read())
+
+        return sync
+
+
+class HTTPStore(RemoteStore):
+    """Remote store over the framework's own rendezvous HTTP KV server
+    (``runner/http_server.py`` — PUT/GET ``/kv/<scope>/<key>``). The
+    in-repo stand-in for an object store: every byte of the estimator
+    round-trip (checkpoints, logs, synced run dirs) travels over the
+    wire, so remote-store code paths are exercised for real even though
+    this image cannot reach cloud object storage.
+
+    ``prefix_path``: ``http://host:port[/subpath]`` — objects land under
+    KV scope ``store`` with key ``<subpath>/...``.
+    """
+
+    SCOPE = "store"
+
+    def __init__(self, prefix_path: str, timeout: float = 30.0):
+        super().__init__(prefix_path)
+        from urllib.parse import urlparse
+
+        u = urlparse(self.prefix_path)
+        self._base = f"{u.scheme}://{u.netloc}"
+        self._timeout = timeout
+
+    def _key(self, path: str) -> str:
+        # strip the server authority; keys keep the subpath so multiple
+        # stores can share one server
+        if path.startswith(self._base):
+            path = path[len(self._base):]
+        return path.lstrip("/")
+
+    def _url(self, path: str) -> str:
+        from urllib.parse import quote
+
+        return (f"{self._base}/kv/{self.SCOPE}/"
+                f"{quote(self._key(path))}")
+
+    def exists(self, path: str) -> bool:
+        import urllib.error
+        import urllib.request
+
+        # HEAD: headers only — a GET would ship the whole object (a
+        # multi-MB checkpoint) just to learn it exists
+        req = urllib.request.Request(self._url(path), method="HEAD")
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout):
+                return True
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return False
+            raise
+
+    def read(self, path: str) -> bytes:
+        import urllib.request
+
+        with urllib.request.urlopen(self._url(path),
+                                    timeout=self._timeout) as r:
+            return r.read()
+
+    def write(self, path: str, data: bytes):
+        import urllib.request
+
+        req = urllib.request.Request(self._url(path), data=data,
+                                     method="PUT")
+        with urllib.request.urlopen(req, timeout=self._timeout):
+            pass
+
+
+class GCSStore(RemoteStore):
+    """Google Cloud Storage store (``gs://bucket/path``) — the
+    TPU-idiomatic object store for checkpoints/logs. Gated on the
+    ``google-cloud-storage`` client, which this image cannot install
+    (zero egress): constructing without it raises a clear ImportError,
+    like :class:`HDFSStore` without pyarrow. The IO surface mirrors
+    HTTPStore's, which the tests exercise end-to-end."""
+
+    def __init__(self, prefix_path: str, client=None):
+        super().__init__(prefix_path)
+        rest = prefix_path[len("gs://"):]
+        self._bucket_name = rest.partition("/")[0]
+        if client is None:
+            try:
+                from google.cloud import storage  # type: ignore
+            except ImportError as e:  # pragma: no cover - env w/o gcs
+                raise ImportError(
+                    "GCSStore requires the google-cloud-storage client; "
+                    "use HTTPStore or FilesystemStore instead") from e
+            client = storage.Client()
+        self._bucket = client.bucket(self._bucket_name)
+
+    def _key(self, path: str) -> str:
+        if path.startswith("gs://"):
+            path = path[len("gs://"):].partition("/")[2]
+        return path.lstrip("/")
+
+    def exists(self, path: str) -> bool:
+        return self._bucket.blob(self._key(path)).exists()
+
+    def read(self, path: str) -> bytes:
+        return self._bucket.blob(self._key(path)).download_as_bytes()
+
+    def write(self, path: str, data: bytes):
+        self._bucket.blob(self._key(path)).upload_from_string(data)
 
 
 # reference exposes LocalStore as an alias of the filesystem flavor

@@ -644,9 +644,18 @@ class _DistributedAdasumOptimizer:
             [np.asarray(v).copy() for _, v in live]
         result = self._opt.apply_gradients(gv, **kwargs)
         deltas = [v - s for (_, v), s in zip(live, starts)]
-        # names must be rank-identical: variable names, never id()s
-        names = [f"adasum.delta.{i}.{getattr(v, 'name', None) or 'var'}"
-                 for i, (_, v) in enumerate(live)]
+        # names must be (a) rank-identical, (b) independent of which
+        # OTHER gradients are None on this rank, and (c) unique within
+        # the step. The index into the FULL gradient list gives (b)+(c)
+        # — it is structurally rank-invariant, unlike an index into the
+        # None-filtered list, where a conditionally-frozen layer on one
+        # rank would shift every later index and deadlock the
+        # negotiation (ADVICE r4) — and the variable name alone would
+        # break (c): TF2 eager does not uniquify, so two variables can
+        # share '<w>:0'.
+        names = [f"adasum.delta.{idx}."
+                 f"{getattr(v, 'name', None) or 'var'}"
+                 for idx, (g, v) in enumerate(gv) if g is not None]
         combined = _allreduce_grads(
             deltas, op=C.Adasum, compression=self._compression,
             name_prefix="adasum.delta", names=names)

@@ -90,13 +90,24 @@ def start(path, mark_cycles=False, xla_profiler=True):
     data plane. Armed best-effort: a profiler that cannot start (another
     session already active, backend without profiling) never blocks the
     engine timeline.
+
+    Session ownership: JAX allows ONE active profiler session, and while
+    the timeline holds it a user's own ``jax.profiler.start_trace``
+    fails. Pass ``xla_profiler=False`` (or set ``HVT_TIMELINE_XLA=0``)
+    when your code manages its own profiler sessions; if a session is
+    already active when the timeline starts, the timeline leaves it
+    untouched and records without device traces (ADVICE r4).
     """
+    import os as _os
+
     global _state
     with _state_lock:
         if _state is not None:
             return
         _state = _TimelineState(path, mark_cycles)
         _state.xla_profiling = False
+        if _os.environ.get("HVT_TIMELINE_XLA", "1") == "0":
+            xla_profiler = False
         if xla_profiler:
             try:
                 import jax
@@ -104,6 +115,9 @@ def start(path, mark_cycles=False, xla_profiler=True):
                 jax.profiler.start_trace(path + ".xplane")
                 _state.xla_profiling = True
             except Exception:
+                # includes "already active": that session belongs to the
+                # user — never stolen, and stop() below won't touch it
+                # because xla_profiling stays False
                 pass
 
 

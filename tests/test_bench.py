@@ -39,7 +39,7 @@ def test_main_scaling_sweep_and_json_schema(monkeypatch, capsys):
 
     def fake_measure(model_name, devices, per_chip_batch, num_iters,
                      num_batches_per_iter, dtype_name, image_size=224,
-                     norm_impl="tpu"):
+                     norm_impl="tpu", conv0_s2d=False, unroll=1):
         pc = per_chip_by_n[len(devices)]
         return pc, pc * len(devices), 0.0, 12.3e9, 23.5e9, 1.23, False
 

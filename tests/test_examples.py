@@ -80,6 +80,60 @@ def test_tf_elastic_train_smoke_2proc():
     assert "epoch 4" in out, out[-1500:]
 
 
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="C++ engine not built")
+def test_torch_imagenet_resnet50_smoke_2proc():
+    # fp16 compression + grouped fusion + local aggregation — the
+    # BASELINE.json torch-ImageNet config (reference
+    # examples/pytorch/pytorch_imagenet_resnet50.py) at smoke scale
+    out = _run_example(
+        ["examples/torch/pytorch_imagenet_resnet50.py", "--width", "8",
+         "--image-size", "32", "--batch-size", "4", "--epochs", "1",
+         "--steps-per-epoch", "2", "--batches-per-allreduce", "2"],
+        np_procs=2, timeout=420)
+    assert "img/sec" in out, out[-1000:]
+
+
+@pytest.mark.skipif(not os.path.exists(LIB),
+                    reason="C++ engine not built")
+def test_torch_elastic_train_smoke_2proc():
+    # reference examples/elastic/pytorch analog: TorchState +
+    # @hvd.elastic.run over the real launcher in elastic mode
+    _PORT[0] += 1
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": "",
+                "PYTHONPATH": REPO + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    cmd = [sys.executable, "-m", "horovod_tpu.runner.launch",
+           "-np", "2", "--min-np", "2", "--max-np", "3",
+           "--master-port", str(_PORT[0]), sys.executable,
+           "examples/elastic/pytorch_elastic_train.py",
+           "--epochs", "3", "--batches-per-epoch", "2"]
+    proc = subprocess.run(cmd, env=env, cwd=REPO, capture_output=True,
+                          text=True, timeout=420)
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    assert "done: epochs=3" in proc.stdout + proc.stderr
+
+
+@pytest.mark.skipif(not os.path.exists(TF_OPS_LIB),
+                    reason="TF op library not built")
+def test_keras_mnist_smoke_2proc():
+    # BASELINE.json Keras-MNIST config (reference
+    # examples/tensorflow2/tensorflow2_keras_mnist.py): full callback
+    # set through real model.fit
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        out = _run_example(
+            ["examples/keras/keras_mnist.py", "--epochs", "2",
+             "--batch-size", "8", "--steps-per-epoch", "2",
+             "--checkpoint-dir", d],
+            np_procs=2, timeout=420)
+    assert "final loss" in out, out[-1500:]
+
+
 def test_jax_long_context_train_smoke():
     out = _run_example(
         ["examples/jax/jax_long_context_train.py", "--sp", "4", "--seq",

@@ -2,6 +2,8 @@
 installed (the reference's ``test/single/test_ray*.py`` use a local ray
 cluster; the pure-logic cores here are testable without one)."""
 
+import os
+
 import pytest
 
 from horovod_tpu.ray.elastic import ElasticRayExecutor, RayHostDiscovery
@@ -177,6 +179,130 @@ def test_store_create_dispatch(tmp_path):
     assert isinstance(Store.create("dbfs:/x"), DBFSLocalStore)
     assert DBFSLocalStore._localize("dbfs:/a/b") == "/dbfs/a/b"
     assert FilesystemStore._localize("file:///a/b") == "/a/b"
+
+
+def test_store_create_dispatch_remote_schemes():
+    from horovod_tpu.spark import HTTPStore, RemoteStore, Store
+
+    s = Store.create("http://127.0.0.1:1/base")
+    assert isinstance(s, HTTPStore) and isinstance(s, RemoteStore)
+    # gs:// dispatches to GCSStore. Environment-dependent outcome:
+    # with the library + ambient credentials (a real GCP TPU VM) it
+    # constructs; without either it must fail LOUDLY (gated
+    # ImportError, or the client's credentials error) — never a
+    # silently broken store.
+    from horovod_tpu.spark import GCSStore
+
+    try:
+        s = Store.create("gs://bucket/prefix")
+    except Exception as e:
+        assert ("google-cloud-storage" in str(e)
+                or "credential" in str(e).lower()
+                or type(e).__name__ == "DefaultCredentialsError"), e
+    else:
+        assert isinstance(s, GCSStore)
+
+
+def test_http_store_roundtrip_over_real_kv_server():
+    """Remote-store IO through the actual rendezvous HTTP KV server —
+    every byte over the wire (VERDICT r4 #6: the reference selects
+    LocalStore/HDFSStore by scheme, spark/common/store.py)."""
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.spark import Store
+
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        store = Store.create(f"http://127.0.0.1:{port}/teamA")
+        assert store.get_checkpoint_path("r1") \
+            == f"http://127.0.0.1:{port}/teamA/runs/r1/checkpoint.bin"
+        ck = store.get_checkpoint_path("r1")
+        assert not store.exists(ck)
+        store.write(ck, b"remote-bytes")
+        assert store.exists(ck) and store.read(ck) == b"remote-bytes"
+        # scratch-dir sync publishes every file into the run path
+        with store.get_local_output_dir_fn("r1")() as d:
+            os.makedirs(f"{d}/sub", exist_ok=True)
+            with open(f"{d}/epoch-0.pt", "wb") as f:
+                f.write(b"ck0")
+            with open(f"{d}/sub/log.txt", "wb") as f:
+                f.write(b"line")
+            store.sync_fn("r1")(d)
+        assert store.read(store.get_run_path("r1") + "/epoch-0.pt") \
+            == b"ck0"
+        assert store.read(store.get_run_path("r1") + "/sub/log.txt") \
+            == b"line"
+    finally:
+        srv.stop()
+
+
+def test_gcs_store_io_with_fake_client():
+    """GCSStore's key mapping + IO against a dict-backed fake client
+    (the real google-cloud-storage is uninstallable here; the fake
+    mirrors Bucket.blob().exists/download_as_bytes/upload_from_string)."""
+    from horovod_tpu.spark import GCSStore
+
+    blobs = {}
+
+    class FakeBlob:
+        def __init__(self, key):
+            self.key = key
+
+        def exists(self):
+            return self.key in blobs
+
+        def download_as_bytes(self):
+            return blobs[self.key]
+
+        def upload_from_string(self, data):
+            blobs[self.key] = (data.encode()
+                               if isinstance(data, str) else data)
+
+    class FakeBucket:
+        def blob(self, key):
+            return FakeBlob(key)
+
+    class FakeClient:
+        def bucket(self, name):
+            assert name == "my-bucket"
+            return FakeBucket()
+
+    store = GCSStore("gs://my-bucket/ckpts", client=FakeClient())
+    ck = store.get_checkpoint_path("r9")
+    assert ck == "gs://my-bucket/ckpts/runs/r9/checkpoint.bin"
+    assert not store.exists(ck)
+    store.write(ck, b"gcs-bytes")
+    assert store.exists(ck) and store.read(ck) == b"gcs-bytes"
+    # keys are bucket-relative
+    assert "ckpts/runs/r9/checkpoint.bin" in blobs
+
+
+def test_jax_estimator_roundtrip_through_http_store():
+    """Full estimator fit → checkpoint-publish → load → predict with the
+    store served remotely (VERDICT r4 #6 'done' criterion)."""
+    import numpy as np
+
+    from horovod_tpu.runner.http_server import RendezvousServer
+    from horovod_tpu.spark import JaxEstimator, JaxModel, Store
+
+    srv = RendezvousServer()
+    port = srv.start()
+    try:
+        store = Store.create(f"http://127.0.0.1:{port}/est")
+        rng = np.random.RandomState(3)
+        Wt = np.asarray([1.5, -2.0], np.float32)
+        X = rng.randn(64, 2).astype(np.float32)
+        y = X @ Wt
+        est = JaxEstimator(_linreg_train_fn, feature_cols=["a", "b"],
+                           label_col="y", epochs=1, store=store,
+                           run_id="runH")
+        model = est._fit_arrays(X, y)
+        assert store.exists(store.get_checkpoint_path("runH"))
+        loaded = JaxModel.load(store, "runH")
+        np.testing.assert_allclose(loaded._predict_arrays(X),
+                                   model._predict_arrays(X))
+    finally:
+        srv.stop()
 
 
 def test_jax_estimator_fit_save_load_predict(tmp_path):

@@ -21,6 +21,46 @@ def test_resnet50_forward_shape():
     assert "batch_stats" in mutated
 
 
+def test_conv0_space_to_depth_is_numerically_identical():
+    """The s2d stem is a pure reindexing of the 7x7/2 conv: same kernel
+    parameter, same output, for any input — and the checkpoint layout
+    ({"conv_init": {"kernel"}}, shape (7,7,3,width)) is unchanged."""
+    from horovod_tpu.models.resnet import _SpaceToDepthStem
+    from jax import lax
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)
+    stem = _SpaceToDepthStem(features=16, dtype=jnp.float32)
+    variables = stem.init(jax.random.PRNGKey(1), x)
+    k = variables["params"]["kernel"]
+    assert k.shape == (7, 7, 3, 16)
+
+    got = stem.apply(variables, x)
+    want = lax.conv_general_dilated(
+        x, k, window_strides=(2, 2), padding=((3, 3), (3, 3)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    assert got.shape == want.shape == (2, 16, 16, 16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_conv0_s2d_checkpoint_layout_matches_standard_stem():
+    from horovod_tpu.models import ResNet50
+
+    x = jnp.zeros((1, 64, 64, 3))
+    std = ResNet50(num_classes=10, dtype=jnp.float32).init(
+        jax.random.PRNGKey(0), x, train=True)
+    s2d = ResNet50(num_classes=10, dtype=jnp.float32,
+                   conv0_space_to_depth=True).init(
+        jax.random.PRNGKey(0), x, train=True)
+    assert (std["params"]["conv_init"]["kernel"].shape
+            == s2d["params"]["conv_init"]["kernel"].shape)
+    # a standard-stem checkpoint loads into an s2d model verbatim
+    std_tree = jax.tree.structure(std)
+    s2d_tree = jax.tree.structure(s2d)
+    assert std_tree == s2d_tree
+
+
 def test_resnet_eval_mode():
     from horovod_tpu.models import ResNet50
 
@@ -396,7 +436,7 @@ def test_gpt_use_flash_auto_resolves_by_sequence_length(monkeypatch):
 
     # long sequence: auto must route through the flash kernel. Shrink
     # the threshold so the CPU-interpret run stays fast.
-    monkeypatch.setattr(tr, "_FLASH_AUTO_THRESHOLD", 64)
+    monkeypatch.setattr(fa, "FLASH_AUTO_THRESHOLD", 64)
     tokens_long = jnp.asarray(
         np.random.RandomState(0).randint(0, 64, (1, 128)))
     model.apply(params, tokens_long)

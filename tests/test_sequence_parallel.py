@@ -52,6 +52,37 @@ def test_ring_attention_matches_dense(causal):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_ring_attention_auto_resolves_per_shard(monkeypatch):
+    """``use_flash="auto"`` is resolved INSIDE the shard function from
+    its local block length — never by dividing a trace-time shape by a
+    mesh factor at the call site, which double-divides when the caller
+    is already inside its own shard_map (ADVICE r4). Pinned by spying
+    on the resolver: with sp=8 over seq 64 it must see 8, not 1."""
+    from horovod_tpu.ops import flash_attention as fa
+    from horovod_tpu.parallel import sequence as seq_mod
+
+    seen = []
+    real = fa.resolve_flash
+
+    def spy(use_flash, local_seq):
+        seen.append(local_seq)
+        return real(use_flash, local_seq)
+
+    monkeypatch.setattr(fa, "resolve_flash", spy)
+    q, k, v = _qkv()
+    mesh = make_parallel_mesh(sp=8)
+    spec = P(None, "sp", None, None)
+    qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                  for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh=mesh, causal=True,
+                         use_flash="auto")
+    ref = _dense_reference(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    assert seen and all(s == 64 // 8 for s in seen), seen
+    del seq_mod  # imported to make the monkeypatch target explicit
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_attention_matches_dense(causal):
     q, k, v = _qkv(h=8)
