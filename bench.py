@@ -73,9 +73,10 @@ PAPER_PEAK_TFLOPS = 197.0
 # Training FLOPs (3x forward, forward = 2x MACs), algorithmic counts at
 # the model's native resolution (224; inception_v3 scales from 299).
 FLOPS_PER_IMG = {"resnet50": 12.3e9, "resnet101": 23.4e9,
-                 "vgg16": 46.5e9, "inception_v3": 17.1e9}
-NATIVE_IMG_SIZE = {"resnet50": 224, "resnet101": 224, "vgg16": 224,
-                   "inception_v3": 299}
+                 "resnet152": 34.5e9, "vgg16": 46.5e9,
+                 "inception_v3": 17.1e9}
+NATIVE_IMG_SIZE = {"resnet50": 224, "resnet101": 224, "resnet152": 224,
+                   "vgg16": 224, "inception_v3": 299}
 
 
 def _compiled_flops(lowered_compiled):
@@ -274,14 +275,14 @@ def measure(model_name, devices, per_chip_batch, num_iters,
 
     import horovod_tpu as hvt
     from horovod_tpu.models import (InceptionV3, ResNet50, ResNet101,
-                                    VGG16)
+                                    ResNet152, VGG16)
     from horovod_tpu.parallel.mesh import make_parallel_mesh
 
     n = len(devices)
     mesh = make_parallel_mesh(devices=devices, dp=n)
     dtype = jnp.float32 if dtype_name == "fp32" else jnp.bfloat16
     model_cls = {"resnet50": ResNet50, "resnet101": ResNet101,
-                 "vgg16": VGG16,
+                 "resnet152": ResNet152, "vgg16": VGG16,
                  "inception_v3": InceptionV3}[model_name]
     extra = ({"conv0_space_to_depth": True}
              if conv0_s2d and model_name.startswith("resnet") else {})
@@ -376,8 +377,8 @@ def measure(model_name, devices, per_chip_batch, num_iters,
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="resnet50",
-                   choices=["resnet50", "resnet101", "vgg16",
-                            "inception_v3", "gpt"])
+                   choices=["resnet50", "resnet101", "resnet152",
+                            "vgg16", "inception_v3", "gpt"])
     p.add_argument("--n-kv-heads", type=int, default=None,
                    help="gpt: grouped-query attention K/V head count "
                         "(default: n_heads=12, i.e. standard MHA; must "

@@ -294,13 +294,22 @@ def _flash(q, k, v, scale, causal, block_q, block_k, out_dtype):
     return o, lse
 
 
-def _seq_tile(s, block_q, block_k):
+# The dkv backward kernel carries more per-tile state than the forward
+# (Q + dO tiles streamed together plus two fp32 accumulators), so the
+# largest tile that fits the 16 MB scoped-VMEM limit is SMALLER there:
+# tile 8192 runs in fwd/dq but blows VMEM in dkv (measured, v5-lite,
+# BENCH_NOTES r4). Cap dkv's tile independently so a user-requested
+# HVT_FLASH_SEQ_TILE=8192 degrades only the one kernel that needs it.
+_DKV_TILE_CAP = 4096
+
+
+def _seq_tile(s, block_q, block_k, cap=None):
     """Streamed-sequence VMEM tile (elements of the seq axis per grid
     step). Measured on v5-lite (d=64, 12 heads): 4096 is the sweet spot
     — within 5% of a fully resident kernel at seq<=4096 while seq 8192
-    runs at MFU 0.35 (tile 8192 re-blows the 16 MB scoped-VMEM limit in
-    the dkv kernel; tile 2048 costs ~10% more refetch). Override with
-    HVT_FLASH_SEQ_TILE for other head dims.
+    runs at MFU 0.35 (tile 2048 costs ~10% more refetch). Override with
+    HVT_FLASH_SEQ_TILE for other head dims; ``cap`` bounds the request
+    per-kernel (the dkv backward caps at ``_DKV_TILE_CAP``).
 
     The tile must divide ``s`` AND be a multiple of both block sizes —
     the kernels walk ``tile // block`` sub-blocks, so a remainder would
@@ -311,12 +320,25 @@ def _seq_tile(s, block_q, block_k):
     import os
 
     req = min(int(os.environ.get("HVT_FLASH_SEQ_TILE", "4096")), s)
+    if cap is not None:
+        req = min(req, cap)
     base = math.lcm(block_q, block_k)
     best, m = base, 2
     while m * base <= req:
         if s % (m * base) == 0:
             best = m * base
         m += 1
+    if cap is not None and best > cap:
+        # correctness pins the tile to >= lcm(block_q, block_k); block
+        # sizes whose lcm exceeds the cap force a tile the capped
+        # kernel may not fit in VMEM — say so instead of failing later
+        # with an opaque scoped-VMEM allocation error
+        import sys
+
+        print(f"# horovod_tpu flash: block sizes ({block_q}, {block_k}) "
+              f"force tile {best} > VMEM cap {cap} in the capped "
+              f"backward kernel; expect scoped-VMEM pressure — use "
+              f"blocks with lcm <= {cap}", file=sys.stderr)
     return best
 
 
@@ -395,19 +417,23 @@ def _flash_bwd(scale, causal, block_q, block_k, out_dtype, res, cot):
     # kernel still reads the shared K/V head zero-copy but emits
     # per-QUERY-head gradients (full h), which are then group-summed —
     # each K/V head's gradient is the sum over its query group.
+    # The dkv tile is capped independently of the fwd/dq tile: this
+    # kernel streams Q AND dO tiles together and was the one that blew
+    # scoped VMEM at tile 8192 (see _DKV_TILE_CAP).
+    dkv_tile = _seq_tile(s, block_q, block_k, cap=_DKV_TILE_CAP)
     kv_in_ki = pl.BlockSpec((1, 1, block_k, d),
                             lambda bi, hi, ki, ti: (bi, hi // group, ki, 0))
     dkv_out_ki = pl.BlockSpec((1, 1, block_k, d),
                               lambda bi, hi, ki, ti: (bi, hi, ki, 0))
-    q_tile = pl.BlockSpec((1, 1, tile, d),
+    q_tile = pl.BlockSpec((1, 1, dkv_tile, d),
                           lambda bi, hi, ki, ti: (bi, hi, ti, 0))
-    vec_tile = pl.BlockSpec((1, 1, tile, 1),
+    vec_tile = pl.BlockSpec((1, 1, dkv_tile, 1),
                             lambda bi, hi, ki, ti: (bi, hi, ti, 0))
     full_shape = (b, h, s, d)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q),
-        grid=(b, h, s // block_k, s // tile),
+        grid=(b, h, s // block_k, s // dkv_tile),
         in_specs=[kv_in_ki, kv_in_ki, q_tile, q_tile, vec_tile,
                   vec_tile],
         out_specs=[dkv_out_ki, dkv_out_ki],

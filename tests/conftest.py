@@ -52,6 +52,51 @@ def _ensure_engine_built():
 _ensure_engine_built()
 
 
+# ---------------------------------------------------------------- quick set
+# Inner-loop marker (VERDICT r4 #8): the full suite is ~37 min on this
+# 1-core host, dominated by the modules below (multi-subprocess gangs,
+# TF imports per worker, pallas interpret mode, heavy 8-device
+# compiles). Everything NOT in this list is auto-marked `quick`;
+# `./ci.sh --fast` runs `-m quick` (~minutes). The full suite stays
+# the round gate. Classification is by module because the cost is
+# dominated by per-module fixtures (subprocess spawns, TF import,
+# first-compile), not individual test bodies.
+_SLOW_MODULES = {
+    "test_engine_integration",   # real 2/4/5-process engine gangs
+    "test_multiprocess_jit",     # jax.distributed subprocess pairs
+    "test_engine_scaling",       # timed eager-plane benchmarks
+    "test_adasum",               # multi-process numeric cross-checks
+    "test_autotune",             # engine cycles to convergence
+    "test_tensorflow",           # TF import + eager engine paths
+    "test_tensorflow_native",    # TF custom-op gangs (20 s import/worker)
+    "test_tensorflow_real",      # real keras fits
+    "test_torch_parallel",       # multi-process torch gangs
+    "test_examples",             # every example as a subprocess
+    "test_elastic_driver",       # launcher + failure/growth scenarios
+    "test_runner",               # launcher subprocesses
+    "test_preemption",           # signal/recovery scenarios
+    "test_flash_attention",      # pallas interpret mode is slow on CPU
+    "test_sequence_parallel",    # ring/ulysses 8-device compiles
+    "test_models",               # GPT/ResNet init + flash paths
+    "test_sanitizers",           # TSAN/ASAN rebuilds
+    "test_bench",                # full harness runs
+    "test_integrations",         # real gang + HTTP-store suites
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "quick: fast inner-loop subset (auto-applied to "
+                   "modules outside the known-slow list; run with "
+                   "`pytest -m quick` or `./ci.sh --fast`)")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__.rsplit(".", 1)[-1] not in _SLOW_MODULES:
+            item.add_marker(pytest.mark.quick)
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _hvt_init():
     import horovod_tpu as hvt

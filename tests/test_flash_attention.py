@@ -177,6 +177,57 @@ def test_seq_tile_divisibility_invariants():
         assert s % t == 0 and t % bq == 0 and t % bk == 0, (s, bq, bk, t)
 
 
+def test_seq_tile_cap_bounds_the_dkv_tile():
+    """The dkv backward streams Q AND dO tiles together and blows the
+    16 MB scoped-VMEM limit one tile size earlier than fwd/dq (measured
+    r4, v5-lite): a user-requested HVT_FLASH_SEQ_TILE=8192 must degrade
+    only dkv, to _DKV_TILE_CAP, while still satisfying the
+    divisibility invariants."""
+    import os
+
+    from horovod_tpu.ops.flash_attention import _DKV_TILE_CAP, _seq_tile
+
+    os.environ["HVT_FLASH_SEQ_TILE"] = "8192"
+    try:
+        full = _seq_tile(8192, 128, 128)
+        capped = _seq_tile(8192, 128, 128, cap=_DKV_TILE_CAP)
+        assert full == 8192
+        assert capped == _DKV_TILE_CAP == 4096
+        assert 8192 % capped == 0 and capped % 128 == 0
+        # cap interacts with odd block sizes without breaking invariants
+        t = _seq_tile(6144, 128, 512, cap=4096)
+        assert t <= 4096 and 6144 % t == 0 and t % 512 == 0
+    finally:
+        del os.environ["HVT_FLASH_SEQ_TILE"]
+
+
+def test_flash_grads_match_dense_when_fwd_and_dkv_tiles_differ(
+        monkeypatch):
+    """Gradient correctness when the fwd/dq streaming tile differs from
+    the capped dkv tile (the seq-8192 + HVT_FLASH_SEQ_TILE=8192 shape,
+    shrunk: fwd tile 512, dkv capped at 256)."""
+    from horovod_tpu.ops import flash_attention as fa
+
+    monkeypatch.setenv("HVT_FLASH_SEQ_TILE", "512")
+    monkeypatch.setattr(fa, "_DKV_TILE_CAP", 256)
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(1, 512, 2, 32), jnp.float32)
+    k = jnp.asarray(rs.randn(1, 512, 2, 32), jnp.float32)
+    v = jnp.asarray(rs.randn(1, 512, 2, 32), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True).sum()
+
+    def loss_dense(q, k, v):
+        return _dense(q, k, v, causal=True).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-2)
+
+
 def test_flash_multi_tile_matches_dense_768_mixed_blocks():
     """The review's concrete miss case: s=768, block_q=384, block_k=256
     forces a tile that is a multiple of both; fwd AND grads must match

@@ -502,7 +502,213 @@ def test_keras_estimator_validation(tmp_path):
     assert rec.logs[-1]["val_loss"] < rec.logs[0]["val_loss"]
 
 
-# ------------------------------------------- fake-DataFrame fit(df) rig
+# --------------------------------------- barrier-API conformance (r4 #9)
+
+# The slice of pyspark's DOCUMENTED API that spark/runner.py relies on,
+# with arities (excluding self). Source: pyspark.BarrierTaskContext /
+# RDD / SparkSession docs (pyspark 3.x). If runner.py starts using a
+# method not listed here, the fake below lacks it and the execution
+# test fails loudly — instead of the env-blocked code rotting silently
+# against a drifted fake (VERDICT r4 #9). If pyspark ever changes this
+# surface, THIS table is the single place to re-verify against the
+# real docs.
+_PYSPARK_DOCUMENTED_SURFACE = {
+    "BarrierTaskContext.get": 0,          # classmethod
+    "BarrierTaskContext.getTaskInfos": 0,  # -> [BarrierTaskInfo(address)]
+    "BarrierTaskContext.partitionId": 0,
+    "BarrierTaskContext.barrier": 0,
+    "SparkSession.builder.getOrCreate": 0,
+    "SparkContext.parallelize": 2,        # (iterable, numSlices)
+    "SparkContext.broadcast": 1,
+    "SparkContext.defaultParallelism": 0,  # property
+    "RDD.barrier": 0,
+    "RDDBarrier.mapPartitions": 1,
+    "RDD.collect": 0,
+}
+
+
+def _install_fake_pyspark(monkeypatch, num_proc):
+    """Inject a sys.modules pyspark whose surface is EXACTLY
+    _PYSPARK_DOCUMENTED_SURFACE — nothing more, so undocumented-API use
+    in runner.py breaks here rather than on a real cluster."""
+    import sys
+    import types
+
+    class _TaskInfo:
+        def __init__(self, address):
+            self.address = address
+
+    class BarrierTaskContext:
+        _current = None
+
+        @classmethod
+        def get(cls):
+            return cls._current
+
+        def __init__(self, rank):
+            self._rank = rank
+
+        def getTaskInfos(self):
+            return [_TaskInfo(f"127.0.0.1:{40000 + i}")
+                    for i in range(num_proc)]
+
+        def partitionId(self):
+            return self._rank
+
+        def barrier(self):
+            pass  # single-gang fake: tasks run sequentially
+
+    class _BarrierRDD:
+        def __init__(self, parts):
+            self._parts = parts
+
+        def mapPartitions(self, fn):
+            out = []
+            for i, p in enumerate(self._parts):
+                BarrierTaskContext._current = BarrierTaskContext(i)
+                try:
+                    out.extend(fn(iter(p)))
+                finally:
+                    BarrierTaskContext._current = None
+            return _CollectedRDD(out)
+
+    class _CollectedRDD:
+        def __init__(self, rows):
+            self._rows = rows
+
+        def collect(self):
+            return list(self._rows)
+
+    class _SC:
+        defaultParallelism = num_proc
+
+        def parallelize(self, it, numSlices):
+            items = list(it)
+            return _PlainRDD([items[i::numSlices]
+                              for i in range(numSlices)])
+
+        def broadcast(self, v):
+            return FakeBroadcast(v)
+
+    class _PlainRDD:
+        def __init__(self, parts):
+            self._parts = parts
+
+        def barrier(self):
+            return _BarrierRDD(self._parts)
+
+    class _Builder:
+        def getOrCreate(self):
+            s = types.SimpleNamespace()
+            s.sparkContext = _SC()
+            return s
+
+    class SparkSession:
+        builder = _Builder()
+
+    mod = types.ModuleType("pyspark")
+    mod.BarrierTaskContext = BarrierTaskContext
+    sql = types.ModuleType("pyspark.sql")
+    sql.SparkSession = SparkSession
+    mod.sql = sql
+    # expose every fake class for the conformance test — ALL rows of
+    # _PYSPARK_DOCUMENTED_SURFACE must be checkable, not just the
+    # BarrierTaskContext ones
+    mod._conformance_targets = {
+        "BarrierTaskContext": BarrierTaskContext,
+        "SparkContext": _SC,
+        "RDD": _PlainRDD,
+        "RDDBarrier": _BarrierRDD,
+        "CollectedRDD": _CollectedRDD,
+        "Builder": _Builder,
+    }
+    monkeypatch.setitem(sys.modules, "pyspark", mod)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", sql)
+    return mod
+
+
+def test_fake_barrier_context_matches_documented_surface(monkeypatch):
+    """Method-name/arity conformance of the fake vs the documented
+    pyspark surface — EVERY row of the table, so the fake can only rot
+    in a way this catches."""
+    import inspect
+
+    mod = _install_fake_pyspark(monkeypatch, 2)
+    t = mod._conformance_targets
+    # dotted surface name -> (fake class, method) it must conform on
+    fake_for = {
+        "BarrierTaskContext.get": (t["BarrierTaskContext"], "get"),
+        "BarrierTaskContext.getTaskInfos":
+            (t["BarrierTaskContext"], "getTaskInfos"),
+        "BarrierTaskContext.partitionId":
+            (t["BarrierTaskContext"], "partitionId"),
+        "BarrierTaskContext.barrier":
+            (t["BarrierTaskContext"], "barrier"),
+        "SparkSession.builder.getOrCreate": (t["Builder"], "getOrCreate"),
+        "SparkContext.parallelize": (t["SparkContext"], "parallelize"),
+        "SparkContext.broadcast": (t["SparkContext"], "broadcast"),
+        "SparkContext.defaultParallelism":
+            (t["SparkContext"], "defaultParallelism"),
+        "RDD.barrier": (t["RDD"], "barrier"),
+        "RDDBarrier.mapPartitions": (t["RDDBarrier"], "mapPartitions"),
+        "RDD.collect": (t["CollectedRDD"], "collect"),
+    }
+    assert set(fake_for) == set(_PYSPARK_DOCUMENTED_SURFACE), \
+        "surface table and fake mapping drifted apart"
+    for dotted, arity in _PYSPARK_DOCUMENTED_SURFACE.items():
+        cls, name = fake_for[dotted]
+        attr = inspect.getattr_static(cls, name)
+        assert attr is not None, f"fake lacks {dotted}"
+        if dotted == "SparkContext.defaultParallelism":
+            # documented as a property/attribute, not a callable
+            assert not callable(attr)
+            continue
+        raw = attr.__func__ if isinstance(attr, classmethod) else attr
+        params = [p for p in
+                  inspect.signature(raw).parameters.values()
+                  if p.name not in ("self", "cls")]
+        assert len(params) == arity, (dotted, params)
+
+
+def test_spark_run_executes_through_documented_barrier_api(monkeypatch):
+    """spark.run() END-TO-END through the fake barrier gang (1 task:
+    threads would fight over os.environ): env derivation from task
+    addresses, barrier before init, hvt runtime up inside the task,
+    results ordered by rank. Previously run() was only gating-tested —
+    this pins the whole documented-API interaction."""
+    import jax
+
+    import horovod_tpu as hvt
+
+    _install_fake_pyspark(monkeypatch, 1)
+    from horovod_tpu.spark import runner as spark_runner
+
+    def train_fn(a, b=0):
+        # the fake gang runs in-process, where the pytest session's
+        # runtime is already up — assert on the env the barrier task
+        # derived from the task addresses, not on ambient hvt state
+        assert os.environ["HVT_NUM_PROCESSES"] == "1"
+        assert os.environ["HVT_PROCESS_ID"] == "0"
+        assert os.environ["HVT_HOSTNAME"] == "127.0.0.1"
+        return a + b + int(os.environ["HVT_PROCESS_ID"])
+
+    # In-process isolation: the barrier task calls hvt.init() AND
+    # hvt.shutdown() (correct on a real executor, fatal to the pytest
+    # session's runtime here — shutdown would tear down the session
+    # fixture's engine for every later test) and os.environ.update()s
+    # the slot identity. Neuter init/shutdown and restore the env.
+    monkeypatch.setattr(hvt, "init", lambda *a, **k: None)
+    monkeypatch.setattr(hvt, "shutdown", lambda *a, **k: None)
+    env_before = dict(os.environ)
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        out = spark_runner.run(train_fn, args=(40,), kwargs={"b": 2},
+                               num_proc=1, force_cpu_jax=True)
+    finally:
+        for k in set(os.environ) - set(env_before):
+            del os.environ[k]
+        os.environ.update(env_before)
+    assert out == [42]
 
 class FakeBroadcast:
     def __init__(self, v):
