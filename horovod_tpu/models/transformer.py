@@ -143,11 +143,17 @@ class Attention(nn.Module):
         if cfg.ring_mesh is not None:
             from horovod_tpu.parallel.sequence import ring_attention
 
-            # the ring schedule streams K/V shards per full head set
-            # today; broadcast first (XLA fuses the repeat). Exploiting
-            # GQA's smaller ICI payload in the ring is a future
-            # optimization.
-            k, v = _repeat_kv(k, v, cfg.n_heads // n_kv)
+            # GQA K/V go to the ring UN-repeated: the schedule
+            # circulates the small h_kv buffers over ICI (payload
+            # shrinks by the group factor — the point of GQA at long
+            # context) and broadcasts locally per block (einsum path)
+            # or aliases heads zero-copy in the kernel (flash path).
+            # Exception: a 'tp' mesh axis shards the head dim, and the
+            # small K/V head count may not divide it — repeat up front
+            # there (the pre-r5 behavior) so the sharding stays valid.
+            tp = dict(cfg.ring_mesh.shape).get("tp", 1)
+            if n_kv % tp:
+                k, v = _repeat_kv(k, v, cfg.n_heads // n_kv)
             # "auto" passes through UNRESOLVED: the ring shard function
             # resolves it against its local (post-shard_map) block
             # length, where the shape is unambiguous — dividing the

@@ -88,6 +88,16 @@ def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
+    # Grouped-query attention: K/V may carry FEWER heads than Q. The
+    # ring circulates the small K/V buffers (ICI payload shrinks by the
+    # group factor — the point of GQA at long context) and each step
+    # broadcasts them to the query head count LOCALLY, where XLA fuses
+    # the repeat into the attention einsum instead of materializing it.
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"query heads ({h}) must be a multiple of K/V "
+                         f"heads ({h_kv})")
+    group = h // h_kv
     if scale is None:
         scale = d ** -0.5
 
@@ -104,8 +114,13 @@ def ring_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
         # ring neighbor (idx - step) mod n.
         k_idx = (idx - step) % n
         k_pos = k_idx * s + jnp.arange(s)
+        if group > 1:   # local GQA broadcast; the RING carries h_kv heads
+            kb = jnp.repeat(k_blk, group, axis=2)
+            vb = jnp.repeat(v_blk, group, axis=2)
+        else:
+            kb, vb = k_blk, v_blk
         o_blk, m_blk, l_blk = _local_attention(
-            q, k_blk, v_blk, q_pos, k_pos, causal=causal, scale=scale)
+            q, kb, vb, q_pos, k_pos, causal=causal, scale=scale)
         m_new = jnp.maximum(m, m_blk)
         c_old = jnp.exp(m - m_new)        # rescale previous accumulator
         c_blk = jnp.exp(m_blk - m_new)
@@ -205,6 +220,21 @@ def ulysses_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
     if h % n != 0:
         raise ValueError(f"Ulysses needs heads ({h}) divisible by the "
                          f"sequence-parallel axis size ({n})")
+    # GQA: K/V may carry fewer heads; the head-exchange all_to_all then
+    # needs the K/V head count divisible by the axis too (each device
+    # ends up with h/n query heads and h_kv/n K/V heads — the group
+    # structure is preserved because consecutive query heads share a
+    # K/V head)
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"query heads ({h}) must be a multiple of K/V "
+                         f"heads ({h_kv})")
+    if h_kv != h and h_kv % n != 0:
+        raise ValueError(
+            f"Ulysses with grouped-query K/V needs K/V heads ({h_kv}) "
+            f"divisible by the axis size ({n}); repeat K/V to the "
+            f"query head count first for smaller head counts")
+    group = h // h_kv
     if scale is None:
         scale = d ** -0.5
 
@@ -223,9 +253,13 @@ def ulysses_attention_shard(q, k, v, *, axis_name, causal=True, scale=None,
     if attn_fn is None and resolve_flash(use_flash, qg.shape[1]):
         from horovod_tpu.ops.flash_attention import flash_attention
 
+        # the kernel serves GQA zero-copy (head-index aliasing)
         attn_fn = functools.partial(flash_attention, causal=causal,
                                     scale=scale)
     if attn_fn is None:
+        if group > 1:   # local broadcast for the dense einsum path
+            kg = jnp.repeat(kg, group, axis=2)
+            vg = jnp.repeat(vg, group, axis=2)
         pos = jnp.arange(s * n)
         og, _, l = _local_attention(qg, kg, vg, pos, pos,
                                     causal=causal, scale=scale)

@@ -83,6 +83,59 @@ def test_ring_attention_auto_resolves_per_shard(monkeypatch):
     del seq_mod  # imported to make the monkeypatch target explicit
 
 
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ring_attention_gqa_circulates_small_kv(use_flash):
+    """GQA K/V enter the ring UN-repeated (h_kv=2 circulating buffers
+    for h=4 query heads — half the ICI payload); output must equal
+    dense attention over locally-repeated K/V, on both the einsum and
+    flash block paths."""
+    rng = np.random.RandomState(1)
+    b, s, h, h_kv, d = 2, 64, 4, 2, 16
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h_kv, d)), jnp.float32)
+    mesh = make_parallel_mesh(sp=8)
+    spec = P(None, "sp", None, None)
+    qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                  for x in (q, k, v))
+    out = ring_attention(qs, ks, vs, mesh=mesh, causal=True,
+                         use_flash=use_flash)
+    kr = jnp.repeat(k, h // h_kv, axis=2)
+    vr = jnp.repeat(v, h // h_kv, axis=2)
+    ref = _dense_reference(q, kr, vr, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_attention_gqa():
+    """Ulysses with GQA: K/V heads exchange on their own (smaller)
+    head axis; consecutive-query-head grouping survives the a2a."""
+    rng = np.random.RandomState(2)
+    b, s, h, h_kv, d = 2, 64, 16, 8, 8
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h_kv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h_kv, d)), jnp.float32)
+    mesh = make_parallel_mesh(sp=8)
+    spec = P(None, "sp", None, None)
+    qs, ks, vs = (jax.device_put(x, NamedSharding(mesh, spec))
+                  for x in (q, k, v))
+    out = ulysses_attention(qs, ks, vs, mesh=mesh, causal=True)
+    kr = jnp.repeat(k, h // h_kv, axis=2)
+    vr = jnp.repeat(v, h // h_kv, axis=2)
+    ref = _dense_reference(q, kr, vr, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_gqa_indivisible_kv_heads_raises():
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.normal(size=(1, 64, 16, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 64, 2, 8)), jnp.float32)
+    mesh = make_parallel_mesh(sp=8)
+    with pytest.raises(ValueError, match="K/V heads"):
+        ulysses_attention(q, k, k, mesh=mesh)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_ulysses_attention_matches_dense(causal):
     q, k, v = _qkv(h=8)
