@@ -1,0 +1,92 @@
+"""Keras synthetic-data throughput benchmark — the analog of reference
+``examples/tensorflow2/tensorflow2_keras_synthetic_benchmark.py``:
+
+    hvtrun -np 2 python examples/keras/keras_synthetic_benchmark.py \
+        --model ResNet50 --batch-size 32
+
+Measures img/sec through ``model.fit``-style training with the
+distributed optimizer. ``--model`` accepts any ``tf.keras.applications``
+architecture name (constructed with ``weights=None`` — no downloads);
+``--small`` swaps in a compact CNN for smoke tests and CPU machines.
+"""
+
+import argparse
+import time
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.keras as hvd
+
+
+def small_cnn(num_classes=1000):
+    return tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 3, strides=2, activation="relu"),
+        tf.keras.layers.Conv2D(32, 3, strides=2, activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(num_classes),
+    ])
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="ResNet50",
+                   help="tf.keras.applications model name")
+    p.add_argument("--small", action="store_true",
+                   help="compact CNN instead of a keras.applications "
+                        "model (smoke tests)")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=3)
+    args = p.parse_args()
+
+    hvd.init()
+
+    if args.small:
+        model = small_cnn()
+    else:
+        model = getattr(tf.keras.applications, args.model)(
+            weights=None, input_shape=(args.image_size, args.image_size,
+                                       3))
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(0.01 * hvd.size(), momentum=0.9))
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True)
+
+    rng = np.random.RandomState(hvd.rank())
+    data = tf.constant(rng.randn(args.batch_size, args.image_size,
+                                 args.image_size, 3).astype(np.float32))
+    target = tf.constant(rng.randint(0, 1000, args.batch_size))
+
+    # broadcast initial weights so ranks agree (build via one forward)
+    model(data[:1])
+    hvd.broadcast_global_variables(0, model=model)
+
+    def benchmark_step():
+        with tf.GradientTape() as tape:
+            loss = loss_fn(target, model(data, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.perf_counter() - t0
+        img_secs.append(args.batch_size * args.num_batches_per_iter / dt)
+
+    if hvd.rank() == 0:
+        mean, std = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec per proc: {mean:.1f} +- {std:.1f}")
+        print(f"Total img/sec on {hvd.size()} proc(s): "
+              f"{mean * hvd.size():.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -134,6 +134,20 @@ def test_keras_mnist_smoke_2proc():
     assert "final loss" in out, out[-1500:]
 
 
+@pytest.mark.skipif(not os.path.exists(TF_OPS_LIB),
+                    reason="TF op library not built")
+def test_keras_synthetic_benchmark_smoke_2proc():
+    # reference tensorflow2_keras_synthetic_benchmark.py analog:
+    # tape + DistributedOptimizer.apply_gradients throughput loop
+    out = _run_example(
+        ["examples/keras/keras_synthetic_benchmark.py", "--small",
+         "--batch-size", "4", "--image-size", "32",
+         "--num-warmup-batches", "1", "--num-batches-per-iter", "2",
+         "--num-iters", "1"],
+        np_procs=2, timeout=420)
+    assert "Img/sec" in out, out[-1500:]
+
+
 def test_jax_long_context_train_smoke():
     out = _run_example(
         ["examples/jax/jax_long_context_train.py", "--sp", "4", "--seq",
