@@ -74,6 +74,14 @@ def test_tf_function_train_smoke_2proc():
 
 @pytest.mark.skipif(not os.path.exists(TF_OPS_LIB),
                     reason="TF op library not built")
+def test_tf_tape_train_smoke_2proc():
+    out = _run_example(["examples/tensorflow/tf_tape_train.py"],
+                       np_procs=2, timeout=420)
+    assert "loss" in out, out[-1000:]
+
+
+@pytest.mark.skipif(not os.path.exists(TF_OPS_LIB),
+                    reason="TF op library not built")
 def test_tf_elastic_train_smoke_2proc():
     out = _run_example(["examples/tensorflow/tf_elastic_train.py"],
                        np_procs=2, timeout=420)

@@ -107,6 +107,50 @@ def test_ring_attention_gqa_circulates_small_kv(use_flash):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ring_gqa_ppermute_payload_is_small_kv():
+    """The claim behind the GQA ring optimization, pinned at the IR
+    level: the circulating ppermute buffers carry h_kv heads, not the
+    query head count (the broadcast happens locally per block)."""
+    from horovod_tpu.parallel.sequence import ring_attention_shard
+
+    b, s_shard, h, h_kv, d = 1, 8, 4, 2, 16
+
+    def shard_fn(q, k, v):
+        return ring_attention_shard(q, k, v, axis_name="sp",
+                                    causal=True)
+
+    mesh = make_parallel_mesh(sp=8)
+    from horovod_tpu.parallel.sequence import _shard_map
+
+    spec = P(None, "sp", None, None)
+    wrapped = _shard_map(shard_fn, mesh=mesh,
+                         in_specs=(spec,) * 3, out_specs=spec,
+                         check_vma=False)
+    q = jnp.zeros((b, s_shard * 8, h, d), jnp.float32)
+    k = jnp.zeros((b, s_shard * 8, h_kv, d), jnp.float32)
+    jaxpr = jax.make_jaxpr(wrapped)(q, k, k)
+    # walk the whole tree: the ppermutes live inside the scan eqn that
+    # wraps the ring's fori_loop body, nested under the shard_map eqn
+    perm_shapes = []
+
+    def walk(jx):
+        for e in jx.eqns:
+            if e.primitive.name == "ppermute":
+                perm_shapes.append(e.invars[0].aval.shape)
+            for sub in e.params.values():
+                if hasattr(sub, "eqns"):
+                    walk(sub)
+                elif hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    assert perm_shapes, "no ppermute found in the ring jaxpr"
+    for shape in perm_shapes:
+        assert shape[-2] == h_kv, (
+            f"ring circulates {shape[-2]} heads; expected the small "
+            f"K/V head count {h_kv}")
+
+
 def test_ulysses_attention_gqa():
     """Ulysses with GQA: K/V heads exchange on their own (smaller)
     head axis; consecutive-query-head grouping survives the a2a."""
