@@ -33,8 +33,11 @@ def parse_args(argv=None):
         prog="hvtrun",
         description="Launch a horovod_tpu job (CPU engine processes or one "
                     "process per TPU host).")
-    p.add_argument("-np", "--num-proc", type=int, required=True,
-                   help="total number of processes")
+    # not required at the argparse level so `hvtrun --check-build`
+    # answers alone; main() enforces it for actual launches
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="total number of processes (required unless "
+                        "--check-build)")
     p.add_argument("-H", "--hosts", default=None,
                    help="host1:slots,host2:slots (default: localhost:np)")
     p.add_argument("--hostfile", default=None,
@@ -87,12 +90,16 @@ def parse_args(argv=None):
                    help="YAML file supplying any of these flags; "
                         "explicit CLI flags win (reference --config-file)")
     p.add_argument("--verbose", action="store_true")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   help="print available frameworks/controllers/tensor "
+                        "operations and exit (-np and a training "
+                        "command are not required)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     args = p.parse_args(argv)
-    if not args.command:
+    if not args.command and not args.check_build:
         p.error("no training command given")
-    if args.command[0] == "--":
+    if args.command and args.command[0] == "--":
         args.command = args.command[1:]
     if args.config_file:
         from horovod_tpu.runner.config_parser import apply_config
@@ -265,8 +272,68 @@ def _run_elastic(args) -> int:
     return 0
 
 
+def check_build(verbose: bool = False) -> int:
+    """Print what this installation can do (reference
+    ``runner/launch.py:110`` ``horovodrun --check-build``), recast for
+    the TPU stack: framework bindings by importability, the C++ engine
+    and TF custom-op library by presence of their built artifacts, and
+    the data planes they unlock."""
+    import importlib.util
+    import os
+
+    def mark(ok):
+        return "X" if ok else " "
+
+    def importable(name):
+        try:
+            return importlib.util.find_spec(name) is not None
+        except Exception:
+            return False
+
+    from horovod_tpu import __version__
+    from horovod_tpu.engine.native import _lib_path
+
+    engine_lib = _lib_path()
+    engine = os.path.exists(engine_lib)
+    tf_ops = os.path.exists(os.path.join(os.path.dirname(engine_lib),
+                                         "libhvt_tf_ops.so"))
+    out = f"""\
+horovod_tpu v{__version__}:
+
+Available Frameworks:
+    [X] JAX (core)
+    [{mark(importable('tensorflow'))}] TensorFlow
+    [{mark(importable('torch'))}] PyTorch
+    [{mark(importable('mxnet'))}] MXNet (numpy bridge)
+    [{mark(importable('tensorflow'))}] Keras
+
+Available Controllers:
+    [{mark(engine)}] TCP control star (C++ engine)
+    [X] Elastic HTTP rendezvous
+
+Available Tensor Operations:
+    [X] XLA/ICI compiled collectives (psum / all_gather / ...)
+    [{mark(engine)}] shared-memory local plane
+    [{mark(engine)}] TCP ring
+    [{mark(engine)}] hierarchical (local RS -> cross AR -> local AG)
+    [{mark(tf_ops)}] TF native custom ops"""
+    print(out)
+    if verbose:
+        state = ("present" if engine
+                 else "NOT BUILT — run make -C horovod_tpu/csrc")
+        print(f"\nengine library: {engine_lib} ({state})")
+    return 0
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    # bound by argparse BEFORE the REMAINDER command, so a
+    # --check-build belonging to the training script is not hijacked
+    if args.check_build:
+        return check_build(verbose=args.verbose)
+    if args.num_proc is None:
+        print("hvtrun: error: -np/--num-proc is required", file=sys.stderr)
+        return 2
     if args.min_np is not None or args.host_discovery_script:
         return _run_elastic(args)
     if args.hostfile:

@@ -166,3 +166,17 @@ def test_launcher_sigkill_leaves_no_orphan_workers(tmp_path):
         except ProcessLookupError:
             pass
     assert not alive, f"orphan workers survived launcher SIGKILL: {alive}"
+
+
+def test_check_build_reports_capabilities(capsys):
+    """hvtrun --check-build (reference runner/launch.py:110): prints the
+    capability table without requiring -np, exits 0."""
+    from horovod_tpu.runner.launch import main
+
+    assert main(["--check-build"]) == 0
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX (core)" in out
+    assert "XLA/ICI compiled collectives" in out
+    # engine is built in this tree (conftest builds it)
+    assert "[X] TCP control star" in out
