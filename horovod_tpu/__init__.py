@@ -108,8 +108,9 @@ __version__ = "0.1.0"
 
 def __getattr__(name):
     # lazy submodules: checkpoint pulls in orbax, runner pulls launcher
-    # machinery — neither belongs in the base import path
-    if name in ("checkpoint", "runner"):
+    # machinery, metrics is only needed by jobs that scrape it — none
+    # belongs in the base import path
+    if name in ("checkpoint", "runner", "metrics"):
         import importlib
 
         return importlib.import_module(f"horovod_tpu.{name}")
@@ -143,6 +144,8 @@ __all__ = [
     "PartialDistributedGradientTransformation",
     # elastic
     "elastic",
+    # telemetry (lazy submodule)
+    "metrics",
     # exceptions
     "HorovodInternalError", "HostsUpdatedInterrupt",
 ]

@@ -109,6 +109,19 @@ def init(comm=None, process_sets=None):
                 master_port=int(os.environ.get("HVT_MASTER_PORT", "29510")),
                 cycle_ms=int(os.environ.get("HVT_CYCLE_TIME_MS", "2")))
 
+        # Telemetry endpoint (hvtrun --metrics-port → HVT_METRICS_PORT):
+        # every worker serves GET /metrics at base_port + process_rank so
+        # co-hosted workers never collide; port 0 binds ephemerally.
+        metrics_port = os.environ.get("HVT_METRICS_PORT")
+        if metrics_port is not None:
+            from horovod_tpu import metrics as _metrics
+
+            base = int(metrics_port)
+            offset = int(procid or 0) if base else 0
+            bound = _metrics.serve(base + offset)
+            if os.environ.get("HVT_VERBOSE"):
+                print(f"[hvt] metrics endpoint on :{bound}/metrics")
+
         # Materialize the device list once; this is the global communicator.
         from horovod_tpu.parallel import mesh as _mesh
 
@@ -149,6 +162,9 @@ def shutdown():
         from horovod_tpu.common import process_sets as _ps
 
         _ps._reset()
+        from horovod_tpu import metrics as _metrics
+
+        _metrics.stop_server()
         _initialized = False
 
 
@@ -322,6 +338,70 @@ def gloo_enabled() -> bool:
 def xla_built() -> bool:
     """TPU-native addition: the XLA/ICI data plane is always built in."""
     return True
+
+
+# --- engine telemetry bridge (horovod_tpu.metrics) --------------------------
+
+def poll_engine_stats(registry=None):
+    """Pull the C++ engine's atomic stats block (``hvt_engine_stats``,
+    ``csrc/c_api.cc``) into metric counters/gauges.
+
+    Registered as a collector on the default registry
+    (``horovod_tpu.metrics.registry()``), so every scrape / JSON snapshot
+    polls the engine exactly once. The series are emitted even when the
+    engine is absent (zeros) — dashboards and BENCH records keep a stable
+    schema across engine and pure-XLA runs."""
+    from horovod_tpu import metrics as _metrics
+    from horovod_tpu.engine import native
+
+    reg = registry if registry is not None else _metrics.registry()
+    stats = native.engine_stats() if native.available() else {}
+
+    def bridge(name, help_, key):
+        # bridged monotonic source: the raw atomic IS the running total
+        reg.counter(name, help_).labels().set_total(stats.get(key, 0))
+
+    bridge("hvt_engine_cycles_total",
+           "background engine cycle-loop iterations", "cycles")
+    bridge("hvt_engine_tensors_submitted_total",
+           "collectives submitted to the engine", "tensors_submitted")
+    bridge("hvt_engine_tensors_coordinated_total",
+           "tensor names executed via coordinated responses",
+           "tensors_coordinated")
+    bridge("hvt_cache_hits_total",
+           "response-cache hits (fast-path negotiations skipped)",
+           "cache_hits")
+    bridge("hvt_cache_misses_total",
+           "cache-eligible lookups that missed", "cache_misses")
+    bridge("hvt_fusion_buffer_bytes_total",
+           "payload bytes moved through the fusion buffer",
+           "fusion_bytes")
+    bridge("hvt_responses_fused_total",
+           "responses merged by tensor fusion (coordinator-side)",
+           "responses_fused")
+    bridge("hvt_engine_stalls_total",
+           "stall-inspector warnings (some ranks missing a tensor)",
+           "stall_events")
+
+    exec_s = reg.counter("hvt_engine_exec_seconds_total",
+                         "data-plane execution time by collective op",
+                         ("op",))
+    exec_n = reg.counter("hvt_engine_exec_total",
+                         "data-plane responses executed by collective op",
+                         ("op",))
+    ns = stats.get("exec_ns", {})
+    cnt = stats.get("exec_count", {})
+    for op in native.STATS_OPS:
+        exec_s.labels(op=op).set_total(ns.get(op, 0) / 1e9)
+        exec_n.labels(op=op).set_total(cnt.get(op, 0))
+
+    up = reg.gauge("hvt_engine_up",
+                   "1 when the C++ engine is initialized")
+    running = native.engine_running()
+    up.set(1 if running else 0)
+    reg.gauge("hvt_engine_size",
+              "engine world size (0 when not running)").set(
+                  native.engine_size() if running else 0)
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False,

@@ -167,4 +167,38 @@ int hvt_engine_flags() {
   return (e.cache_enabled() ? 1 : 0) | (e.prefer_flat() ? 2 : 0);
 }
 
+// Live engine stats block for the telemetry bridge
+// (horovod_tpu/metrics; polled by common/basics.py:poll_engine_stats).
+// Fixed layout, in slots:
+//   0 cycles                 4 cache_misses
+//   1 tensors_submitted      5 fusion_bytes
+//   2 tensors_coordinated    6 responses_fused (coordinator-side)
+//   3 cache_hits             7 stall_events
+//   8..14  exec_ns    per OpType (ALLREDUCE..BARRIER)
+//   15..21 exec_count per OpType
+// Returns the number of slots the engine knows about; fills at most
+// max_n. Callers sizing the buffer off the return value stay compatible
+// with a newer .so that appends fields.
+int hvt_engine_stats(long long* out, int max_n) {
+  const auto& s = Engine::Get().stats();
+  long long v[8 + 2 * hvt::kStatsOps] = {
+      s.cycles.load(std::memory_order_relaxed),
+      s.tensors_submitted.load(std::memory_order_relaxed),
+      s.tensors_coordinated.load(std::memory_order_relaxed),
+      s.cache_hits.load(std::memory_order_relaxed),
+      s.cache_misses.load(std::memory_order_relaxed),
+      s.fusion_bytes.load(std::memory_order_relaxed),
+      s.responses_fused.load(std::memory_order_relaxed),
+      s.stall_events.load(std::memory_order_relaxed),
+  };
+  for (int i = 0; i < hvt::kStatsOps; ++i) {
+    v[8 + i] = s.exec_ns[i].load(std::memory_order_relaxed);
+    v[8 + hvt::kStatsOps + i] =
+        s.exec_count[i].load(std::memory_order_relaxed);
+  }
+  const int n = 8 + 2 * hvt::kStatsOps;
+  for (int i = 0; i < n && i < max_n; ++i) out[i] = v[i];
+  return n;
+}
+
 }  // extern "C"

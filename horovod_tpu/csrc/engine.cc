@@ -130,6 +130,8 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   // mixes survivors with fresh workers, and the shm barrier words are
   // keyed to this sequence
   resp_seq_ = 0;
+  stats_.Reset();  // fresh telemetry per (re-)init — an elastic restart
+                   // starts a new scrape epoch on every rank
   cache_enabled_ = true;
   prefer_flat_ = false;
   tuned_cache_enabled_ = true;
@@ -193,6 +195,7 @@ void Engine::Shutdown() {
 
 int32_t Engine::Submit(EntryPtr entry) {
   if (!initialized_.load()) return -1;
+  stats_.tensors_submitted.fetch_add(1, std::memory_order_relaxed);
   int32_t h;
   {
     std::lock_guard<std::mutex> lk(handles_mu_);
@@ -275,6 +278,7 @@ void Engine::ThreadLoop() {
 }
 
 bool Engine::RunCycle() {
+  stats_.cycles.fetch_add(1, std::memory_order_relaxed);
   if (timeline_.active() && timeline_.mark_cycles())
     timeline_.CycleMark();
   // 1. drain submissions
@@ -329,14 +333,16 @@ bool Engine::RunCycle() {
     // params are fully rank-symmetric. allgather/alltoall rows vary per
     // call and per rank; grouped tensors renegotiate as an atomic unit;
     // process-set responses carry membership the cache does not key on.
-    int32_t pos = (cache_enabled_.load() &&
-                   e->op == OpType::ALLREDUCE && e->group_id < 0 &&
-                   e->members.empty())
-                      ? cache_.Lookup(r)
-                      : ResponseCache::kMiss;
+    bool cacheable = cache_enabled_.load() &&
+                     e->op == OpType::ALLREDUCE && e->group_id < 0 &&
+                     e->members.empty();
+    int32_t pos = cacheable ? cache_.Lookup(r) : ResponseCache::kMiss;
     if (pos >= 0 && !join_pending_) {
+      stats_.cache_hits.fetch_add(1, std::memory_order_relaxed);
       hit_positions.push_back(pos);
     } else {
+      if (cacheable)
+        stats_.cache_misses.fetch_add(1, std::memory_order_relaxed);
       if (pos == ResponseCache::kInvalid) {
         // params changed → the whole job must evict this entry before the
         // name can renegotiate (reference CacheCoordinator invalid bits)
@@ -414,12 +420,22 @@ bool Engine::RunCycle() {
 
   // 5. execute
   for (auto& resp : responses) {
-    bool trace = timeline_.active()
-        && resp.kind == Response::Kind::TENSOR;
+    bool tensor = resp.kind == Response::Kind::TENSOR;
+    bool trace = timeline_.active() && tensor;
     if (trace)
       for (auto& n : resp.names)
         timeline_.ExecuteStart(n, OpName(resp.op));
+    double exec_t0 = tensor ? NowSec() : 0;
     ExecuteResponse(resp, pending_);
+    if (tensor) {
+      int op_i = static_cast<int>(resp.op);
+      if (op_i >= 0 && op_i < kStatsOps) {
+        stats_.exec_ns[op_i].fetch_add(
+            static_cast<int64_t>((NowSec() - exec_t0) * 1e9),
+            std::memory_order_relaxed);
+        stats_.exec_count[op_i].fetch_add(1, std::memory_order_relaxed);
+      }
+    }
     if (trace)
       for (auto& n : resp.names) timeline_.ExecuteEnd(n);
   }
@@ -1017,6 +1033,7 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
                                   r.names.end());
         fused.back().numels.insert(fused.back().numels.end(),
                                    r.numels.begin(), r.numels.end());
+        stats_.responses_fused.fetch_add(1, std::memory_order_relaxed);
         continue;
       }
     }
@@ -1057,6 +1074,7 @@ void Engine::CheckStalls() {
           << "not by ranks [ " << missing.str() << "] for "
           << static_cast<long>(now - tc.first_seen_sec)
           << " s — possible stall (reference stall_inspector semantics)";
+      stats_.stall_events.fetch_add(1, std::memory_order_relaxed);
       stall_warned_[name] = true;
     }
   }
@@ -1212,8 +1230,13 @@ void Engine::ExecuteResponse(const Response& resp,
 
   const size_t el = DataTypeSize(resp.dtype);
   data_ops_++;  // one per TENSOR response = one data-plane collective
-  for (int64_t n : resp.numels)
+  stats_.tensors_coordinated.fetch_add(
+      static_cast<int64_t>(resp.names.size()), std::memory_order_relaxed);
+  for (int64_t n : resp.numels) {
     cycle_bytes_ += n * static_cast<int64_t>(el);
+    stats_.fusion_bytes.fetch_add(n * static_cast<int64_t>(el),
+                                  std::memory_order_relaxed);
+  }
   switch (resp.op) {
     case OpType::ALLREDUCE: {
       if (resp.reduce == ReduceKind::ADASUM) {

@@ -44,6 +44,38 @@
 
 namespace hvt {
 
+// Atomic engine stats block, polled live over the C API
+// (hvt_engine_stats → horovod_tpu/metrics registry). Writers are the
+// engine thread (plus Submit on client threads); readers poll from any
+// thread, so every field is a relaxed atomic — cheap enough to keep the
+// counters unconditionally on.
+constexpr int kStatsOps = 7;  // OpType 0..6 (common.h)
+
+struct EngineStats {
+  std::atomic<int64_t> cycles{0};               // RunCycle iterations
+  std::atomic<int64_t> tensors_submitted{0};    // client Submit() calls
+  std::atomic<int64_t> tensors_coordinated{0};  // names executed (TENSOR)
+  std::atomic<int64_t> cache_hits{0};           // response-cache hits
+  std::atomic<int64_t> cache_misses{0};         // cacheable lookups missed
+  std::atomic<int64_t> fusion_bytes{0};         // bytes through the
+                                                // fusion buffer
+  std::atomic<int64_t> responses_fused{0};      // responses merged by
+                                                // FuseResponses
+  std::atomic<int64_t> stall_events{0};         // stall-inspector warnings
+  std::atomic<int64_t> exec_ns[kStatsOps]{};    // per-OpType execution ns
+  std::atomic<int64_t> exec_count[kStatsOps]{};
+
+  void Reset() {
+    cycles = tensors_submitted = tensors_coordinated = 0;
+    cache_hits = cache_misses = 0;
+    fusion_bytes = responses_fused = stall_events = 0;
+    for (int i = 0; i < kStatsOps; ++i) {
+      exec_ns[i] = 0;
+      exec_count[i] = 0;
+    }
+  }
+};
+
 struct HandleState {
   bool done = false;
   Status status;
@@ -76,6 +108,7 @@ class Engine {
   // total data-plane collectives executed (one fused allreduce = one);
   // introspection for tests asserting fusion behavior
   int64_t data_ops() const { return data_ops_.load(); }
+  const EngineStats& stats() const { return stats_; }
 
   // Returns handle (>=0) or -1 when not initialized.
   int32_t Submit(EntryPtr entry);
@@ -183,6 +216,7 @@ class Engine {
   ParameterManager autotune_;     // rank 0 tunes; workers receive cycle_ms
   int64_t cycle_bytes_ = 0;       // payload bytes executed this cycle
   std::atomic<int64_t> data_ops_{0};
+  EngineStats stats_;             // live telemetry (hvt_engine_stats)
   EngineTimeline timeline_;       // rank-0 chrome trace (HVT_TIMELINE)
 
   std::vector<uint8_t> fusion_buffer_;

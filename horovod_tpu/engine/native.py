@@ -18,6 +18,7 @@ from __future__ import annotations
 import ctypes
 import os
 import threading
+import time
 
 import numpy as np
 
@@ -84,6 +85,9 @@ def _load():
             # introspection symbol; a stale .so without it must not break
             # the graceful-degrade contract of _load()
             lib.hvt_data_ops.restype = ctypes.c_longlong
+        if getattr(lib, "hvt_engine_stats", None) is not None:
+            lib.hvt_engine_stats.argtypes = [
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
         lib.hvt_result_read.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                         ctypes.c_longlong]
         lib.hvt_result_recv_splits.argtypes = [
@@ -136,6 +140,36 @@ def engine_data_ops() -> int:
     return int(lib.hvt_data_ops())
 
 
+# hvt_engine_stats fixed layout (c_api.cc): scalar slots, then per-op
+# exec_ns and exec_count arrays indexed by OpType wire id.
+STATS_SCALARS = ("cycles", "tensors_submitted", "tensors_coordinated",
+                 "cache_hits", "cache_misses", "fusion_bytes",
+                 "responses_fused", "stall_events")
+STATS_OPS = ("allreduce", "allgather", "broadcast", "alltoall",
+             "reducescatter", "join", "barrier")
+
+
+def engine_stats() -> dict:
+    """Snapshot of the engine's atomic stats block (zeros-when-absent is
+    the caller's concern — this returns {} when the library or symbol is
+    missing). Values are monotonic within one engine run; Init resets
+    them, starting a new scrape epoch."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_engine_stats", None) is None:
+        return {}
+    n_ops = len(STATS_OPS)
+    want = len(STATS_SCALARS) + 2 * n_ops
+    buf = (ctypes.c_longlong * want)()
+    n = min(int(lib.hvt_engine_stats(buf, want)), want)
+    vals = [int(buf[i]) for i in range(n)] + [0] * (want - n)
+    out = dict(zip(STATS_SCALARS, vals))
+    base = len(STATS_SCALARS)
+    out["exec_ns"] = dict(zip(STATS_OPS, vals[base:base + n_ops]))
+    out["exec_count"] = dict(
+        zip(STATS_OPS, vals[base + n_ops:base + 2 * n_ops]))
+    return out
+
+
 def engine_rank() -> int:
     return _lib.hvt_rank() if engine_running() else 0
 
@@ -151,12 +185,31 @@ def _np_dtype_id(arr: np.ndarray) -> int:
     return _DT[name]
 
 
+_submit_latency = None
+
+
+def _observe_submit_latency(op: str, seconds: float):
+    """Submit→completion latency of one engine collective, by op — the
+    engine-side half of the telemetry plane (the Python dispatch half
+    lives in ops/collective_ops.py)."""
+    global _submit_latency
+    if _submit_latency is None:
+        from horovod_tpu import metrics as _metrics
+
+        _submit_latency = _metrics.histogram(
+            "hvt_engine_submit_latency_seconds",
+            "engine collective latency from submit to completion",
+            ("op",))
+    _submit_latency.labels(op=op).observe(seconds)
+
+
 class NativeHandle:
     """Async handle over the C++ engine (reference handle_manager.h)."""
 
     def __init__(self, handle, op, arr, kind, trailing_shape, dtype,
                  orig_shape=None, n_participants=None):
         self._h = handle
+        self._t_submit = time.monotonic()
         self._op = op
         self._kind = kind
         self._trailing = trailing_shape
@@ -182,8 +235,6 @@ class NativeHandle:
             return self._result
         lib = _lib
         if timeout is not None:
-            import time
-
             deadline = time.monotonic() + timeout
             while not lib.hvt_poll(self._h):
                 if time.monotonic() > deadline:
@@ -239,6 +290,7 @@ class NativeHandle:
             self._result = (out, splits) if self._op == "alltoall" else out
         lib.hvt_release(self._h)
         self._finished = True
+        _observe_submit_latency(self._op, time.monotonic() - self._t_submit)
         return self._result
 
 

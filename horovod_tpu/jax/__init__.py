@@ -227,7 +227,8 @@ DistributedOptimizer = DistributedGradientTransformation
 from horovod_tpu.jax.callbacks import (  # noqa: E402,F401
     BroadcastGlobalVariablesCallback, Callback, CallbackList,
     LearningRateScheduleCallback, LearningRateWarmupCallback,
-    MetricAverageCallback, exponential_schedule, warmup_schedule)
+    MetricAverageCallback, MetricsCallback, exponential_schedule,
+    warmup_schedule)
 
 
 def __getattr__(name):

@@ -107,6 +107,38 @@ class MetricAverageCallback(Callback):
         return out
 
 
+class MetricsCallback(Callback):
+    """Fold training-loop metrics into the ``horovod_tpu.metrics``
+    registry so they ride the same scrape/snapshot plane as the engine
+    counters.
+
+    Every value in the epoch-end metrics dict becomes a sample of the
+    ``hvt_train_metric`` gauge (labeled by metric name); epochs are
+    counted in ``hvt_train_epochs_total``. Pair with
+    :class:`MetricAverageCallback` (ordered before this one) to publish
+    the cross-worker average instead of the local value. A Keras adapter
+    is exported as ``horovod_tpu.keras.MetricsCallback``."""
+
+    def __init__(self, registry=None, prefix: str = "hvt_train"):
+        from horovod_tpu import metrics as _metrics
+
+        reg = registry if registry is not None else _metrics.registry()
+        self._gauge = reg.gauge(
+            f"{prefix}_metric", "training metrics by name (last epoch)",
+            ("metric",))
+        self._epochs = reg.counter(f"{prefix}_epochs_total",
+                                   "training epochs completed")
+
+    def on_epoch_end(self, epoch, metrics=None):
+        self._epochs.inc()
+        for k, v in (metrics or {}).items():
+            try:
+                self._gauge.labels(metric=str(k)).set(float(v))
+            except (TypeError, ValueError):
+                continue  # non-numeric entries (e.g. strings) are skipped
+        return metrics
+
+
 class LearningRateScheduleCallback(Callback):
     """Piecewise/exponential LR schedule (reference
     ``LearningRateScheduleCallbackImpl:89``): from ``start_epoch`` until

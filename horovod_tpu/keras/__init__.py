@@ -68,6 +68,16 @@ def MetricAverageCallback():
     return _make_callback(_M())
 
 
+def MetricsCallback(registry=None, prefix="hvt_train"):
+    """Publish ``model.fit`` epoch metrics into the horovod_tpu metrics
+    registry (gauge ``hvt_train_metric{metric=...}`` + epoch counter) so
+    Keras training shows up on the same ``GET /metrics`` scrape plane as
+    the engine counters."""
+    from horovod_tpu.jax.callbacks import MetricsCallback as _M
+
+    return _make_callback(_M(registry=registry, prefix=prefix))
+
+
 def _make_lr_callback(jax_cb):
     """Adapt an hvt.jax LR-schedule callback: sets the model optimizer's
     learning rate at each epoch boundary (the reference's
@@ -194,6 +204,9 @@ def DistributedOptimizer(optimizer, *args, **kwargs):
     if not (_KERAS_AVAILABLE
             and isinstance(optimizer, _keras.optimizers.Optimizer)):
         return hvt_tf.DistributedOptimizer(optimizer, *args, **kwargs)
+    if getattr(optimizer, "_hvt_distributed", False):
+        # already wrapped — wrapping again would exchange gradients twice
+        return optimizer
 
     base = optimizer.__class__
 
@@ -222,8 +235,21 @@ def DistributedOptimizer(optimizer, *args, **kwargs):
 
     cls = type(base.__name__, (base,),
                {"apply_gradients": apply_gradients,
-                "_hvt_distributed": True})
-    return cls.from_config(optimizer.get_config())
+                "_hvt_distributed": True,
+                # Serialization transparency: Keras 3 records an
+                # optimizer's class by module+qualname. Pointing the
+                # dynamic subclass at the base class's identity makes
+                # model.save()/load_model round-trip to the plain
+                # optimizer (load_model then re-wraps); the subclass's
+                # own module path would not resolve at load time.
+                "__module__": base.__module__,
+                "__qualname__": base.__qualname__})
+    # Preserve the wrapped INSTANCE by swapping its class instead of
+    # rebuilding via cls.from_config(): a built optimizer's slot state
+    # (Adam m/v, iterations) lives in variables that from_config drops,
+    # so the rebuild silently reset momentum on load_model restores.
+    optimizer.__class__ = cls
+    return optimizer
 
 
 def broadcast_global_variables(root_rank=0, model=None, variables=None):

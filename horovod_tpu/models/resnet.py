@@ -55,6 +55,13 @@ class _SpaceToDepthStem(nn.Module):
     @nn.compact
     def __call__(self, x):
         b, h, w, c = x.shape
+        if h % 2 or w % 2:
+            raise ValueError(
+                f"conv0_space_to_depth requires even input height/width "
+                f"(the stem folds 2x2 pixel blocks into channels) but got "
+                f"{h}x{w}; pad the input to even dimensions or build the "
+                f"model with conv0_space_to_depth=False for the standard "
+                f"7x7/2 stem")
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
                             (7, 7, c, self.features), jnp.float32)
         # pixels: (B, H, W, C) -> (B, H/2, W/2, 2*2*C), block-major (a, b, c)

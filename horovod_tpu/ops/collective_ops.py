@@ -29,6 +29,8 @@ tests completion (``torch/mpi_ops.py:807``).
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -57,6 +59,78 @@ Product = ReduceOp("Product")
 
 def _is_traced(x) -> bool:
     return any(isinstance(l, jax.core.Tracer) for l in jax.tree.leaves(x))
+
+
+# --------------------------------------------------------------------------
+# dispatch telemetry (horovod_tpu.metrics)
+# --------------------------------------------------------------------------
+# Eager dispatches get a wall-clock latency histogram and a byte counter
+# per (op, process_set); traced dispatches are counted at TRACE time only
+# (the collective then lives inside the compiled program, invisible to
+# Python — per-execution device timing belongs to the XLA profiler).
+# Metric handles are cached so the per-call cost is a dict lookup + one
+# histogram observe (~1 µs; pinned by tests/test_metrics.py).
+
+_dispatch_metrics = None
+
+
+def _metric_handles():
+    global _dispatch_metrics
+    if _dispatch_metrics is None:
+        from horovod_tpu import metrics as _metrics
+
+        _dispatch_metrics = (
+            _metrics.histogram(
+                "hvt_collective_latency_seconds",
+                "eager collective wall-clock latency (dispatch to "
+                "completion)", ("op", "process_set")),
+            _metrics.counter(
+                "hvt_collective_bytes_total",
+                "payload bytes submitted to eager collectives",
+                ("op", "process_set")),
+            _metrics.counter(
+                "hvt_traced_collectives_total",
+                "collectives emitted into compiled XLA programs "
+                "(counted per trace, not per execution)", ("op",)),
+        )
+    return _dispatch_metrics
+
+
+def _ps_label(process_set) -> str:
+    ranks = getattr(process_set, "ranks", None) if process_set else None
+    if ranks is None:
+        return "global"
+    return ",".join(str(r) for r in sorted(int(r) for r in ranks))
+
+
+def _payload_bytes(tensor) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(tensor):
+        nb = getattr(leaf, "nbytes", None)
+        total += int(nb) if nb is not None else 0
+    return total
+
+
+def _count_traced(op_name: str):
+    try:
+        _metric_handles()[2].labels(op=op_name).inc()
+    except Exception:
+        pass  # telemetry must never break a dispatch
+
+
+def _timed_eager(op_name: str, process_set, tensor, fn):
+    """Run ``fn()`` (the eager submit+synchronize path) under the
+    dispatch histogram/byte counter."""
+    hist, bytes_total, _ = _metric_handles()
+    ps = _ps_label(process_set)
+    bytes_total.labels(op=op_name, process_set=ps).inc(
+        _payload_bytes(tensor))
+    t0 = time.monotonic()
+    try:
+        return fn()
+    finally:
+        hist.labels(op=op_name, process_set=ps).observe(
+            time.monotonic() - t0)
 
 
 def _resolve_op(op, average):
@@ -127,15 +201,19 @@ def allreduce(tensor, average=None, name=None, op=None,
     (pre/postscale handling at ``operations.cc:941-957``).
     """
     if _is_traced(tensor):
+        _count_traced("allreduce")
         return jax.tree.map(
             lambda t: _traced_allreduce(
                 t, _resolve_op(op, average), _axis_or_default(axis_name),
                 process_set, prescale_factor, postscale_factor),
             tensor)
-    return synchronize(allreduce_async(
-        tensor, average=average, name=name, op=op,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
-        process_set=process_set))
+    return _timed_eager(
+        "allreduce", process_set, tensor,
+        lambda: synchronize(allreduce_async(
+            tensor, average=average, name=name, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set)))
 
 
 def _grouped_reduce(t, op, axis, groups):
@@ -251,11 +329,14 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
                 for t in tensors]
     from horovod_tpu.engine import api as engine
 
-    h = engine.grouped_allreduce(tensors, op=_resolve_op(op, average),
-                                 name=name, prescale_factor=prescale_factor,
-                                 postscale_factor=postscale_factor,
-                                 process_set=process_set)
-    return synchronize(h)
+    def _run():
+        h = engine.grouped_allreduce(
+            tensors, op=_resolve_op(op, average), name=name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)
+        return synchronize(h)
+
+    return _timed_eager("grouped_allreduce", process_set, tensors, _run)
 
 
 def grouped_allreduce_async(tensors, average=None, name=None, op=None,
@@ -284,14 +365,17 @@ def allgather(tensor, name=None, process_set=global_process_set,
     Reference API: ``torch/mpi_ops.py:502``.
     """
     if _is_traced(tensor):
+        _count_traced("allgather")
         axis = _axis_or_default(axis_name)
         groups, _ = _equal_groups(process_set, axis, "allgather")
         return jax.tree.map(
             lambda t: lax.all_gather(t, axis, axis_index_groups=groups,
                                      tiled=True),
             tensor)
-    return synchronize(allgather_async(tensor, name=name,
-                                       process_set=process_set))
+    return _timed_eager(
+        "allgather", process_set, tensor,
+        lambda: synchronize(allgather_async(tensor, name=name,
+                                            process_set=process_set)))
 
 
 def allgather_async(tensor, name=None, process_set=global_process_set):
@@ -325,6 +409,7 @@ def broadcast(tensor, root_rank=0, name=None,
     Reference API: ``torch/mpi_ops.py:585`` / ``operations.cc:1060``.
     """
     if _is_traced(tensor):
+        _count_traced("broadcast")
         axis = _axis_or_default(axis_name)
         groups = _groups(process_set, axis)
 
@@ -335,8 +420,11 @@ def broadcast(tensor, root_rank=0, name=None,
             return lax.psum(masked, axis, axis_index_groups=groups)
 
         return jax.tree.map(_bcast, tensor)
-    return synchronize(broadcast_async(tensor, root_rank=root_rank,
-                                       name=name, process_set=process_set))
+    return _timed_eager(
+        "broadcast", process_set, tensor,
+        lambda: synchronize(broadcast_async(tensor, root_rank=root_rank,
+                                            name=name,
+                                            process_set=process_set)))
 
 
 def broadcast_async(tensor, root_rank=0, name=None,
@@ -368,6 +456,7 @@ def alltoall(tensor, splits=None, name=None,
                 "uneven alltoall splits are not representable in a "
                 "statically-shaped XLA program; pad to even splits or use "
                 "the eager path")
+        _count_traced("alltoall")
         axis = _axis_or_default(axis_name)
         groups, group_size = _equal_groups(process_set, axis, "alltoall")
 
@@ -380,8 +469,11 @@ def alltoall(tensor, splits=None, name=None,
                                   tiled=True, axis_index_groups=groups)
 
         return jax.tree.map(_a2a, tensor)
-    return synchronize(alltoall_async(tensor, splits=splits, name=name,
-                                      process_set=process_set))
+    return _timed_eager(
+        "alltoall", process_set, tensor,
+        lambda: synchronize(alltoall_async(tensor, splits=splits,
+                                           name=name,
+                                           process_set=process_set)))
 
 
 def alltoall_async(tensor, splits=None, name=None,
@@ -408,6 +500,7 @@ def reducescatter(tensor, op=None, name=None,
     """
     rop = op if op is not None else Average
     if _is_traced(tensor):
+        _count_traced("reducescatter")
         axis = _axis_or_default(axis_name)
         groups, group_size = _equal_groups(process_set, axis,
                                            "reducescatter")
@@ -433,9 +526,11 @@ def reducescatter(tensor, op=None, name=None,
         return jax.tree.map(_rs, tensor)
     from horovod_tpu.engine import api as engine
 
-    return synchronize(engine.reducescatter(
-        tensor, op=rop, name=name, prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor, process_set=process_set))
+    return _timed_eager(
+        "reducescatter", process_set, tensor,
+        lambda: synchronize(engine.reducescatter(
+            tensor, op=rop, name=name, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set)))
 
 
 def grouped_reducescatter(tensors, op=None, name=None,
@@ -461,14 +556,15 @@ def join(device=None) -> int:
     """
     from horovod_tpu.engine import api as engine
 
-    return engine.join()
+    return _timed_eager("join", None, None, engine.join)
 
 
 def barrier(process_set=global_process_set):
     """Block until all processes reach the barrier (engine control plane)."""
     from horovod_tpu.engine import api as engine
 
-    return engine.barrier(process_set=process_set)
+    return _timed_eager("barrier", process_set, None,
+                        lambda: engine.barrier(process_set=process_set))
 
 
 def synchronize(handle):

@@ -154,6 +154,15 @@ class HostManager:
         with self._lock:
             return self._is_blacklisted_locked(host, time.time())
 
+    def blacklisted_count(self) -> int:
+        """Hosts currently serving a blacklist sentence (expired cooldowns
+        are purged on the way) — feeds the hvt_elastic_blacklisted_hosts
+        telemetry gauge."""
+        with self._lock:
+            now = time.time()
+            return sum(1 for h in list(self._blacklist)
+                       if self._is_blacklisted_locked(h, now))
+
     def _is_blacklisted_locked(self, host: str, now: float) -> bool:
         until = self._blacklist.get(host)
         if until is None:

@@ -32,6 +32,26 @@ from horovod_tpu.runner.elastic.settings import ElasticSettings
 _NOTIFY_SCOPE = "workers"
 
 
+def _elastic_metrics():
+    """Driver-side telemetry (horovod_tpu.metrics): rendezvous rounds,
+    world size, alive/blacklisted hosts — the live form of what the
+    reference only logs (reference driver.py verbose prints)."""
+    from horovod_tpu import metrics
+
+    return (
+        metrics.counter("hvt_elastic_rounds_total",
+                        "elastic rendezvous rounds activated"),
+        metrics.counter("hvt_elastic_resets_total",
+                        "elastic restarts after the initial round"),
+        metrics.gauge("hvt_elastic_world_size",
+                      "slots assigned in the current round"),
+        metrics.gauge("hvt_elastic_alive_hosts",
+                      "distinct hosts in the current assignment"),
+        metrics.gauge("hvt_elastic_blacklisted_hosts",
+                      "hosts currently blacklisted by the host manager"),
+    )
+
+
 class ElasticDriver:
     def __init__(self, rendezvous, discovery, settings: ElasticSettings,
                  create_worker_fn: Optional[Callable] = None,
@@ -224,6 +244,16 @@ class ElasticDriver:
             # results are per-round: a rank that failed in a superseded
             # round must not make a successfully recovered job exit 1
             self._results = {}
+        try:
+            rounds, resets, world, alive, blacklisted = _elastic_metrics()
+            rounds.inc()
+            if rounds.value > 1:
+                resets.inc()
+            world.set(len(slots))
+            alive.set(len({s.hostname for s in slots}))
+            blacklisted.set(self._host_manager.blacklisted_count())
+        except Exception:
+            pass  # telemetry must never block a rendezvous round
         if self._create_worker_fn is not None:
             self._start_missing_workers()
 
@@ -287,6 +317,11 @@ class ElasticDriver:
                 changed = self._host_manager.update_available_hosts()
             except Exception:
                 changed = False
+            try:
+                _elastic_metrics()[4].set(
+                    self._host_manager.blacklisted_count())
+            except Exception:
+                pass
             if changed:
                 self._notify_workers_host_changes()
                 self._start_missing_workers_if_growing()

@@ -49,6 +49,8 @@ class RendezvousServer:
       GET     /keys/<scope>             — JSON list of keys
       GET     /rendezvous/<host>/<local_rank> — JSON SlotInfo
       GET     /world                    — JSON {size, hosts}
+      GET     /metrics                  — Prometheus text exposition
+      GET     /metrics.json             — JSON metrics snapshot
       DELETE  /rendezvous               — finalize round (elastic)
     """
 
@@ -169,6 +171,23 @@ class RendezvousServer:
                 elif parts == ["world"]:
                     self._send(200, json.dumps(world_ref._world).encode(),
                                "application/json")
+                elif parts in (["metrics"], ["metrics.json"]):
+                    # Prometheus scrape surface on the driver-side server
+                    # (horovod_tpu.metrics): the elastic driver's gauges
+                    # plus whatever the launcher process itself recorded.
+                    # Worker-side registries are served per worker via
+                    # hvtrun --metrics-port (metrics.serve).
+                    from horovod_tpu import metrics as _metrics
+
+                    if parts == ["metrics"]:
+                        self._send(200,
+                                   _metrics.prometheus_text().encode(),
+                                   _metrics.PROMETHEUS_CONTENT_TYPE)
+                    else:
+                        self._send(
+                            200,
+                            json.dumps(_metrics.json_snapshot()).encode(),
+                            "application/json")
                 else:
                     self._send(404)
 

@@ -53,6 +53,10 @@ def parse_args(argv=None):
     p.add_argument("--timeline", default=None,
                    help="chrome-trace timeline output path "
                         "(reference HOROVOD_TIMELINE)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus GET /metrics from every worker "
+                        "at this base port (worker rank r binds "
+                        "port+r); 0 binds ephemeral ports")
     p.add_argument("--stall-warning-sec", type=int, default=60,
                    help="stall inspector warning threshold")
     p.add_argument("--autotune", action="store_true",
@@ -143,6 +147,8 @@ def slot_env(base_env, slot, args, master_addr):
         env["HVT_COORDINATOR_ADDR"] = f"{master_addr}:{args.master_port}"
     if args.timeline:
         env["HVT_TIMELINE"] = args.timeline
+    if getattr(args, "metrics_port", None) is not None:
+        env["HVT_METRICS_PORT"] = str(args.metrics_port)
     if getattr(args, "autotune", False):
         env["HVT_AUTOTUNE"] = "1"
         if args.autotune_log_file:
@@ -297,6 +303,19 @@ def check_build(verbose: bool = False) -> int:
     engine = os.path.exists(engine_lib)
     tf_ops = os.path.exists(os.path.join(os.path.dirname(engine_lib),
                                          "libhvt_tf_ops.so"))
+    # the Keras wrapper gates on `import tensorflow.keras`
+    # (horovod_tpu/keras/__init__.py:_KERAS_AVAILABLE); probing the bare
+    # 'tensorflow' spec showed an X for TF builds whose keras shim is
+    # broken/absent, so probe the same module the wrapper imports
+    keras_ok = importable("tensorflow.keras")
+    engine_stats = False
+    if engine:
+        try:
+            from horovod_tpu.engine import native as _native
+
+            engine_stats = bool(_native.engine_stats())
+        except Exception:
+            engine_stats = False
     out = f"""\
 horovod_tpu v{__version__}:
 
@@ -305,7 +324,7 @@ Available Frameworks:
     [{mark(importable('tensorflow'))}] TensorFlow
     [{mark(importable('torch'))}] PyTorch
     [{mark(importable('mxnet'))}] MXNet (numpy bridge)
-    [{mark(importable('tensorflow'))}] Keras
+    [{mark(keras_ok)}] Keras
 
 Available Controllers:
     [{mark(engine)}] TCP control star (C++ engine)
@@ -316,7 +335,11 @@ Available Tensor Operations:
     [{mark(engine)}] shared-memory local plane
     [{mark(engine)}] TCP ring
     [{mark(engine)}] hierarchical (local RS -> cross AR -> local AG)
-    [{mark(tf_ops)}] TF native custom ops"""
+    [{mark(tf_ops)}] TF native custom ops
+
+Telemetry:
+    [X] Prometheus /metrics registry (hvtrun --metrics-port)
+    [{mark(engine_stats)}] engine stats bridge (hvt_engine_stats)"""
     print(out)
     if verbose:
         state = ("present" if engine

@@ -587,3 +587,18 @@ def test_param_partition_spec_gqa_tp_fallback():
     specs = param_partition_spec(params)
     assert specs["block_0"]["attn"]["k"]["kernel"] == P(None, "tp", None)
     del att
+
+
+def test_conv0_space_to_depth_odd_input_raises_clear_error():
+    """Odd H/W cannot fold 2x2 pixel blocks; the stem must raise a
+    ValueError naming conv0_space_to_depth, not an opaque reshape
+    error from deep inside XLA."""
+    from horovod_tpu.models.resnet import _SpaceToDepthStem
+
+    stem = _SpaceToDepthStem(features=16, dtype=jnp.float32)
+    x = jnp.zeros((1, 33, 32, 3), jnp.float32)
+    with pytest.raises(ValueError, match="conv0_space_to_depth.*33x32"):
+        stem.init(jax.random.PRNGKey(0), x)
+    with pytest.raises(ValueError, match="conv0_space_to_depth"):
+        stem.init(jax.random.PRNGKey(0),
+                  jnp.zeros((1, 32, 31, 3), jnp.float32))

@@ -463,3 +463,57 @@ def test_keras_best_model_checkpoint(tmp_path):
     assert tf.io.gfile.exists(path)
     with pytest.raises(ValueError, match="filepath"):
         hvt_keras.BestModelCheckpoint(monitor="loss")
+
+
+def test_keras_distributed_optimizer_preserves_built_slot_state():
+    """Wrapping a BUILT optimizer must keep the instance (and its slot
+    variables — Adam m/v, iterations) instead of rebuilding via
+    from_config, which silently reset momentum on load_model restores."""
+    import horovod_tpu.keras as hvt_keras
+
+    opt = tf.keras.optimizers.Adam(0.01)
+    model = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+    model.compile(optimizer=opt, loss="mse")
+    X = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    model.fit(X, y, epochs=1, verbose=0)  # builds + populates slots
+    before = [v.numpy().copy() for v in opt.variables]
+    assert int(opt.iterations.numpy()) > 0
+
+    wrapped = hvt_keras.DistributedOptimizer(opt)
+    assert wrapped is opt  # the instance survives (class swap, not copy)
+    assert getattr(wrapped, "_hvt_distributed", False)
+    after = [v.numpy() for v in wrapped.variables]
+    assert len(before) == len(after)
+    for a, b in zip(before, after):
+        np.testing.assert_allclose(a, b)
+    # double-wrapping must be a no-op, not a second exchange layer
+    assert hvt_keras.DistributedOptimizer(wrapped) is wrapped
+
+
+def test_keras_wrapped_optimizer_save_load_roundtrip(tmp_path):
+    """A model COMPILED with the wrapper round-trips through
+    model.save()/load_model: the dynamic subclass serializes under the
+    base optimizer's module/name, and slot state survives the reload."""
+    import horovod_tpu.keras as hvt_keras
+
+    model = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+    model.compile(
+        optimizer=hvt_keras.DistributedOptimizer(
+            tf.keras.optimizers.Adam(0.01)),
+        loss="mse")
+    X = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    y = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+    model.fit(X, y, epochs=1, verbose=0)
+    pre = [v.numpy().copy() for v in model.optimizer.variables]
+
+    path = str(tmp_path / "wrapped.keras")
+    model.save(path)  # failed pre-fix: unresolvable dynamic class
+    loaded = hvt_keras.load_model(path)
+    assert getattr(loaded.optimizer, "_hvt_distributed", False)
+    assert isinstance(loaded.optimizer, tf.keras.optimizers.Adam)
+    post = [v.numpy() for v in loaded.optimizer.variables]
+    assert len(pre) == len(post)
+    for a, b in zip(pre, post):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+    loaded.fit(X, y, epochs=1, verbose=0)  # retraining still works
