@@ -26,6 +26,22 @@ echo "=== [1/4] build C++ engine ==="
 make -C horovod_tpu/csrc -j
 make -C horovod_tpu/csrc tf_ops   # no-op when TF is not importable
 
+# Post-build link smoke check: the seed shipped a .so with an unresolved
+# shm_open that silently skipped every engine test until PR 1 (see
+# CHANGES.md NOTE). A dlopen via ctypes catches load-time breakage;
+# `ldd -r` catches lazily-bound undefined symbols dlopen won't touch.
+CORE_SO=horovod_tpu/csrc/build/libhvt_core.so
+python -c "import ctypes; ctypes.CDLL('$CORE_SO'); print('ctypes load OK')"
+if command -v ldd >/dev/null 2>&1; then
+  UNDEF=$(ldd -r "$CORE_SO" 2>&1 | grep -i "undefined symbol" || true)
+  if [[ -n "$UNDEF" ]]; then
+    echo "FATAL: undefined symbols in $CORE_SO:" >&2
+    echo "$UNDEF" >&2
+    exit 1
+  fi
+  echo "ldd -r OK (no undefined symbols)"
+fi
+
 echo "=== [2/4] test suite ==="
 if [[ "$FAST" == "1" ]]; then
   # quick subset: modules outside tests/conftest.py's known-slow list

@@ -32,6 +32,7 @@ from horovod_tpu.common.basics import (
     is_initialized,
     start_timeline,
     stop_timeline,
+    diagnostics,
     rank,
     size,
     local_rank,
@@ -119,6 +120,7 @@ def __getattr__(name):
 __all__ = [
     # lifecycle
     "init", "shutdown", "is_initialized", "start_timeline", "stop_timeline",
+    "diagnostics",
     # topology
     "rank", "size", "local_rank", "local_size", "cross_rank", "cross_size",
     "process_rank", "process_size", "is_homogeneous",

@@ -35,6 +35,7 @@ import threading
 _lock = threading.Lock()
 _initialized = False
 _started_jax_distributed = False
+_debugz_stop = None  # Event for the /debugz pusher thread, when running
 
 
 def _jax():
@@ -122,6 +123,47 @@ def init(comm=None, process_sets=None):
             if os.environ.get("HVT_VERBOSE"):
                 print(f"[hvt] metrics endpoint on :{bound}/metrics")
 
+        # Flight recorder (hvtrun --timeline → HVT_TIMELINE_SHARD): every
+        # worker records a per-rank chrome-trace shard, clock-aligned to
+        # the rendezvous server and uploaded there at teardown so the
+        # launcher can merge all ranks into one loadable trace.
+        shard_base = os.environ.get("HVT_TIMELINE_SHARD")
+        # HVT_DIAG_ADDR: the static launcher's KV server (--timeline);
+        # HVT_RENDEZVOUS_ADDR: the elastic rendezvous (same surface).
+        # The split exists because the latter is the "elastic launch"
+        # marker that elastic/run.py and preemption.py key off.
+        rdv_addr = (os.environ.get("HVT_DIAG_ADDR")
+                    or os.environ.get("HVT_RENDEZVOUS_ADDR"))
+        if shard_base:
+            from horovod_tpu.utils import timeline as _tl
+
+            my_rank = int(procid or 0)
+            if rdv_addr:
+                try:
+                    _tl.set_clock_offset_us(
+                        _tl.measure_clock_offset_us(rdv_addr))
+                except Exception:
+                    pass  # unaligned shards still merge, just skewed
+            # xla_profiler off: every gang member arming a PJRT session
+            # would fight over the one-session limit; opt back in with
+            # HVT_TIMELINE_XLA=1 via start_timeline on the rank you want
+            _tl.start(f"{shard_base}.rank{my_rank}",
+                      mark_cycles=os.environ.get(
+                          "HVT_TIMELINE_MARK_CYCLES", "0") != "0",
+                      xla_profiler=False, pid=my_rank,
+                      upload_addr=rdv_addr)
+
+        # Background /debugz reporter: periodically push this worker's
+        # diagnostics() snapshot to the rendezvous KV so the launcher's
+        # GET /debugz names stalled tensors without touching workers.
+        if rdv_addr:
+            global _debugz_stop
+            _debugz_stop = threading.Event()
+            threading.Thread(
+                target=_debugz_push_loop,
+                args=(rdv_addr, int(procid or 0), _debugz_stop),
+                daemon=True).start()
+
         # Materialize the device list once; this is the global communicator.
         from horovod_tpu.parallel import mesh as _mesh
 
@@ -143,13 +185,21 @@ def shutdown():
     Reference: ``horovod_shutdown`` (``operations.cc:728``) joins the
     background thread and finalizes pending tensors with SHUT_DOWN_ERROR.
     """
-    global _initialized, _started_jax_distributed
+    global _initialized, _started_jax_distributed, _debugz_stop
     with _lock:
         if not _initialized:
             return
+        if _debugz_stop is not None:
+            _debugz_stop.set()
+            _debugz_stop = None
         from horovod_tpu.engine import api as _engine_api
 
         _engine_api.shutdown_if_running()
+        # after the engine: its teardown records the final DONE/abort
+        # events, which the timeline's last drain must still capture
+        from horovod_tpu.utils import timeline as _tl
+
+        _tl.stop()
         if _started_jax_distributed:
             try:
                 _jax().distributed.shutdown()
@@ -403,6 +453,24 @@ def poll_engine_stats(registry=None):
               "engine world size (0 when not running)").set(
                   native.engine_size() if running else 0)
 
+    # stall details from the diagnostics snapshot: one series per
+    # stalled tensor, value = how many ranks are missing. Resolved
+    # stalls zero out (the series stays, so alerts see the recovery).
+    stall_g = reg.gauge(
+        "hvt_stall_missing_ranks",
+        "ranks that have not submitted a stalled tensor, by tensor",
+        ("tensor",))
+    try:
+        stalls = {s["tensor"]: len(s.get("missing_ranks", []))
+                  for s in (native.diagnostics() or {}).get("stalls", [])}
+    except Exception:
+        stalls = {}
+    for labels, child in stall_g.samples():
+        if labels.get("tensor") not in stalls:
+            child.set(0)
+    for tensor, n_missing in stalls.items():
+        stall_g.labels(tensor=tensor).set(n_missing)
+
 
 def start_timeline(file_path: str, mark_cycles: bool = False,
                    xla_profiler: bool = True):
@@ -424,3 +492,55 @@ def stop_timeline():
     from horovod_tpu.utils import timeline as _tl
 
     _tl.stop()
+
+
+def diagnostics() -> dict:
+    """Stall-diagnostics snapshot (the machine-readable face of the
+    reference's stall inspector, ``stall_inspector.h`` lineage).
+
+    Returns a JSON-serializable dict:
+
+    - ``engine``: running flag, rank/size, cycle count, client queue
+      depth, stall warn threshold, flight-recorder drop count;
+    - ``pending``: tensors submitted on THIS rank still awaiting
+      execution, with ages in seconds;
+    - ``negotiations`` (rank 0 only): the coordinator's arrival table —
+      per tensor, which ranks have announced it and which are missing,
+      plus how long it has been waiting;
+    - ``stalls``: the subset of negotiations past the warn threshold —
+      a deliberately stalled gang names the tensor and its missing
+      ranks here;
+    - ``timeline_active`` / ``process_rank``: local context.
+
+    Served remotely as ``GET /debugz`` on the rendezvous server, which
+    aggregates every worker's pushed snapshot."""
+    from horovod_tpu.engine import native
+    from horovod_tpu.utils import timeline as _tl
+
+    out = {"process_rank": int(os.environ.get("HVT_PROCESS_ID", "0")),
+           "timeline_active": _tl.active()}
+    try:
+        out.update(native.diagnostics() or
+                   {"engine": {"running": False}})
+    except Exception as e:
+        out["engine"] = {"running": False, "error": str(e)}
+    return out
+
+
+def _debugz_push_loop(addr: str, rank: int, stop: "threading.Event",
+                      period_sec: float = 5.0):
+    """PUT this worker's diagnostics to ``/kv/debugz/<rank>`` until
+    stopped — the worker-side half of ``GET /debugz``. Best-effort: a
+    dead rendezvous server must never disturb training."""
+    import json as _json
+
+    from horovod_tpu.runner.http_client import put_bytes
+
+    while True:
+        try:
+            put_bytes(addr, f"/kv/debugz/{rank}",
+                      _json.dumps(diagnostics()).encode(), timeout=3)
+        except Exception:
+            pass
+        if stop.wait(period_sec):
+            return

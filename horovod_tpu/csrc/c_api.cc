@@ -201,4 +201,37 @@ int hvt_engine_stats(long long* out, int max_n) {
   return n;
 }
 
+// ---- flight recorder (csrc/events.h) -------------------------------------
+
+// Drain up to max_n engine events into buf (an array of EventView — the
+// ctypes EngineEvent Structure mirrors the layout). Returns the number
+// written, oldest first. Safe to call whether or not the engine is
+// initialized; events survive Shutdown until drained or overwritten.
+int hvt_events_drain(void* buf, int max_n) {
+  if (!buf || max_n <= 0) return 0;
+  return Engine::Get().events().Drain(
+      static_cast<hvt::EventView*>(buf), max_n);
+}
+
+// Events overwritten before anyone drained them (ring capacity 8192).
+long long hvt_events_dropped() {
+  return static_cast<long long>(Engine::Get().events().dropped());
+}
+
+// JSON diagnostics snapshot: engine queue depth, pending tensors with
+// ages, and (on rank 0) the negotiation arrival table with per-tensor
+// missing-rank sets — the machine-readable face of the stall inspector.
+// Fills dst (NUL-terminated, truncated to max_n); returns the full
+// length, so callers can re-size and retry like hvt_error_message.
+int hvt_diagnostics(char* dst, int max_n) {
+  std::string s = Engine::Get().DiagnosticsJson();
+  int n = static_cast<int>(s.size());
+  if (dst && max_n > 0) {
+    int k = n < max_n - 1 ? n : max_n - 1;
+    memcpy(dst, s.data(), static_cast<size_t>(k));
+    dst[k] = '\0';
+  }
+  return n;
+}
+
 }  // extern "C"

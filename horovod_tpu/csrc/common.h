@@ -169,6 +169,8 @@ struct TensorTableEntry {
   // (ascending); empty → the global set. Mirrors the later-lineage
   // horovod ProcessSet on the eager path.
   std::vector<int64_t> members;
+  // stamped at Submit(); pending ages in the diagnostics snapshot
+  double submit_sec = 0;
 };
 
 using EntryPtr = std::shared_ptr<TensorTableEntry>;

@@ -196,6 +196,10 @@ void Engine::Shutdown() {
 int32_t Engine::Submit(EntryPtr entry) {
   if (!initialized_.load()) return -1;
   stats_.tensors_submitted.fetch_add(1, std::memory_order_relaxed);
+  entry->submit_sec = NowSec();
+  events_.Record(EventKind::ENQUEUED, entry->name,
+                 static_cast<int32_t>(entry->op), rank_,
+                 static_cast<int64_t>(entry->input.size()));
   int32_t h;
   {
     std::lock_guard<std::mutex> lk(handles_mu_);
@@ -236,6 +240,8 @@ void Engine::Release(int32_t handle) {
 }
 
 void Engine::CompleteEntry(const EntryPtr& e, const Status& s) {
+  events_.Record(EventKind::DONE, e->name, static_cast<int32_t>(e->op),
+                 static_cast<int32_t>(s.type), 0);
   std::lock_guard<std::mutex> lk(handles_mu_);
   auto it = handles_.find(e->handle);
   if (it == handles_.end()) return;
@@ -425,6 +431,15 @@ bool Engine::RunCycle() {
     if (trace)
       for (auto& n : resp.names)
         timeline_.ExecuteStart(n, OpName(resp.op));
+    if (tensor) {
+      int32_t op_w = static_cast<int32_t>(resp.op);
+      int64_t fused_n = static_cast<int64_t>(resp.names.size());
+      for (auto& n : resp.names) {
+        if (fused_n > 1)
+          events_.Record(EventKind::FUSED, n, op_w, rank_, fused_n);
+        events_.Record(EventKind::EXEC_BEGIN, n, op_w, rank_, 0);
+      }
+    }
     double exec_t0 = tensor ? NowSec() : 0;
     ExecuteResponse(resp, pending_);
     if (tensor) {
@@ -435,10 +450,16 @@ bool Engine::RunCycle() {
             std::memory_order_relaxed);
         stats_.exec_count[op_i].fetch_add(1, std::memory_order_relaxed);
       }
+      for (auto& n : resp.names)
+        events_.Record(EventKind::EXEC_END, n,
+                       static_cast<int32_t>(resp.op), rank_, 0);
     }
     if (trace)
       for (auto& n : resp.names) timeline_.ExecuteEnd(n);
   }
+  if (!responses.empty())
+    events_.Record(EventKind::CYCLE, "", -1,
+                   static_cast<int32_t>(responses.size()), 0);
 
   // feed the autotuner with this cycle's throughput (rank 0 tunes;
   // reference operations.cc:610-642 feeds the ParameterManager the same
@@ -460,6 +481,7 @@ bool Engine::RunCycle() {
   cycle_bytes_ = 0;
 
   if (rank_ == 0) CheckStalls();
+  UpdateDiag();
 
   if (resp_flags & 1) {
     // coordinated shutdown: drain anything left as errors
@@ -524,6 +546,11 @@ bool Engine::RegisterArrival(const std::string& key, int r, Request q,
     if (tc.count == 0) timeline_.NegotiateStart(q.name, OpName(q.op));
     timeline_.NegotiateRankReady(q.name, r);
   }
+  if (tc.count == 0)
+    events_.Record(EventKind::NEGOTIATE_BEGIN, q.name,
+                   static_cast<int32_t>(q.op), r, 0);
+  events_.Record(EventKind::RANK_READY, q.name,
+                 static_cast<int32_t>(q.op), r, 0);
   tc.requests.push_back(std::move(q));
   tc.count++;
   return true;
@@ -810,6 +837,8 @@ std::vector<Response> Engine::Coordinate(
   for (auto& name : complete) {
     auto& tc = counts_[name];
     if (timeline_.active()) timeline_.NegotiateEnd(tc.requests[0].name);
+    events_.Record(EventKind::NEGOTIATE_END, tc.requests[0].name,
+                   static_cast<int32_t>(tc.requests[0].op), tc.count, 0);
     Response resp = BuildResponse(tc.requests);
     int32_t gid = tc.requests[0].group_id;
     int32_t gsize = tc.requests[0].group_size;
@@ -1065,9 +1094,13 @@ void Engine::CheckStalls() {
         return false;
       };
       std::ostringstream missing;
+      int64_t missing_mask = 0;  // ranks >= 64 appear only in the
+                                 // diagnostics JSON, not the event mask
       for (int r = 0; r < size_; ++r)
-        if (!tc.seen[r] && !rank_joined_[r] && expected(r))
+        if (!tc.seen[r] && !rank_joined_[r] && expected(r)) {
           missing << r << " ";
+          if (r < 64) missing_mask |= int64_t{1} << r;
+        }
       HVT_LOG(WARNING, rank_)
           << "tensor '" << tc.requests[0].name
           << "' was submitted by some ranks but "
@@ -1075,9 +1108,140 @@ void Engine::CheckStalls() {
           << static_cast<long>(now - tc.first_seen_sec)
           << " s — possible stall (reference stall_inspector semantics)";
       stats_.stall_events.fetch_add(1, std::memory_order_relaxed);
+      events_.Record(
+          EventKind::STALL, tc.requests[0].name,
+          static_cast<int32_t>(tc.requests[0].op),
+          static_cast<int32_t>(now - tc.first_seen_sec), missing_mask);
       stall_warned_[name] = true;
     }
   }
+}
+
+// Snapshot engine-thread state for client-thread diagnostics readers.
+// Throttled to ~10 Hz: the copy is O(pending + negotiations × size)
+// string work, which must not tax the 2 ms cycle loop of a large gang
+// that nobody is scraping; 100 ms staleness is invisible to the 5 s
+// debugz push loop and to human-driven hvt.diagnostics() polling.
+void Engine::UpdateDiag() {
+  double now = NowSec();
+  {
+    std::lock_guard<std::mutex> lk(diag_mu_);
+    if (diag_.valid && now - diag_.updated_sec < 0.1) return;
+  }
+  DiagState d;
+  d.valid = true;
+  d.cycles = stats_.cycles.load(std::memory_order_relaxed);
+  {
+    std::lock_guard<std::mutex> lk(queue_mu_);
+    d.queue_depth = static_cast<int>(submitted_.size());
+  }
+  for (auto& [name, e] : pending_)
+    d.pending.emplace_back(name, e->submit_sec > 0
+                                     ? now - e->submit_sec
+                                     : 0.0);
+  if (rank_ == 0) {
+    for (auto& [key, tc] : counts_) {
+      if (tc.requests.empty()) continue;
+      DiagNegotiation n;
+      n.name = tc.requests[0].name;
+      n.op = tc.requests[0].op;
+      n.waiting_sec = tc.first_seen_sec > 0 ? now - tc.first_seen_sec : 0;
+      const auto& mem = tc.requests[0].members;
+      auto expected = [&](int r) {
+        if (mem.empty()) return true;
+        for (auto mr : mem)
+          if (mr == r) return true;
+        return false;
+      };
+      for (int r = 0; r < size_; ++r) {
+        if (!expected(r) || rank_joined_[r]) continue;
+        bool seen = r < static_cast<int>(tc.seen.size()) && tc.seen[r];
+        (seen ? n.arrived : n.missing).push_back(r);
+      }
+      d.negotiations.push_back(std::move(n));
+    }
+  }
+  d.stall_warn_sec = stall_warn_sec_;
+  d.updated_sec = now;
+  std::lock_guard<std::mutex> lk(diag_mu_);
+  diag_ = std::move(d);
+}
+
+static void JsonAppendEscaped(std::string& out, const std::string& s) {
+  for (char c : s) {
+    if (c == '"' || c == '\\') {
+      out += '\\';
+      out += c;
+    } else if (static_cast<unsigned char>(c) < 0x20) {
+      char buf[8];
+      snprintf(buf, sizeof(buf), "\\u%04x", c);
+      out += buf;
+    } else {
+      out += c;
+    }
+  }
+}
+
+static void JsonAppendRanks(std::string& out, const std::vector<int>& v) {
+  out += '[';
+  for (size_t i = 0; i < v.size(); ++i) {
+    if (i) out += ',';
+    out += std::to_string(v[i]);
+  }
+  out += ']';
+}
+
+std::string Engine::DiagnosticsJson() {
+  DiagState d;
+  {
+    std::lock_guard<std::mutex> lk(diag_mu_);
+    d = diag_;
+  }
+  bool running = initialized_.load();
+  char num[64];
+  std::string out = "{\"engine\":{\"running\":";
+  out += running ? "true" : "false";
+  out += ",\"rank\":" + std::to_string(rank_);
+  out += ",\"size\":" + std::to_string(size_);
+  out += ",\"cycles\":" + std::to_string(d.cycles);
+  out += ",\"queue_depth\":" + std::to_string(d.queue_depth);
+  snprintf(num, sizeof(num), "%.3f", d.stall_warn_sec);
+  out += std::string(",\"stall_warn_sec\":") + num;
+  out += ",\"events_dropped\":" + std::to_string(events_.dropped());
+  out += "},\"pending\":[";
+  for (size_t i = 0; i < d.pending.size(); ++i) {
+    if (i) out += ',';
+    out += "{\"tensor\":\"";
+    JsonAppendEscaped(out, d.pending[i].first);
+    snprintf(num, sizeof(num), "%.3f", d.pending[i].second);
+    out += std::string("\",\"age_sec\":") + num + "}";
+  }
+  out += "],\"negotiations\":[";
+  // stalls = negotiations past the warn threshold; emitted as a separate
+  // array so callers don't re-derive the policy
+  std::string stalls;
+  for (size_t i = 0; i < d.negotiations.size(); ++i) {
+    const auto& n = d.negotiations[i];
+    std::string entry = "{\"tensor\":\"";
+    JsonAppendEscaped(entry, n.name);
+    entry += "\",\"op\":\"";
+    entry += OpName(n.op);
+    snprintf(num, sizeof(num), "%.3f", n.waiting_sec);
+    entry += std::string("\",\"waiting_sec\":") + num;
+    entry += ",\"arrived_ranks\":";
+    JsonAppendRanks(entry, n.arrived);
+    entry += ",\"missing_ranks\":";
+    JsonAppendRanks(entry, n.missing);
+    entry += "}";
+    if (i) out += ',';
+    out += entry;
+    if (!n.missing.empty() && n.waiting_sec > d.stall_warn_sec) {
+      if (!stalls.empty()) stalls += ',';
+      stalls += entry;
+    }
+  }
+  out += "],\"stalls\":[" + stalls + "]}";
+  return out;
 }
 
 // --------------------------------------------------------------------------

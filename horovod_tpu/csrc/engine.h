@@ -37,6 +37,7 @@
 #include "backends.h"
 #include "cache.h"
 #include "common.h"
+#include "events.h"
 #include "net.h"
 #include "ring_ops.h"
 #include "timeline.h"
@@ -84,6 +85,29 @@ struct HandleState {
   int32_t join_result = -1;
 };
 
+// Diagnostics snapshot — refreshed by the engine thread once per cycle
+// under diag_mu_, read by DiagnosticsJson() from any client thread
+// (hvt_diagnostics → hvt.diagnostics() / GET /debugz). A snapshot
+// rather than direct reads because pending_/counts_ are engine-thread-
+// only state; the copy is a handful of small strings per cycle.
+struct DiagNegotiation {
+  std::string name;
+  OpType op = OpType::ALLREDUCE;
+  double waiting_sec = 0;
+  std::vector<int> arrived;
+  std::vector<int> missing;
+};
+
+struct DiagState {
+  bool valid = false;
+  int64_t cycles = 0;
+  int queue_depth = 0;           // undrained client submissions
+  std::vector<std::pair<std::string, double>> pending;  // name, age sec
+  std::vector<DiagNegotiation> negotiations;  // rank 0 only
+  double stall_warn_sec = 60.0;
+  double updated_sec = 0;
+};
+
 class Engine {
  public:
   static Engine& Get();
@@ -109,6 +133,9 @@ class Engine {
   // introspection for tests asserting fusion behavior
   int64_t data_ops() const { return data_ops_.load(); }
   const EngineStats& stats() const { return stats_; }
+  EventRing& events() { return events_; }
+  // JSON stall/queue snapshot for hvt_diagnostics (thread-safe).
+  std::string DiagnosticsJson();
 
   // Returns handle (>=0) or -1 when not initialized.
   int32_t Submit(EntryPtr entry);
@@ -139,6 +166,7 @@ class Engine {
   Response BuildResponse(const std::vector<Request>& reqs);
   void FuseResponses(std::vector<Response>& responses);
   void CheckStalls();
+  void UpdateDiag();
   void HitToArrival(int rank, int64_t pos, double now_sec);
   bool RegisterArrival(const std::string& key, int rank, Request q,
                        double now_sec);
@@ -218,6 +246,9 @@ class Engine {
   std::atomic<int64_t> data_ops_{0};
   EngineStats stats_;             // live telemetry (hvt_engine_stats)
   EngineTimeline timeline_;       // rank-0 chrome trace (HVT_TIMELINE)
+  EventRing events_;              // flight recorder (hvt_events_drain)
+  std::mutex diag_mu_;
+  DiagState diag_;                // see DiagState docs above
 
   std::vector<uint8_t> fusion_buffer_;
 };

@@ -1,0 +1,162 @@
+// Engine flight recorder — a fixed-size lock-free event ring recording
+// the per-tensor lifecycle from inside the engine (ENQUEUED on the
+// submitting thread; NEGOTIATE / RANK_READY / FUSED / EXEC / DONE /
+// CYCLE / STALL on the engine thread), drained over the C API
+// (hvt_events_drain) by the Python timeline's drainer thread
+// (horovod_tpu/utils/timeline.py) into per-rank chrome-trace shards.
+//
+// Unlike the EngineTimeline (timeline.h), which formats JSON and writes
+// a file on rank 0 only, the ring is raw, always-on, and per-rank: the
+// reference's stall inspector and timeline are post-hoc / coordinator
+// surfaces, while pod-scale profiling work (arXiv:1909.09756) needs
+// every rank's engine-thread view merged into one clock-aligned trace.
+//
+// Concurrency: multi-producer (engine thread + any submitting client
+// thread), single consumer (the Python drainer; a mutex serializes
+// accidental concurrent drains). Producers claim a slot with a relaxed
+// fetch_add on the head cursor, write the payload, then publish the
+// slot's sequence with a release store. The consumer validates the
+// sequence before AND after copying the payload (per-slot seqlock), so
+// a producer lapping the ring mid-copy yields a counted drop, never a
+// torn record. Record() is wait-free; an idle ring costs nothing.
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace hvt {
+
+// Wire ids — part of the C ABI (EVENT_KINDS in engine/native.py).
+enum class EventKind : int32_t {
+  ENQUEUED = 0,         // Submit() accepted the entry (client thread)
+  NEGOTIATE_BEGIN = 1,  // first rank announced (coordinator)
+  NEGOTIATE_END = 2,    // all required ranks announced (coordinator)
+  RANK_READY = 3,       // rank `arg` announced (coordinator)
+  FUSED = 4,            // executed as part of an `arg2`-tensor fused unit
+  EXEC_BEGIN = 5,       // data-plane execution started (engine thread)
+  EXEC_END = 6,         // data-plane execution finished
+  DONE = 7,             // handle completed; arg = StatusType
+  CYCLE = 8,            // a cycle that executed `arg` responses
+  STALL = 9,            // stall inspector fired; arg = seconds waiting,
+                        // arg2 = missing-rank bitmask (ranks < 64)
+};
+
+// POD view of one event — mirrored field-for-field by the ctypes
+// Structure EngineEvent in engine/native.py. 96 bytes, naturally
+// aligned; changing the layout is an ABI break.
+struct EventView {
+  int64_t ts_us;   // CLOCK_REALTIME microseconds (same epoch the Python
+                   // timeline stamps with, so shards merge without a
+                   // per-source offset)
+  int64_t arg2;
+  int32_t kind;
+  int32_t op;      // OpType wire id, -1 when not applicable
+  int32_t arg;
+  int32_t pad;
+  char name[64];   // tensor name, NUL-terminated, truncated to fit
+};
+static_assert(sizeof(EventView) == 96, "EventView is part of the C ABI");
+
+class EventRing {
+ public:
+  static constexpr uint64_t kCapacity = 8192;  // power of two
+
+  void Record(EventKind kind, const std::string& name, int32_t op,
+              int32_t arg, int64_t arg2) {
+    uint64_t idx = head_.fetch_add(1, std::memory_order_relaxed);
+    Slot& s = slots_[idx & (kCapacity - 1)];
+    // invalidate while writing so a concurrent reader can't accept a
+    // half-written payload under the OLD (lapped) sequence
+    s.seq.store(0, std::memory_order_release);
+    s.view.ts_us = NowEpochUs();
+    s.view.arg2 = arg2;
+    s.view.kind = static_cast<int32_t>(kind);
+    s.view.op = op;
+    s.view.arg = arg;
+    s.view.pad = 0;
+    size_t n = name.size() < sizeof(s.view.name) - 1
+                   ? name.size()
+                   : sizeof(s.view.name) - 1;
+    memcpy(s.view.name, name.data(), n);
+    s.view.name[n] = '\0';
+    s.seq.store(idx + 1, std::memory_order_release);
+  }
+
+  // Copies up to max_n published events into out, oldest first; returns
+  // the number copied. Events overwritten before they were drained are
+  // skipped and counted in dropped().
+  int Drain(EventView* out, int max_n) {
+    std::lock_guard<std::mutex> lk(drain_mu_);
+    int n = 0;
+    while (n < max_n) {
+      uint64_t want = tail_ + 1;
+      Slot& s = slots_[tail_ & (kCapacity - 1)];
+      uint64_t seq = s.seq.load(std::memory_order_acquire);
+      if (seq < want) {
+        if (seq == 0 && head_.load(std::memory_order_relaxed) > tail_ &&
+            head_.load(std::memory_order_relaxed) - tail_ > kCapacity) {
+          // slot is mid-overwrite by a producer a full lap ahead
+          SkipToWindow();
+          continue;
+        }
+        break;  // caught up (or the next slot is still being written)
+      }
+      if (seq > want) {  // lapped: this slot now holds a newer event
+        SkipToWindow();
+        continue;
+      }
+      out[n] = s.view;
+      // re-check: a producer may have lapped us mid-copy
+      if (s.seq.load(std::memory_order_acquire) != want) {
+        SkipToWindow();
+        continue;
+      }
+      ++tail_;
+      ++n;
+    }
+    return n;
+  }
+
+  int64_t dropped() const {
+    return dropped_.load(std::memory_order_relaxed);
+  }
+
+  static int64_t NowEpochUs() {
+    return std::chrono::duration_cast<std::chrono::microseconds>(
+               std::chrono::system_clock::now().time_since_epoch())
+        .count();
+  }
+
+ private:
+  struct Slot {
+    std::atomic<uint64_t> seq{0};
+    EventView view{};
+  };
+
+  // Jump the read cursor to the oldest slot that can still be intact,
+  // counting everything skipped as dropped.
+  void SkipToWindow() {
+    uint64_t head = head_.load(std::memory_order_relaxed);
+    uint64_t oldest = head > kCapacity ? head - kCapacity : 0;
+    // one extra slot of slack: the slot at `oldest` may be the one a
+    // producer is overwriting right now
+    ++oldest;
+    if (oldest > tail_) {
+      dropped_.fetch_add(static_cast<int64_t>(oldest - tail_),
+                         std::memory_order_relaxed);
+      tail_ = oldest;
+    }
+  }
+
+  Slot slots_[kCapacity];
+  std::atomic<uint64_t> head_{0};
+  uint64_t tail_ = 0;  // guarded by drain_mu_
+  std::atomic<int64_t> dropped_{0};
+  std::mutex drain_mu_;
+};
+
+}  // namespace hvt

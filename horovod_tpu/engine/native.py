@@ -88,6 +88,13 @@ def _load():
         if getattr(lib, "hvt_engine_stats", None) is not None:
             lib.hvt_engine_stats.argtypes = [
                 ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
+        if getattr(lib, "hvt_events_drain", None) is not None:
+            # flight recorder (csrc/events.h); absent in a stale .so —
+            # the graceful-degrade contract of _load() covers it
+            lib.hvt_events_drain.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int]
+            lib.hvt_events_dropped.restype = ctypes.c_longlong
+            lib.hvt_diagnostics.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.hvt_result_read.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                         ctypes.c_longlong]
         lib.hvt_result_recv_splits.argtypes = [
@@ -170,6 +177,93 @@ def engine_stats() -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# flight recorder bridge (csrc/events.h → utils/timeline.py drainer)
+# ---------------------------------------------------------------------------
+
+class EngineEvent(ctypes.Structure):
+    """Mirror of ``hvt::EventView`` (csrc/events.h) — 96 bytes, part of
+    the C ABI of ``hvt_events_drain``."""
+
+    _fields_ = [("ts_us", ctypes.c_longlong),
+                ("arg2", ctypes.c_longlong),
+                ("kind", ctypes.c_int),
+                ("op", ctypes.c_int),
+                ("arg", ctypes.c_int),
+                ("pad", ctypes.c_int),
+                ("name", ctypes.c_char * 64)]
+
+
+assert ctypes.sizeof(EngineEvent) == 96, "EngineEvent ABI drift"
+
+# index == wire id (csrc/events.h EventKind)
+EVENT_KINDS = ("ENQUEUED", "NEGOTIATE_BEGIN", "NEGOTIATE_END",
+               "RANK_READY", "FUSED", "EXEC_BEGIN", "EXEC_END", "DONE",
+               "CYCLE", "STALL")
+
+
+def events_supported() -> bool:
+    lib = _load()
+    return lib is not None and \
+        getattr(lib, "hvt_events_drain", None) is not None
+
+
+def drain_events(max_events: int = 4096) -> list:
+    """Drain the engine's event ring, oldest first, as dicts with
+    ``kind``/``kind_name``/``op_name``/``ts_us`` (epoch µs)/``name``/
+    ``arg``/``arg2``. Safe whether or not the engine is initialized."""
+    if not events_supported():
+        return []
+    buf = (EngineEvent * max_events)()
+    n = int(_lib.hvt_events_drain(buf, max_events))
+    out = []
+    for i in range(n):
+        e = buf[i]
+        kind = int(e.kind)
+        op = int(e.op)
+        out.append({
+            "ts_us": int(e.ts_us),
+            "kind": kind,
+            "kind_name": (EVENT_KINDS[kind]
+                          if 0 <= kind < len(EVENT_KINDS) else "?"),
+            "op": op,
+            "op_name": (STATS_OPS[op].upper()
+                        if 0 <= op < len(STATS_OPS) else ""),
+            "name": e.name.decode(errors="replace"),
+            "arg": int(e.arg),
+            "arg2": int(e.arg2),
+        })
+    return out
+
+
+def events_dropped() -> int:
+    """Events overwritten in the ring before anyone drained them."""
+    if not events_supported():
+        return 0
+    return int(_lib.hvt_events_dropped())
+
+
+def diagnostics() -> dict:
+    """The engine's JSON diagnostics snapshot (``hvt_diagnostics``):
+    queue depth, pending tensors with ages, and — on rank 0 — the
+    negotiation arrival table with per-tensor missing-rank sets plus the
+    ``stalls`` subset past the warn threshold. ``{}`` when the library
+    or symbol is absent."""
+    import json as _json
+
+    if not events_supported():
+        return {}
+    buf = ctypes.create_string_buffer(65536)
+    n = int(_lib.hvt_diagnostics(buf, len(buf)))
+    if n >= len(buf):  # resize to the advertised full length and retry
+        buf = ctypes.create_string_buffer(n + 1)
+        _lib.hvt_diagnostics(buf, len(buf))
+    try:
+        return _json.loads(buf.value.decode(errors="replace"))
+    except Exception:
+        return {}
+
+
 def engine_rank() -> int:
     return _lib.hvt_rank() if engine_running() else 0
 
@@ -222,6 +316,8 @@ class NativeHandle:
         self._result = None
         self._error = None
         self._finished = False
+        self._name = None       # set by submit() when a timeline is live
+        self._traced = False
 
     def done(self) -> bool:
         if self._finished:
@@ -248,6 +344,7 @@ class NativeHandle:
             msg = buf.value.decode(errors="replace")
             lib.hvt_release(self._h)
             self._finished = True
+            self._trace_end()
             # ABORTED (engine/peer failure) → HorovodInternalError so the
             # elastic wrapper can catch and recover; PRECONDITION (cross-
             # rank mismatch) → ValueError matching the reference's
@@ -290,8 +387,17 @@ class NativeHandle:
             self._result = (out, splits) if self._op == "alltoall" else out
         lib.hvt_release(self._h)
         self._finished = True
+        self._trace_end()
         _observe_submit_latency(self._op, time.monotonic() - self._t_submit)
         return self._result
+
+    def _trace_end(self):
+        if not self._traced:
+            return
+        self._traced = False
+        from horovod_tpu.utils import timeline as _timeline
+
+        _timeline.activity_end(self._name)
 
 
 def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
@@ -352,6 +458,16 @@ def submit(op, arr, kind, name=None, op_kind="sum", root_rank=0,
     if h < 0:
         raise HorovodInternalError("hvt engine rejected submission "
                                    "(not initialized)")
-    return NativeHandle(h, op, arr, kind, tuple(arr.shape[1:]), dtype,
-                        orig_shape=orig_shape,
-                        n_participants=len(members) or None)
+    handle = NativeHandle(h, op, arr, kind, tuple(arr.shape[1:]), dtype,
+                          orig_shape=orig_shape,
+                          n_participants=len(members) or None)
+    # dispatch-side timeline lane (B here, E at wait completion): the
+    # Python half of the per-tensor lifecycle, merged in the same shard
+    # as the engine-thread "(engine)" lane events
+    from horovod_tpu.utils import timeline as _timeline
+
+    if _timeline.active():
+        handle._name = name
+        handle._traced = True
+        _timeline.activity_start(name, f"EAGER_{op.upper()}")
+    return handle

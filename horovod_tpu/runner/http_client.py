@@ -21,3 +21,12 @@ def get_json(addr, path, timeout=5):
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         body = resp.read()
         return json.loads(body) if body else None
+
+
+def put_bytes(addr, path, data: bytes, timeout=15):
+    """Raw-bytes PUT (timeline shard upload: the shards are pre-encoded
+    JSON files, re-encoding them via put_json would double the memory)."""
+    req = urllib.request.Request(f"http://{addr}{path}", data=data,
+                                 method="PUT")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status

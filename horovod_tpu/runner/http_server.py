@@ -51,6 +51,13 @@ class RendezvousServer:
       GET     /world                    — JSON {size, hosts}
       GET     /metrics                  — Prometheus text exposition
       GET     /metrics.json             — JSON metrics snapshot
+      GET     /clock                    — server wall clock (epoch µs);
+                                          the timeline clock-offset
+                                          handshake samples this
+      GET     /debugz                   — stall-diagnostics snapshot:
+                                          world info + every worker's
+                                          last hvt.diagnostics() report
+                                          (pushed to /kv/debugz/<rank>)
       DELETE  /rendezvous               — finalize round (elastic)
     """
 
@@ -79,7 +86,9 @@ class RendezvousServer:
         once, at first state init. Each init bumps ``round`` so workers
         re-rendezvousing can tell fresh slot info from the previous
         round's."""
-        self._store.clear(keep_scopes=("workers",))
+        # timeline/debugz survive re-rendezvous: shards from workers
+        # torn down in round N must still be mergeable at job end
+        self._store.clear(keep_scopes=("workers", "timeline", "debugz"))
         self._round += 1
         self._slots = {
             f"{s.hostname}/{s.local_rank}": {
@@ -171,6 +180,30 @@ class RendezvousServer:
                 elif parts == ["world"]:
                     self._send(200, json.dumps(world_ref._world).encode(),
                                "application/json")
+                elif parts == ["clock"]:
+                    import time
+
+                    self._send(200, json.dumps(
+                        {"epoch_us": time.time_ns() // 1000}).encode(),
+                        "application/json")
+                elif parts == ["debugz"]:
+                    # stall-diagnostics endpoint: aggregate the per-rank
+                    # hvt.diagnostics() snapshots workers push to
+                    # /kv/debugz/<rank> (see common/basics.py _DebugzPusher)
+                    ranks = {}
+                    for key in store.keys("debugz"):
+                        v = store.get("debugz", key)
+                        try:
+                            ranks[key] = json.loads(v)
+                        except Exception:
+                            ranks[key] = {"error": "unparseable report"}
+                    body = {"world": world_ref._world,
+                            "round": server_ref._round,
+                            "timeline_shards":
+                                sorted(store.keys("timeline")),
+                            "ranks": ranks}
+                    self._send(200, json.dumps(body).encode(),
+                               "application/json")
                 elif parts in (["metrics"], ["metrics.json"]):
                     # Prometheus scrape surface on the driver-side server
                     # (horovod_tpu.metrics): the elastic driver's gauges
@@ -193,7 +226,8 @@ class RendezvousServer:
 
             def do_DELETE(self):
                 if self.path.strip("/") == "rendezvous":
-                    store.clear(keep_scopes=("workers",))
+                    store.clear(keep_scopes=("workers", "timeline",
+                                             "debugz"))
                     self._send(200)
                 else:
                     self._send(404)

@@ -146,7 +146,12 @@ def slot_env(base_env, slot, args, master_addr):
     else:
         env["HVT_COORDINATOR_ADDR"] = f"{master_addr}:{args.master_port}"
     if args.timeline:
+        # HVT_TIMELINE: the legacy engine-side rank-0 trace (kept as a
+        # fallback surface); HVT_TIMELINE_SHARD: the per-rank flight-
+        # recorder shard (<path>.rank<r>) every worker records, uploads
+        # to the rendezvous KV, and the launcher merges into <path>
         env["HVT_TIMELINE"] = args.timeline
+        env["HVT_TIMELINE_SHARD"] = args.timeline
     if getattr(args, "metrics_port", None) is not None:
         env["HVT_METRICS_PORT"] = str(args.metrics_port)
     if getattr(args, "autotune", False):
@@ -156,11 +161,22 @@ def slot_env(base_env, slot, args, master_addr):
     return env
 
 
-def build_commands(args, slots, master_addr, base_env=None):
+def build_commands(args, slots, master_addr, base_env=None,
+                   rendezvous_port=None):
     base_env = dict(os.environ if base_env is None else base_env)
     cmds = []
     for slot in slots:
         env = slot_env(base_env, slot, args, master_addr)
+        if rendezvous_port is not None:
+            # launcher-side KV server (timeline shard upload, /clock
+            # handshake, /debugz); a remote worker must dial the
+            # LAUNCHER host, not itself. Deliberately NOT
+            # HVT_RENDEZVOUS_ADDR: that var is the "elastic launch"
+            # marker (elastic/run.py, preemption.py key off it), and a
+            # static --timeline run must not trip those paths.
+            host = ("127.0.0.1" if _is_local(slot.hostname)
+                    else socket.gethostname())
+            env["HVT_DIAG_ADDR"] = f"{host}:{rendezvous_port}"
         if _is_local(slot.hostname):
             cmds.append((list(args.command), env, slot.rank))
         else:
@@ -168,6 +184,49 @@ def build_commands(args, slots, master_addr, base_env=None):
                                       args.command),
                          dict(os.environ), slot.rank))
     return cmds
+
+
+def merge_timeline_shards(timeline_path, store, expected_ranks=()):
+    """Merge per-rank timeline shards into ``timeline_path``.
+
+    Shards come from the rendezvous KV (``PUT /kv/timeline/<rank>`` at
+    worker teardown); any expected rank missing from the KV falls back
+    to its local shard file ``<timeline_path>.rank<r>`` — a SIGKILLed
+    worker never uploads, but its flushed shard is still loadable
+    (``utils/timeline.py`` crash-safety notes)."""
+    from horovod_tpu.utils import timeline as tl
+
+    shards, found = [], set()
+    if store is not None:
+        for key in store.keys("timeline"):
+            v = store.get("timeline", key)
+            if v is None:
+                continue
+            shards.append(tl.parse_trace(v.decode(errors="replace")))
+            found.add(str(key))
+    missing = []
+    for r in expected_ranks:
+        if str(r) in found:
+            continue
+        local = f"{timeline_path}.rank{r}"
+        if os.path.exists(local):
+            shards.append(tl.load_trace(local))
+        else:
+            missing.append(r)
+    if not shards:
+        print(f"[hvtrun] timeline: no shards recorded; {timeline_path} "
+              f"not written", file=sys.stderr)
+        return 0
+    merged = tl.merge_traces(shards)
+    import json
+
+    with open(timeline_path, "w") as f:
+        json.dump(merged, f)
+    note = f" (no shard from ranks {missing})" if missing else ""
+    print(f"[hvtrun] timeline: merged {len(shards)} shard(s), "
+          f"{len(merged)} events -> {timeline_path}{note}",
+          file=sys.stderr)
+    return len(shards)
 
 
 def _run_elastic(args) -> int:
@@ -264,6 +323,20 @@ def _run_elastic(args) -> int:
         driver.wait()
     finally:
         terminate_children()
+        if args.timeline:
+            # elastic world size varies per round; merge whatever shards
+            # workers uploaded (the KV keeps the timeline scope across
+            # re-rendezvous resets), with the local-file fallback over
+            # the final round's world — elastic is exactly the mode
+            # where workers get killed before they can upload
+            try:
+                final_world = (rendezvous.world or {}).get("size") \
+                    or args.num_proc
+                merge_timeline_shards(args.timeline, rendezvous.store,
+                                      expected_ranks=range(final_world))
+            except Exception as e:
+                print(f"[hvtrun] timeline merge failed: {e}",
+                      file=sys.stderr)
         rendezvous.stop()
     if driver.error:
         print(f"[hvtrun] elastic job failed: {driver.error}",
@@ -381,8 +454,35 @@ def main(argv=None) -> int:
             print(f"[hvtrun] rank {s.rank} → {s.hostname} "
                   f"(local {s.local_rank}/{s.local_size}, "
                   f"cross {s.cross_rank}/{s.cross_size})", file=sys.stderr)
-    cmds = build_commands(args, slots, master_addr)
-    exit_codes = safe_exec.run_all(cmds)
+    rendezvous = None
+    rendezvous_port = None
+    if args.timeline:
+        # static jobs rendezvous over the TCP control star; the timeline
+        # still needs an HTTP surface for the clock-offset handshake,
+        # shard upload, and GET /debugz — start a KV server for the run
+        from horovod_tpu.runner.http_server import RendezvousServer
+
+        rendezvous = RendezvousServer(verbose=args.verbose)
+        rendezvous.init(slots)
+        rendezvous_port = rendezvous.start()
+    try:
+        cmds = build_commands(args, slots, master_addr,
+                              rendezvous_port=rendezvous_port)
+        exit_codes = safe_exec.run_all(cmds)
+        if args.timeline:
+            try:
+                merge_timeline_shards(
+                    args.timeline,
+                    rendezvous.store if rendezvous else None,
+                    expected_ranks=range(args.num_proc))
+            except Exception as e:
+                # training already finished: a merge failure must not
+                # eat the per-rank exit-code report below
+                print(f"[hvtrun] timeline merge failed: {e}",
+                      file=sys.stderr)
+    finally:
+        if rendezvous is not None:
+            rendezvous.stop()
     bad = [(i, rc) for i, rc in enumerate(exit_codes) if rc != 0]
     if bad:
         print(f"[hvtrun] ranks failed: {bad}", file=sys.stderr)

@@ -1,17 +1,42 @@
-"""Chrome-trace timeline (reference ``horovod/common/timeline.{h,cc}``).
+"""Chrome-trace timeline + distributed flight recorder
+(reference ``horovod/common/timeline.{h,cc}``).
 
 Records the lifecycle of every collective as chrome://tracing events:
 NEGOTIATE → (QUEUE, MEMCPY_IN_FUSION_BUFFER, <BACKEND>_ALLREDUCE, ...) →
 done, one "thread" lane per tensor, exactly the reference's event scheme
-(activity names at ``common/common.h:31-62``).
+(activity names at ``common/common.h:31-62``). Every event is tagged
+``pid=<process rank>``, so shards from different ranks merge into one
+clock-aligned multi-process view (``merge_files`` /
+``python -m horovod_tpu.utils.timeline merge``).
+
+Three producers feed one per-rank trace shard:
+
+- the **Python producer API** below (``negotiate_start`` /
+  ``activity_start`` / ...), called around eager dispatches;
+- the **engine drainer thread**, which pulls the C++ engine's flight
+  recorder ring (``csrc/events.h`` via ``hvt_events_drain``) and
+  converts ENQUEUED / NEGOTIATE / FUSED / EXEC / DONE / STALL records
+  into chrome events on per-tensor ``(engine)`` lanes;
+- ``mark_cycle`` instants on a dedicated metadata-named CYCLE lane.
 
 Architecture mirrors the reference's lock-free writer split
-(``timeline.h:84-86``): producers append to an unbounded deque (append is
-atomic under the GIL — the Python analog of the SPSC queue) and a dedicated
-writer thread drains to disk, so the hot path never blocks on file I/O.
+(``timeline.h:84-86``): producers append to an unbounded deque and
+signal a ``threading.Condition``; a dedicated writer thread drains to
+disk, so the hot path never blocks on file I/O and an idle timeline
+costs ~zero CPU (no polling). The writer flushes after every batch, so
+a SIGKILLed worker still leaves a loadable shard: Chrome and Perfetto
+both tolerate a trace whose closing ``]`` is missing, and
+``load_trace`` below repairs it explicitly when merging.
+
+Timestamps are wall-clock microseconds (``time.time_ns``) plus a
+cross-rank clock offset measured against the rendezvous server's
+``GET /clock`` at init (``measure_clock_offset_us``) — the same epoch
+the C++ ring stamps with (``EventRing::NowEpochUs``), so engine-thread
+and dispatch-thread events interleave correctly across ranks.
+
 For the traced/TPU path, per-op device timings come from XLA profiler
-sessions (``jax.profiler``); ``start()`` optionally arms one so both views
-share a trace directory.
+sessions (``jax.profiler``); ``start()`` optionally arms one so both
+views share a trace directory.
 """
 
 from __future__ import annotations
@@ -24,61 +49,246 @@ import time
 _state = None
 _state_lock = threading.Lock()
 
+# Microseconds to ADD to local wall-clock timestamps so every rank's
+# events land on the rendezvous server's clock (0 when no handshake ran;
+# same-host ranks share a clock anyway).
+_clock_offset_us = 0.0
+
+# kind wire ids — must match csrc/events.h EventKind / native.EVENT_KINDS
+_ENQUEUED, _NEG_B, _NEG_E, _RANK_READY, _FUSED, _EXEC_B, _EXEC_E, \
+    _DONE, _CYCLE, _STALL = range(10)
+
+_ENGINE_DRAIN_SEC = 0.05
+
+
+def set_clock_offset_us(offset_us: float):
+    global _clock_offset_us
+    _clock_offset_us = float(offset_us)
+
+
+def clock_offset_us() -> float:
+    return _clock_offset_us
+
+
+def _now_us() -> float:
+    return time.time_ns() / 1e3 + _clock_offset_us
+
+
+def measure_clock_offset_us(addr: str, samples: int = 5,
+                            timeout: float = 2.0) -> float:
+    """Clock-offset handshake against the rendezvous server's
+    ``GET /clock``: offset = server_epoch_us + rtt/2 − local_now, taking
+    the minimum-RTT sample (NTP's classic estimator). Workers call this
+    once at init so cross-rank (cross-host) shard timestamps align."""
+    from horovod_tpu.runner.http_client import get_json
+
+    best_rtt, best_off = None, 0.0
+    for _ in range(max(1, samples)):
+        t0 = time.time_ns() / 1e3
+        obj = get_json(addr, "/clock", timeout=timeout)
+        t1 = time.time_ns() / 1e3
+        rtt = t1 - t0
+        off = float(obj["epoch_us"]) + rtt / 2.0 - t1
+        if best_rtt is None or rtt < best_rtt:
+            best_rtt, best_off = rtt, off
+    return best_off
+
 
 class _TimelineState:
-    def __init__(self, path, mark_cycles):
+    def __init__(self, path, mark_cycles, pid=0, upload_addr=None):
         self.path = path
         self.mark_cycles = mark_cycles
+        self.pid = int(pid)
+        self.upload_addr = upload_addr
         self.queue = collections.deque()
-        self.stop_event = threading.Event()
+        self.cond = threading.Condition()
+        self.stopping = False
         self.tensor_lanes = {}
         self.next_lane = 0
+        self.cycle_lane = None
         self.file = open(path, "w")
         self.file.write("[\n")
         self.first = True
+        self._emit({"name": "process_name", "ph": "M", "pid": self.pid,
+                    "args": {"name": f"rank {self.pid}"}})
+        self._emit({"name": "process_sort_index", "ph": "M",
+                    "pid": self.pid, "args": {"sort_index": self.pid}})
         self.writer = threading.Thread(target=self._drain, daemon=True)
         self.writer.start()
+        self.drainer = None
+        self._maybe_start_engine_drainer()
 
-    def _lane(self, tensor_name):
-        if tensor_name not in self.tensor_lanes:
-            self.tensor_lanes[tensor_name] = self.next_lane
+    # ----------------------------------------------------------- lanes
+    def _lane(self, key, display_name):
+        if key not in self.tensor_lanes:
+            self.tensor_lanes[key] = self.next_lane
             self.next_lane += 1
-            self._emit({"name": "thread_name", "ph": "M", "pid": 0,
-                        "tid": self.tensor_lanes[tensor_name],
-                        "args": {"name": tensor_name}})
-        return self.tensor_lanes[tensor_name]
+            self._emit({"name": "thread_name", "ph": "M", "pid": self.pid,
+                        "tid": self.tensor_lanes[key],
+                        "args": {"name": display_name}})
+        return self.tensor_lanes[key]
 
+    def _cycle_lane(self):
+        # dedicated metadata-named lane: cycle instants must never land
+        # in tensor lane 0 (they used to hardcode tid=0)
+        if self.cycle_lane is None:
+            self.cycle_lane = self._lane(("__cycle__",), "CYCLE")
+        return self.cycle_lane
+
+    # ------------------------------------------------------- producers
     def _emit(self, ev):
-        self.queue.append(ev)
+        with self.cond:
+            self.queue.append(ev)
+            self.cond.notify()
 
     def record(self, tensor_name, phase, name=None):
-        tid = self._lane(tensor_name)
-        ev = {"ph": phase, "pid": 0, "tid": tid,
-              "ts": time.perf_counter_ns() / 1e3}
+        tid = self._lane(tensor_name, tensor_name)
+        ev = {"ph": phase, "pid": self.pid, "tid": tid, "ts": _now_us()}
         if name is not None:
             ev["name"] = name
         self._emit(ev)
 
+    def cycle_mark(self, name="CYCLE_START", ts=None):
+        self._emit({"ph": "i", "pid": self.pid, "tid": self._cycle_lane(),
+                    "name": name, "ts": _now_us() if ts is None else ts,
+                    "s": "p"})
+
+    # ---------------------------------------------------------- writer
     def _drain(self):
-        while not self.stop_event.is_set() or self.queue:
-            try:
-                ev = self.queue.popleft()
-            except IndexError:
-                time.sleep(0.001)
-                continue
-            if not self.first:
-                self.file.write(",\n")
-            self.first = False
-            self.file.write(json.dumps(ev))
+        while True:
+            with self.cond:
+                while not self.queue and not self.stopping:
+                    self.cond.wait()
+                batch = list(self.queue)
+                self.queue.clear()
+                stopping = self.stopping
+            for ev in batch:
+                if not self.first:
+                    self.file.write(",\n")
+                self.first = False
+                self.file.write(json.dumps(ev))
+            if batch:
+                # crash-safety: everything up to here survives a SIGKILL
+                # (the trailing "]" is optional to Chrome/Perfetto and
+                # repaired by load_trace)
+                self.file.flush()
+            if stopping and not self.queue:
+                break
         self.file.write("\n]\n")
         self.file.close()
 
+    # -------------------------------------------- engine flight recorder
+    def _maybe_start_engine_drainer(self):
+        try:
+            from horovod_tpu.engine import native
+
+            if not native.events_supported():
+                return
+        except Exception:
+            return
+        self.drainer_stop = threading.Event()
+        self.drainer = threading.Thread(target=self._drain_engine,
+                                        daemon=True)
+        self.drainer.start()
+
+    def _drain_engine(self):
+        from horovod_tpu.engine import native
+
+        while not self.drainer_stop.wait(_ENGINE_DRAIN_SEC):
+            self._convert_engine_events(native.drain_events())
+        # final sweep: Shutdown's DONE/abort events land after the last
+        # periodic tick
+        self._convert_engine_events(native.drain_events())
+
+    def _convert_engine_events(self, events):
+        for ev in events:
+            kind = ev["kind"]
+            ts = ev["ts_us"] + _clock_offset_us
+            name = ev["name"]
+            op = ev["op_name"]
+            if kind == _CYCLE:
+                if self.mark_cycles:
+                    # arg counts the responses the cycle executed — not
+                    # a cycle index, so label it unambiguously
+                    self.cycle_mark(
+                        name=f"ENGINE_CYCLE({ev['arg']} responses)",
+                        ts=ts)
+                continue
+            key = ("eng", name)
+            tid = self._lane(key, f"{name} (engine)")
+            out = {"pid": self.pid, "tid": tid, "ts": ts}
+            if kind == _NEG_B:
+                out.update(ph="B", name=f"NEGOTIATE_{op}")
+            elif kind == _NEG_E or kind == _EXEC_E:
+                out.update(ph="E")
+            elif kind == _EXEC_B:
+                out.update(ph="B", name=op)
+            elif kind == _RANK_READY:
+                out.update(ph="i", name=f"RANK_READY_{ev['arg']}", s="t")
+            elif kind == _ENQUEUED:
+                out.update(ph="i", name="ENQUEUED", s="t")
+            elif kind == _FUSED:
+                out.update(ph="i", name=f"FUSED_x{ev['arg2']}", s="t")
+            elif kind == _DONE:
+                ok = ev["arg"] == 0
+                out.update(ph="i", name="DONE" if ok else "ERROR", s="t")
+            elif kind == _STALL:
+                missing = [r for r in range(64)
+                           if ev["arg2"] & (1 << r)]
+                out.update(ph="i", name="STALL", s="g",
+                           args={"missing_ranks": missing,
+                                 "waiting_sec": ev["arg"]})
+            else:
+                continue
+            self._emit(out)
+
+    # ----------------------------------------------------------- close
     def close(self):
-        self.stop_event.set()
+        if self.drainer is not None:
+            self.drainer_stop.set()
+            self.drainer.join(timeout=5)
+        with self.cond:
+            self.stopping = True
+            self.cond.notify()
         self.writer.join(timeout=5)
+        self._upload()
+
+    def _upload(self):
+        """PUT the finished shard to the rendezvous KV store
+        (``/kv/timeline/<rank>``) so the launcher can merge every rank's
+        shard without a shared filesystem. Best-effort: a dead server
+        must not fail teardown (the local file is the fallback)."""
+        if not self.upload_addr:
+            return
+        try:
+            from horovod_tpu.runner.http_client import put_bytes
+
+            with open(self.path, "rb") as f:
+                put_bytes(self.upload_addr, f"/kv/timeline/{self.pid}",
+                          f.read())
+        except Exception as e:
+            import sys
+
+            print(f"horovod_tpu: timeline shard upload to "
+                  f"{self.upload_addr} failed ({type(e).__name__}: {e}); "
+                  f"shard remains at {self.path}", file=sys.stderr)
 
 
-def start(path, mark_cycles=False, xla_profiler=True):
+def _default_pid() -> int:
+    import os
+
+    try:
+        from horovod_tpu.engine import native
+
+        if native.engine_running():
+            return native.engine_rank()
+    except Exception:
+        pass
+    return int(os.environ.get("HVT_PROCESS_ID", "0"))
+
+
+def start(path, mark_cycles=False, xla_profiler=True, pid=None,
+          upload_addr=None):
     """Begin recording (reference ``operations.cc:738`` horovod_start_timeline).
 
     With ``xla_profiler=True`` (default) an XLA/PJRT profiler session is
@@ -97,6 +307,11 @@ def start(path, mark_cycles=False, xla_profiler=True):
     when your code manages its own profiler sessions; if a session is
     already active when the timeline starts, the timeline leaves it
     untouched and records without device traces (ADVICE r4).
+
+    ``pid`` tags every event (defaults to the engine/process rank);
+    ``upload_addr`` makes ``stop()`` PUT the finished shard to
+    ``http://<upload_addr>/kv/timeline/<pid>`` (the hvtrun --timeline
+    collection path).
     """
     import os as _os
 
@@ -104,7 +319,10 @@ def start(path, mark_cycles=False, xla_profiler=True):
     with _state_lock:
         if _state is not None:
             return
-        _state = _TimelineState(path, mark_cycles)
+        _state = _TimelineState(
+            path, mark_cycles,
+            pid=_default_pid() if pid is None else pid,
+            upload_addr=upload_addr)
         _state.xla_profiling = False
         if _os.environ.get("HVT_TIMELINE_XLA", "1") == "0":
             xla_profiler = False
@@ -176,5 +394,102 @@ def activity_end(tensor_name):
 def mark_cycle():
     s = _state
     if s and s.mark_cycles:
-        s._emit({"ph": "i", "pid": 0, "tid": 0, "name": "CYCLE_START",
-                 "ts": time.perf_counter_ns() / 1e3, "s": "g"})
+        s.cycle_mark()
+
+
+# --- shard loading / merging ------------------------------------------------
+
+def load_trace(path):
+    """Load one trace shard file (see :func:`parse_trace`)."""
+    with open(path) as f:
+        return parse_trace(f.read())
+
+
+def parse_trace(text):
+    """Parse one trace shard, tolerating truncation: a crashed writer
+    leaves no closing ``]`` (and possibly a half-written last event).
+    Chrome/Perfetto already accept such files; merging must too."""
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    repaired = text.rstrip().rstrip(",")
+    if repaired.startswith("["):
+        try:
+            return json.loads(repaired + "\n]")
+        except json.JSONDecodeError:
+            pass
+    # last resort: the writer emits one event per line — keep every line
+    # that parses, drop the torn tail
+    events = []
+    for line in text.splitlines():
+        line = line.strip().rstrip(",")
+        if not line or line in "[]":
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(ev, dict):
+            events.append(ev)
+    return events
+
+
+def merge_traces(shards):
+    """Merge per-rank event lists into one chrome-trace event list.
+
+    Metadata (``ph == "M"``) events sort first so lane/process names
+    apply before their events; everything else orders by timestamp. A
+    ``process_name`` metadata event is synthesized for any pid that
+    lacks one (older shards)."""
+    merged, named_pids, seen_pids = [], set(), set()
+    for events in shards:
+        for ev in events:
+            if not isinstance(ev, dict):
+                continue
+            merged.append(ev)
+            pid = ev.get("pid")
+            if pid is not None:
+                seen_pids.add(pid)
+                if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                    named_pids.add(pid)
+    for pid in sorted(seen_pids - named_pids):
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"rank {pid}"}})
+    merged.sort(key=lambda e: (0 if e.get("ph") == "M" else 1,
+                               e.get("ts", 0)))
+    return merged
+
+
+def merge_files(shard_paths, out_path) -> int:
+    """Merge shard files into one chrome://tracing-loadable JSON file;
+    returns the merged event count."""
+    merged = merge_traces([load_trace(p) for p in shard_paths])
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return len(merged)
+
+
+def _main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.utils.timeline",
+        description="offline timeline shard tools")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser(
+        "merge",
+        help="merge per-rank shards into one chrome://tracing file")
+    m.add_argument("shards", nargs="+", help="per-rank shard files")
+    m.add_argument("-o", "--output", default="timeline.merged.json")
+    args = p.parse_args(argv)
+    n = merge_files(args.shards, args.output)
+    print(f"merged {len(args.shards)} shard(s), {n} events "
+          f"-> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_main())

@@ -61,6 +61,55 @@ def test_timeline_arms_xla_profiler_session(tmp_path):
                for p in produced), produced
 
 
+def test_mark_cycle_dedicated_lane(tmp_path):
+    """Cycle instants must live on their own metadata-named lane with
+    the rank's pid — not collide with tensor lane 0 (ISSUE 2
+    satellite)."""
+    path = str(tmp_path / "cyc.json")
+    timeline.start(path, mark_cycles=True, xla_profiler=False, pid=3)
+    timeline.activity_start("tensor0", "WORK")
+    timeline.activity_end("tensor0")
+    timeline.mark_cycle()
+    timeline.stop()
+
+    with open(path) as f:
+        events = json.load(f)
+    lanes = {e["tid"]: e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    cycle = [e for e in events if e.get("name") == "CYCLE_START"]
+    assert cycle, events
+    assert lanes[cycle[0]["tid"]] == "CYCLE"
+    assert lanes[cycle[0]["tid"]] != lanes[
+        [e for e in events if e.get("name") == "WORK"][0]["tid"]]
+    # every event carries the rank's pid
+    assert {e["pid"] for e in events} == {3}
+    # the process is named for chrome's process selector
+    assert any(e.get("name") == "process_name"
+               and e["args"]["name"] == "rank 3" for e in events)
+
+
+def test_timeline_flushes_before_close(tmp_path):
+    """Crash-safety: events must be readable from the shard while the
+    timeline is still recording (periodic flush), so a SIGKILLed worker
+    loses at most the last unflushed batch."""
+    import time
+
+    path = str(tmp_path / "flush.json")
+    timeline.start(path, xla_profiler=False)
+    timeline.activity_start("t", "STEP")
+    timeline.activity_end("t")
+    deadline = time.time() + 5
+    events = []
+    while time.time() < deadline:
+        with open(path) as f:
+            events = timeline.parse_trace(f.read())
+        if any(e.get("name") == "STEP" for e in events):
+            break
+        time.sleep(0.05)
+    timeline.stop()
+    assert any(e.get("name") == "STEP" for e in events), events
+
+
 def test_timeline_start_stop_idempotent(tmp_path):
     path = str(tmp_path / "t2.json")
     hvt.start_timeline(path)
