@@ -11,6 +11,13 @@ invariant at 16 MB.
 Run as a driver (spawns launcher jobs over the sweep):
     python benchmarks/engine_scaling.py [--quick]
 Worker mode is selected internally via HVT_BENCH_WORKER.
+
+Data-plane size sweep (PR 3 artifact): p50/p99 per-op latency + GB/s
+from 4 KB to 64 MB on the TCP ring (HVT_SHM_ALLREDUCE=0), A/B'ing the
+event-driven pipelined plane against the legacy sleep-loop serialized
+ring (HVT_EVENT_DRIVEN=0 + HVT_RING_PIPELINE=0) and the bf16 wire codec:
+    python benchmarks/engine_scaling.py --sweep [--np 2] [--iters 30]
+                                        [--out sweep.json] [--quick]
 """
 
 from __future__ import annotations
@@ -23,6 +30,21 @@ import time
 
 SIZES = {"4KB": 1 << 10 >> 2 << 2, "1MB": 1 << 18, "16MB": 1 << 22,
          "64MB": 1 << 24}  # float32 element counts
+
+# --sweep element counts (float32), 4 KB → 64 MB
+SWEEP_SIZES = {"4KB": 1 << 10, "64KB": 1 << 14, "1MB": 1 << 18,
+               "16MB": 1 << 22, "64MB": 1 << 24}
+
+# --sweep planes: env deltas on top of HVT_SHM_ALLREDUCE=0
+SWEEP_PLANES = {
+    # the rebuilt data plane, all defaults
+    "event_pipelined": {},
+    # the pre-PR-3 plane: unconditional cycle_ms sleep + blocking
+    # serialized ring
+    "sleep_serialized": {"HVT_EVENT_DRIVEN": "0", "HVT_RING_PIPELINE": "0"},
+    # rebuilt plane + bf16 wire compression (fp32 allreduce only)
+    "event_pipelined_bf16wire": {"HVT_WIRE_COMPRESSION": "bf16"},
+}
 
 
 def worker():
@@ -64,6 +86,165 @@ def worker():
                       "hit_ms": round(float(np.median(hot)) * 1e3, 2)}
     if r == 0:
         print("HVT_BENCH_RESULT " + json.dumps(out), flush=True)
+
+
+def sweep_worker():
+    """HVT_BENCH_SWEEP mode: per-op latency samples for each size, on
+    the hot (cached-name) path — the steady-state train-loop shape."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import horovod_tpu as hvt
+
+    hvt.init()
+    r = hvt.rank()
+    sizes = json.loads(os.environ["HVT_BENCH_SIZES"])
+    iters = int(os.environ.get("HVT_BENCH_ITERS", "30"))
+    out = {}
+    for label, numel in sizes.items():
+        x = (np.arange(numel, dtype=np.float32) % 1001) * 0.5 + r
+        # small payloads: more warmup + 5x the samples — µs-scale p50s
+        # on a shared box are dominated by scheduler warmup otherwise
+        small = numel <= (1 << 18)
+        warmup, timed = (5, iters * 5) if small else (1, iters)
+        for _ in range(1 + warmup):
+            hvt.allreduce(x, op=hvt.Sum, name=f"sweep.{label}")
+        samples = []
+        for _ in range(timed):
+            t0 = time.perf_counter()
+            res = hvt.allreduce(x, op=hvt.Sum, name=f"sweep.{label}")
+            samples.append(time.perf_counter() - t0)
+        # correctness guard: a benchmark that returns garbage is not a
+        # benchmark (bf16 wire is lossy → tolerance; raw is exact)
+        expected = sum((np.arange(numel, dtype=np.float32) % 1001) * 0.5
+                       + i for i in range(hvt.size()))
+        tol = 1e-2 if os.environ.get("HVT_WIRE_COMPRESSION") == "bf16" \
+            else 1e-6
+        np.testing.assert_allclose(np.asarray(res), expected, rtol=tol)
+        out[label] = sorted(samples)
+    if r == 0:
+        from horovod_tpu.engine import native
+
+        st = native.engine_stats()
+        print("HVT_BENCH_RESULT " + json.dumps(
+            {"samples_s": out,
+             "wire_tx_bytes": st.get("wire_tx_bytes", {}),
+             "wire_tx_comp_bytes": st.get("wire_tx_comp_bytes", {})}),
+            flush=True)
+
+
+def run_sweep_job(np_, extra_env, sizes, iters, repo):
+    env = dict(os.environ)
+    env.update({
+        "HVT_BENCH_WORKER": "1",
+        "HVT_BENCH_SWEEP": "1",
+        "HVT_BENCH_SIZES": json.dumps(sizes),
+        "HVT_BENCH_ITERS": str(iters),
+        "HVT_SHM_ALLREDUCE": "0",  # the sweep measures the TCP ring
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np",
+         str(np_), sys.executable, os.path.abspath(__file__)],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=2400)
+    if proc.returncode != 0:
+        raise RuntimeError(f"sweep np={np_} env={extra_env} failed:\n"
+                           f"{proc.stdout}\n{proc.stderr}")
+    for line in proc.stdout.splitlines():
+        if "HVT_BENCH_RESULT" in line:
+            return json.loads(line.split("HVT_BENCH_RESULT ", 1)[1])
+    raise RuntimeError(f"no result line:\n{proc.stdout}")
+
+
+def _pctl(sorted_s, q):
+    i = min(len(sorted_s) - 1, int(round(q * (len(sorted_s) - 1))))
+    return sorted_s[i]
+
+
+def sweep_main():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    quick = "--quick" in sys.argv
+
+    def argval(flag, dflt):
+        return (sys.argv[sys.argv.index(flag) + 1]
+                if flag in sys.argv else dflt)
+
+    np_ = int(argval("--np", "2"))
+    iters = int(argval("--iters", "10" if quick else "20"))
+    rounds = int(argval("--rounds", "1" if quick else "3"))
+    out_path = argval("--out", "")
+    sizes = ({"4KB": 1 << 10, "16MB": 1 << 22} if quick
+             else dict(SWEEP_SIZES))
+    planes = dict(SWEEP_PLANES)
+    # optional: measure a pre-PR-3 libhvt_core.so (built from the seed
+    # commit) through the same harness — the honest tentpole baseline,
+    # since HVT_EVENT_DRIVEN/HVT_RING_PIPELINE only unwind part of it
+    seed_lib = argval("--seed-lib", "")
+    if seed_lib:
+        planes["seed_so"] = {"HVT_CORE_LIB": seed_lib}
+    record = {"np": np_, "iters": iters, "rounds": rounds,
+              "transport": "tcp ring (HVT_SHM_ALLREDUCE=0)",
+              "planes": {}}
+    # Interleave planes round-robin: ambient machine state (CPU
+    # frequency, co-tenants) drifts on minute scales, so back-to-back
+    # whole-plane jobs bias the comparison; rotating jobs and pooling
+    # samples spreads the drift across every plane alike.
+    pooled = {p: {label: [] for label in sizes} for p in planes}
+    by_round = {p: {label: [] for label in sizes} for p in planes}
+    wire = {p: {} for p in planes}
+    for rnd in range(rounds):
+        for plane, extra in planes.items():
+            res = run_sweep_job(np_, extra, sizes, iters, repo)
+            for label, samples in res["samples_s"].items():
+                pooled[plane][label].extend(samples)
+                by_round[plane][label].append(
+                    round(_pctl(sorted(samples), 0.50) * 1e3, 3))
+            wire[plane] = {
+                "wire_tx_bytes": res.get("wire_tx_bytes", {}),
+                "wire_tx_comp_bytes": res.get("wire_tx_comp_bytes", {}),
+            }
+            print(f"round {rnd + 1}/{rounds} plane {plane} done",
+                  flush=True)
+    for plane, extra in planes.items():
+        rows = {}
+        for label, samples in pooled[plane].items():
+            samples = sorted(samples)
+            mb = sizes[label] * 4 / (1 << 20)
+            p50, p99 = _pctl(samples, 0.50), _pctl(samples, 0.99)
+            rounds_p50 = by_round[plane][label]
+            rows[label] = {
+                "p50_ms": round(p50 * 1e3, 3),
+                "p99_ms": round(p99 * 1e3, 3),
+                "gbps": round(mb / 1024 / p50, 3) if p50 else 0.0,
+                # per-round medians + their min: the host is a shared
+                # box whose spare CPU drifts on minute scales, so the
+                # quietest round is the least-interference estimate
+                # (pooled p50 includes whatever co-tenant noise each
+                # round absorbed)
+                "round_p50_ms": rounds_p50,
+                "best_p50_ms": min(rounds_p50),
+            }
+            print(json.dumps({"plane": plane, "size": label,
+                              **rows[label]}), flush=True)
+        record["planes"][plane] = {"env": extra, "sizes": rows,
+                                   **wire[plane]}
+    print("\n| plane | size | p50 ms | p99 ms | GB/s |")
+    print("|---|---|---|---|---|")
+    for plane, pr in record["planes"].items():
+        for label, row in pr["sizes"].items():
+            print(f"| {plane} | {label} | {row['p50_ms']} | "
+                  f"{row['p99_ms']} | {row['gbps']} |")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+        print(f"wrote {out_path}")
+    return record
 
 
 def run_job(np_, shm, sizes, iters, repo):
@@ -120,6 +301,8 @@ def main():
 
 if __name__ == "__main__":
     if os.environ.get("HVT_BENCH_WORKER"):
-        worker()
+        sweep_worker() if os.environ.get("HVT_BENCH_SWEEP") else worker()
+    elif "--sweep" in sys.argv:
+        sweep_main()
     else:
         main()

@@ -42,6 +42,19 @@ if command -v ldd >/dev/null 2>&1; then
   echo "ldd -r OK (no undefined symbols)"
 fi
 
+# The rebuilt .so must export the full C API surface — a stale build
+# dir can silently serve an old .so whose missing symbols make the
+# Python bridge degrade to zeros (PR 3 added the data-plane symbols).
+REQUIRED_SYMS="hvt_init hvt_submit hvt_engine_stats hvt_events_drain \
+hvt_diagnostics hvt_wire_compression hvt_scale_buffer"
+for sym in $REQUIRED_SYMS; do
+  if ! nm -D "$CORE_SO" 2>/dev/null | grep -q " T $sym\$"; then
+    echo "FATAL: $CORE_SO does not export $sym (stale build?)" >&2
+    exit 1
+  fi
+done
+echo "C API symbol check OK ($(echo $REQUIRED_SYMS | wc -w) symbols)"
+
 echo "=== [2/4] test suite ==="
 if [[ "$FAST" == "1" ]]; then
   # quick subset: modules outside tests/conftest.py's known-slow list

@@ -82,6 +82,7 @@ from horovod_tpu.ops.collective_ops import (
     join,
     synchronize,
     poll,
+    wire_compression,
     Average,
     Sum,
     Adasum,

@@ -439,11 +439,43 @@ def poll_engine_stats(registry=None):
     exec_n = reg.counter("hvt_engine_exec_total",
                          "data-plane responses executed by collective op",
                          ("op",))
+    wire_tx = reg.counter(
+        "hvt_wire_tx_bytes_total",
+        "bytes sent on the TCP data plane by collective op (compressed "
+        "transfers count their compressed size)", ("op",))
+    wire_txc = reg.counter(
+        "hvt_wire_tx_compressed_bytes_total",
+        "TCP data-plane bytes sent in compressed form "
+        "(HVT_WIRE_COMPRESSION), by collective op", ("op",))
     ns = stats.get("exec_ns", {})
     cnt = stats.get("exec_count", {})
+    tx = stats.get("wire_tx_bytes", {})
+    txc = stats.get("wire_tx_comp_bytes", {})
     for op in native.STATS_OPS:
         exec_s.labels(op=op).set_total(ns.get(op, 0) / 1e9)
         exec_n.labels(op=op).set_total(cnt.get(op, 0))
+        wire_tx.labels(op=op).set_total(tx.get(op, 0))
+        wire_txc.labels(op=op).set_total(txc.get(op, 0))
+
+    # engine-side latency histograms, bridged bucket-for-bucket: the
+    # C++ bounds (1 µs · 4^i) are exactly DEFAULT_LATENCY_BUCKETS, so
+    # set_state maps them 1:1 (ns → seconds for the sum)
+    for name, help_, key in (
+            ("hvt_cycle_duration_seconds",
+             "engine cycle wall time (includes the control-plane wait "
+             "for peers)", "cycle_hist"),
+            ("hvt_engine_wakeup_latency_seconds",
+             "submit-to-drain coalescing latency of the event-driven "
+             "cycle loop", "wakeup_hist")):
+        h = reg.histogram(name, help_)
+        d = stats.get(key) or {}
+        h.labels().set_state(d.get("buckets", ()),
+                             d.get("sum_ns", 0) / 1e9,
+                             d.get("count", 0))
+
+    reg.gauge("hvt_wire_compression_mode",
+              "configured wire codec (0 raw, 1 bf16); rank 0's value "
+              "governs the gang").set(native.wire_compression())
 
     up = reg.gauge("hvt_engine_up",
                    "1 when the C++ engine is initialized")

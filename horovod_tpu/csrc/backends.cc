@@ -76,7 +76,8 @@ void CollectiveBackend::AlltoallvMatrix(
 
 void CollectiveBackend::AllreduceGroup(void*, int64_t, DataType,
                                        ReduceKind,
-                                       const std::vector<int>&) {
+                                       const std::vector<int>&, double,
+                                       WireCodec) {
   throw std::runtime_error(std::string("hvt backend '") + Name() +
                            "' does not implement subset allreduce");
 }
@@ -112,14 +113,15 @@ void CollectiveBackend::ReduceScatter(void* buf, int64_t count,
   (void)my_pos;
   (void)m;
   if (full_world)
-    Allreduce(buf, count, dtype, red);
+    Allreduce(buf, count, dtype, red, 1.0, WireCodec::RAW);
   else
-    AllreduceGroup(buf, count, dtype, red, group);
+    AllreduceGroup(buf, count, dtype, red, group, 1.0, WireCodec::RAW);
 }
 
 void RingBackend::Allreduce(void* buf, int64_t count, DataType dtype,
-                            ReduceKind red) {
-  dp_->Allreduce(buf, count, dtype, red);
+                            ReduceKind red, double postscale,
+                            WireCodec wire) {
+  dp_->Allreduce(buf, count, dtype, red, postscale, wire);
 }
 
 void RingBackend::Allgatherv(const void* in, int64_t my_rows,
@@ -141,8 +143,9 @@ void RingBackend::Alltoallv(const void* in,
 
 void RingBackend::AllreduceGroup(void* buf, int64_t count, DataType dtype,
                                  ReduceKind red,
-                                 const std::vector<int>& group) {
-  dp_->AllreduceGroup(buf, count, dtype, red, group);
+                                 const std::vector<int>& group,
+                                 double postscale, WireCodec wire) {
+  dp_->AllreduceGroup(buf, count, dtype, red, group, postscale, wire);
 }
 
 void RingBackend::AllgathervGroup(const void* in, int64_t my_rows,
@@ -340,7 +343,9 @@ bool ShmLocalBackend::Enabled(const Response& resp,
 }
 
 void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
-                                ReduceKind red) {
+                                ReduceKind red, double postscale,
+                                WireCodec wire) {
+  (void)wire;  // no wire bytes to compress on a shm plane
   if (!used_logged_) {
     used_logged_ = true;
     HVT_LOG(DEBUG, rank_) << "shm allreduce engaged (" << count
@@ -359,6 +364,9 @@ void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
     memcpy(dst, slot(0) + lo * el, static_cast<size_t>(hi - lo) * el);
     for (int r = 1; r < size_; ++r)
       ReduceInto(dst, slot(r) + lo * el, hi - lo, dtype, red);
+    // postscale folds into the chunked reduce: each rank scales only
+    // its chunk of the shared result before publishing it
+    if (postscale != 1.0) ScaleBuffer(dst, hi - lo, dtype, postscale);
   }
   Barrier(world_group_);  // result complete
   memcpy(buf, result(), bytes);
@@ -417,7 +425,9 @@ void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {
 
 void ShmLocalBackend::AllreduceGroup(void* buf, int64_t count,
                                      DataType dtype, ReduceKind red,
-                                     const std::vector<int>& group) {
+                                     const std::vector<int>& group,
+                                     double postscale, WireCodec wire) {
+  (void)wire;
   LogSubsetOnce(group);
   const size_t el = DataTypeSize(dtype);
   const size_t bytes = static_cast<size_t>(count) * el;
@@ -428,6 +438,7 @@ void ShmLocalBackend::AllreduceGroup(void* buf, int64_t count,
   memcpy(buf, slot(group[0]), bytes);
   for (size_t i = 1; i < group.size(); ++i)
     ReduceInto(buf, slot(group[i]), count, dtype, red);
+  if (postscale != 1.0) ScaleBuffer(buf, count, dtype, postscale);
   Barrier(group);  // reads done; slots reusable
 }
 
@@ -536,7 +547,8 @@ bool HierarchicalBackend::Enabled(const Response& resp,
 }
 
 void HierarchicalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
-                                    ReduceKind red) {
+                                    ReduceKind red, double postscale,
+                                    WireCodec wire) {
   // reference NCCLHierarchicalAllreduce decomposition
   // (nccl_operations.cc:188-350): local reduce-scatter, parallel
   // cross-host allreduce (one slice per local rank), local allgather.
@@ -549,10 +561,15 @@ void HierarchicalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
   // I now own fully-reduced (locally) segment (my_local+1) % l; my cross
   // peers (same local index on every host) own the SAME segment of their
   // hosts' local sums — allreduce it across hosts, all slices in parallel.
+  // postscale + wire compression ride the cross-host phase: the slice
+  // comes back scaled (and, under BF16, each rank's slice is already
+  // bf16-truncated identically on every host), so the local allgather
+  // distributes finished data. Only the cross phase crosses the network,
+  // which is also where compressed wire bytes pay off.
   const int own = (topo_.my_local + 1) % l;
   int64_t own_n = seg_off[own + 1] - seg_off[own];
   dp_->AllreduceGroup(bytes + seg_off[own] * el, own_n, dtype, red,
-                      topo_.cross_group);
+                      topo_.cross_group, postscale, wire);
   dp_->RingAllgatherSegs(bytes, seg_off, el, topo_.local_group);
 }
 

@@ -47,8 +47,16 @@ class CollectiveBackend {
   // accepts one must implement the *Group methods below (the reference
   // serves every op from the selected backend — operation_manager.cc).
   virtual bool Enabled(const Response& resp, int64_t total_elems) const = 0;
+  // postscale: applied to the whole buffer as part of the collective —
+  // backends fold it into their last data pass (ring: each rank scales
+  // just its owned segment before the allgather; shm: each rank scales
+  // its chunk of the shared result) instead of a separate full sweep.
+  // wire: negotiated payload codec (WireCodec wire id from the
+  // Response); only the TCP ring moves wire bytes, so other backends
+  // may ignore it.
   virtual void Allreduce(void* buf, int64_t count, DataType dtype,
-                         ReduceKind red) = 0;
+                         ReduceKind red, double postscale,
+                         WireCodec wire) = 0;
   virtual void Allgatherv(const void* in, int64_t my_rows,
                           const std::vector<int64_t>& rows,
                           int64_t row_bytes, void* out);
@@ -69,7 +77,8 @@ class CollectiveBackend {
   // containing this rank; rows/positions indexed by group position) ----
   virtual void AllreduceGroup(void* buf, int64_t count, DataType dtype,
                               ReduceKind red,
-                              const std::vector<int>& group);
+                              const std::vector<int>& group,
+                              double postscale, WireCodec wire);
   virtual void AllgathervGroup(const void* in, int64_t my_rows,
                                const std::vector<int64_t>& rows,
                                int64_t row_bytes, void* out,
@@ -104,8 +113,8 @@ class RingBackend : public CollectiveBackend {
   explicit RingBackend(DataPlane* dp) : dp_(dp) {}
   const char* Name() const override { return "ring"; }
   bool Enabled(const Response&, int64_t) const override { return true; }
-  void Allreduce(void* buf, int64_t count, DataType dtype,
-                 ReduceKind red) override;
+  void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
+                 double postscale, WireCodec wire) override;
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,
                   void* out) override;
@@ -114,8 +123,8 @@ class RingBackend : public CollectiveBackend {
                  int64_t row_bytes, void* out,
                  const std::vector<int64_t>& recv_rows) override;
   void AllreduceGroup(void* buf, int64_t count, DataType dtype,
-                      ReduceKind red,
-                      const std::vector<int>& group) override;
+                      ReduceKind red, const std::vector<int>& group,
+                      double postscale, WireCodec wire) override;
   void AllgathervGroup(const void* in, int64_t my_rows,
                        const std::vector<int64_t>& rows, int64_t row_bytes,
                        void* out, const std::vector<int>& group) override;
@@ -154,8 +163,8 @@ class ShmLocalBackend : public CollectiveBackend {
   ~ShmLocalBackend() override;
   const char* Name() const override { return "shm"; }
   bool Enabled(const Response& resp, int64_t total_elems) const override;
-  void Allreduce(void* buf, int64_t count, DataType dtype,
-                 ReduceKind red) override;
+  void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
+                 double postscale, WireCodec wire) override;
   void Broadcast(void* buf, int64_t bytes, int root) override;
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,
@@ -164,8 +173,8 @@ class ShmLocalBackend : public CollectiveBackend {
                        const std::vector<int64_t>& rows_flat, int m,
                        int64_t row_bytes, void* out, int my_pos) override;
   void AllreduceGroup(void* buf, int64_t count, DataType dtype,
-                      ReduceKind red,
-                      const std::vector<int>& group) override;
+                      ReduceKind red, const std::vector<int>& group,
+                      double postscale, WireCodec wire) override;
   void AllgathervGroup(const void* in, int64_t my_rows,
                        const std::vector<int64_t>& rows, int64_t row_bytes,
                        void* out, const std::vector<int>& group) override;
@@ -222,8 +231,8 @@ class HierarchicalBackend : public CollectiveBackend {
       : dp_(dp), topo_(std::move(topo)), enabled_(enabled) {}
   const char* Name() const override { return "hierarchical"; }
   bool Enabled(const Response& resp, int64_t total_elems) const override;
-  void Allreduce(void* buf, int64_t count, DataType dtype,
-                 ReduceKind red) override;
+  void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
+                 double postscale, WireCodec wire) override;
 
  private:
   DataPlane* dp_;

@@ -176,12 +176,20 @@ int hvt_engine_flags() {
 //   3 cache_hits             7 stall_events
 //   8..14  exec_ns    per OpType (ALLREDUCE..BARRIER)
 //   15..21 exec_count per OpType
+//   22..28 wire_tx_bytes per OpType (TCP data-plane bytes sent)
+//   29..35 wire_tx_compressed_bytes per OpType (subset sent compressed)
+//   36..50 cycle-duration histogram buckets (≤ 1 µs · 4^i, last = +Inf)
+//   51     cycle-duration sum (ns)        52 cycle-duration count
+//   53..67 wakeup-latency histogram buckets (same bounds)
+//   68     wakeup-latency sum (ns)        69 wakeup-latency count
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
 int hvt_engine_stats(long long* out, int max_n) {
-  const auto& s = Engine::Get().stats();
-  long long v[8 + 2 * hvt::kStatsOps] = {
+  auto& eng = Engine::Get();
+  const auto& s = eng.stats();
+  constexpr int kHist = hvt::kLatBuckets + 1 + 2;  // buckets + sum + count
+  long long v[8 + 4 * hvt::kStatsOps + 2 * kHist] = {
       s.cycles.load(std::memory_order_relaxed),
       s.tensors_submitted.load(std::memory_order_relaxed),
       s.tensors_coordinated.load(std::memory_order_relaxed),
@@ -195,10 +203,37 @@ int hvt_engine_stats(long long* out, int max_n) {
     v[8 + i] = s.exec_ns[i].load(std::memory_order_relaxed);
     v[8 + hvt::kStatsOps + i] =
         s.exec_count[i].load(std::memory_order_relaxed);
+    v[8 + 2 * hvt::kStatsOps + i] = eng.wire_tx_bytes(i);
+    v[8 + 3 * hvt::kStatsOps + i] = eng.wire_tx_comp_bytes(i);
   }
-  const int n = 8 + 2 * hvt::kStatsOps;
+  int base = 8 + 4 * hvt::kStatsOps;
+  for (const hvt::LatencyHist* h : {&s.cycle_hist, &s.wakeup_hist}) {
+    for (int i = 0; i <= hvt::kLatBuckets; ++i)
+      v[base++] = h->buckets[i].load(std::memory_order_relaxed);
+    v[base++] = h->sum_ns.load(std::memory_order_relaxed);
+    v[base++] = h->count.load(std::memory_order_relaxed);
+  }
+  const int n = 8 + 4 * hvt::kStatsOps + 2 * kHist;
   for (int i = 0; i < n && i < max_n; ++i) out[i] = v[i];
   return n;
+}
+
+// Negotiated wire codec as configured on this rank (WireCodec wire id;
+// rank 0's value governs the gang via per-response stamps).
+int hvt_wire_compression() { return Engine::Get().wire_mode(); }
+
+// Direct ScaleBuffer entry point for unit tests (pins the integer
+// round-vs-truncate semantics without spinning up a gang). dtype is the
+// DataType wire id. Returns 0, or -1 for an unsupported dtype.
+int hvt_scale_buffer(void* data, long long count, int dtype,
+                     double factor) {
+  try {
+    hvt::ScaleBuffer(data, static_cast<int64_t>(count),
+                     static_cast<DataType>(dtype), factor);
+    return 0;
+  } catch (const std::exception&) {
+    return -1;
+  }
 }
 
 // ---- flight recorder (csrc/events.h) -------------------------------------

@@ -112,6 +112,15 @@ enum class ReduceKind : uint8_t {
   ADASUM = 5,
 };
 
+// Wire codec for TCP-ring payloads, negotiated per response by rank 0
+// (HVT_WIRE_COMPRESSION) so every participant agrees on transfer sizes.
+// BF16 halves fp32 DCN bytes at bf16 precision (EQuARX-style compressed
+// allreduce, arXiv:2506.17615); RAW is bit-exact and the default.
+enum class WireCodec : uint8_t {
+  RAW = 0,
+  BF16 = 1,
+};
+
 struct TensorShape {
   std::vector<int64_t> dims;
   int64_t num_elements() const {

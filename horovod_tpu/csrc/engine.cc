@@ -25,6 +25,17 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   rank_ = rank;
   size_ = size;
   cycle_ms_ = cycle_ms > 0 ? cycle_ms : 2;
+  event_driven_ = EnvInt("HVT_EVENT_DRIVEN", 1) != 0;
+  // Wire codec for fp32 allreduce payloads. Every rank parses the env
+  // for introspection, but only rank 0's value matters: it stamps the
+  // codec into each Response, so the gang always agrees even when the
+  // env differs across hosts.
+  {
+    const char* wc = getenv("HVT_WIRE_COMPRESSION");
+    wire_mode_ = (wc && std::string(wc) == "bf16")
+                     ? static_cast<uint8_t>(WireCodec::BF16)
+                     : static_cast<uint8_t>(WireCodec::RAW);
+  }
   fusion_threshold_ = EnvInt("HVT_FUSION_THRESHOLD", 64 << 20);
   stall_warn_sec_ =
       static_cast<double>(EnvInt("HVT_STALL_WARN_SEC", 60));
@@ -132,6 +143,10 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   resp_seq_ = 0;
   stats_.Reset();  // fresh telemetry per (re-)init — an elastic restart
                    // starts a new scrape epoch on every rank
+  // wire telemetry lands in the stats block, which outlives data_ —
+  // scrape threads may poll hvt_engine_stats while Shutdown tears the
+  // DataPlane down
+  data_->BindTxCounters(stats_.wire_tx_bytes, stats_.wire_tx_comp_bytes);
   cache_enabled_ = true;
   prefer_flat_ = false;
   tuned_cache_enabled_ = true;
@@ -168,6 +183,12 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
 void Engine::Shutdown() {
   if (!initialized_.load()) return;
   shutdown_requested_ = true;
+  {
+    // pair with the cv wait's predicate check so the wakeup can't be
+    // missed between predicate evaluation and sleep
+    std::lock_guard<std::mutex> lk(queue_mu_);
+  }
+  queue_cv_.notify_all();
   if (thread_.joinable()) thread_.join();
   workers_.clear();
   control_.Close();
@@ -215,6 +236,7 @@ int32_t Engine::Submit(EntryPtr entry) {
     std::lock_guard<std::mutex> lk(queue_mu_);
     submitted_.push_back(std::move(entry));
   }
+  queue_cv_.notify_one();  // wake the engine mid-coalescing-wait
   return h;
 }
 
@@ -231,7 +253,16 @@ HandleState Engine::Wait(int32_t handle) {
     return it == handles_.end() || it->second.done;
   });
   auto it = handles_.find(handle);
-  return it == handles_.end() ? HandleState{} : it->second;
+  if (it == handles_.end()) return HandleState{};
+  // MOVE the payload out rather than copying — for a 16 MB allreduce
+  // this is a 16 MB memcpy off the wait path. Handles are waited at
+  // most once (native.py caches, tf_ops waits once); a repeated Wait
+  // still sees done/status but an empty output.
+  HandleState out = std::move(it->second);
+  it->second.done = out.done;
+  it->second.status = out.status;
+  it->second.join_result = out.join_result;
+  return out;
 }
 
 void Engine::Release(int32_t handle) {
@@ -242,13 +273,17 @@ void Engine::Release(int32_t handle) {
 void Engine::CompleteEntry(const EntryPtr& e, const Status& s) {
   events_.Record(EventKind::DONE, e->name, static_cast<int32_t>(e->op),
                  static_cast<int32_t>(s.type), 0);
-  std::lock_guard<std::mutex> lk(handles_mu_);
-  auto it = handles_.find(e->handle);
-  if (it == handles_.end()) return;
-  it->second.done = true;
-  it->second.status = s;
-  it->second.output = std::move(e->output);
-  it->second.recv_splits = std::move(e->recv_splits);
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    auto it = handles_.find(e->handle);
+    if (it == handles_.end()) return;
+    it->second.done = true;
+    it->second.status = s;
+    it->second.output = std::move(e->output);
+    it->second.recv_splits = std::move(e->recv_splits);
+  }
+  // notify AFTER releasing handles_mu_: waking a waiter straight into a
+  // held mutex costs an extra scheduler bounce per completion
   handles_cv_.notify_all();
 }
 
@@ -272,24 +307,78 @@ void Engine::FailAll(const std::string& why) {
 // --------------------------------------------------------------------------
 
 void Engine::ThreadLoop() {
+  // How long open-but-unprogressing negotiations keep the loop hot
+  // before it decays to cycle_ms pacing (see below).
+  const double grace_sec =
+      static_cast<double>(EnvInt("HVT_SPIN_GRACE_MS", 250)) / 1e3;
+  double last_progress = NowSec();
   while (true) {
+    double t0 = NowSec();
+    bool progressed = false;
+    bool outstanding = false;
     try {
-      if (!RunCycle()) return;
+      if (!RunCycle(progressed, outstanding)) return;
     } catch (const std::exception& e) {
       FailAll(std::string("hvt engine: ") + e.what());
       return;
     }
-    std::this_thread::sleep_for(std::chrono::milliseconds(cycle_ms_));
+    double now = NowSec();
+    stats_.cycle_hist.Observe(static_cast<int64_t>((now - t0) * 1e9));
+    if (!event_driven_) {
+      // legacy fixed-rate loop (HVT_EVENT_DRIVEN=0): every cycle pays
+      // the full sleep even with work queued — the A/B baseline
+      std::this_thread::sleep_for(std::chrono::milliseconds(cycle_ms_));
+      continue;
+    }
+    if (progressed) last_progress = now;
+    // Event-driven pacing: cycles run back-to-back while the engine is
+    // progressing (draining submissions / executing responses), and —
+    // within a grace window — while negotiations are still open
+    // (pending_): an engine with open negotiations must keep
+    // exchanging, since its peers cannot finish a cycle without its
+    // frame, so one sleeping participant would pace the whole gang at
+    // cycle_ms. The grace window bounds the failure mode where EVERY
+    // rank has open-but-unmatchable work (e.g. crossed tensor names):
+    // after HVT_SPIN_GRACE_MS without progress the loop decays to the
+    // legacy cv-timeout pacing instead of spinning control frames at
+    // full speed, and any real progress re-arms the window. Only a
+    // fully idle engine sleeps immediately, and a Submit cuts every
+    // sleep short: cycle_ms is the MAX coalescing wait, not a latency
+    // floor.
+    bool hot = progressed ||
+               (outstanding && now - last_progress < grace_sec);
+    if (hot || shutdown_requested_.load()) continue;
+    std::unique_lock<std::mutex> lk(queue_mu_);
+    queue_cv_.wait_for(lk, std::chrono::milliseconds(cycle_ms_), [&] {
+      return !submitted_.empty() || shutdown_requested_.load();
+    });
   }
 }
 
-bool Engine::RunCycle() {
+bool Engine::RunCycle(bool& progressed, bool& outstanding) {
   stats_.cycles.fetch_add(1, std::memory_order_relaxed);
   if (timeline_.active() && timeline_.mark_cycles())
     timeline_.CycleMark();
   // 1. drain submissions
   {
     std::lock_guard<std::mutex> lk(queue_mu_);
+    if (!submitted_.empty()) {
+      progressed = true;
+      // wakeup latency: how long the oldest submission sat in the queue
+      // before this cycle picked it up — the event-driven loop's
+      // coalescing delay (≈ µs when signaled, ≤ cycle_ms worst case)
+      double oldest = submitted_.front()->submit_sec;
+      for (auto& e : submitted_)
+        if (e->submit_sec > 0 && e->submit_sec < oldest)
+          oldest = e->submit_sec;
+      if (oldest > 0) {
+        int64_t ns = static_cast<int64_t>((NowSec() - oldest) * 1e9);
+        if (ns < 0) ns = 0;
+        stats_.wakeup_hist.Observe(ns);
+        events_.Record(EventKind::WAKEUP, "", -1,
+                       static_cast<int32_t>(submitted_.size()), ns / 1000);
+      }
+    }
     for (auto& e : submitted_) {
       if (e->op == OpType::JOIN) {
         if (join_pending_) {
@@ -457,9 +546,11 @@ bool Engine::RunCycle() {
     if (trace)
       for (auto& n : resp.names) timeline_.ExecuteEnd(n);
   }
-  if (!responses.empty())
+  if (!responses.empty()) {
+    progressed = true;
     events_.Record(EventKind::CYCLE, "", -1,
                    static_cast<int32_t>(responses.size()), 0);
+  }
 
   // feed the autotuner with this cycle's throughput (rank 0 tunes;
   // reference operations.cc:610-642 feeds the ParameterManager the same
@@ -491,6 +582,9 @@ bool Engine::RunCycle() {
     announced_.clear();
     return false;
   }
+  // open negotiations keep the cycle loop hot — within the grace
+  // window (see ThreadLoop)
+  outstanding = !pending_.empty() || join_pending_;
   return true;
 }
 
@@ -893,6 +987,16 @@ std::vector<Response> Engine::Coordinate(
   }
 
   FuseResponses(out);
+  // Stamp the negotiated wire codec (HVT_WIRE_COMPRESSION on rank 0) on
+  // every eligible TENSOR response — cache fast-path and slow-path alike
+  // — so all participants compress/decompress identically. Only fp32
+  // non-Adasum allreduces compress (bf16 halves their DCN bytes).
+  if (wire_mode_ == static_cast<uint8_t>(WireCodec::BF16))
+    for (auto& r : out)
+      if (r.kind == Response::Kind::TENSOR &&
+          r.op == OpType::ALLREDUCE && r.dtype == DataType::FLOAT32 &&
+          r.reduce != ReduceKind::ADASUM)
+        r.wire = static_cast<uint8_t>(WireCodec::BF16);
   return out;
 }
 
@@ -1354,8 +1458,8 @@ void Engine::ExecuteResponse(const Response& resp,
             it->second.done = true;
             it->second.status = Status::OK();
           }
-          handles_cv_.notify_all();
         }
+        handles_cv_.notify_all();  // after unlock (see CompleteEntry)
         join_entry_.reset();
       }
       join_pending_ = false;
@@ -1394,6 +1498,9 @@ void Engine::ExecuteResponse(const Response& resp,
 
   const size_t el = DataTypeSize(resp.dtype);
   data_ops_++;  // one per TENSOR response = one data-plane collective
+  // attribute this response's wire bytes to its OpType (engine thread
+  // is the only data-plane user, so a plain member set suffices)
+  if (data_) data_->set_stat_op(static_cast<int>(resp.op));
   stats_.tensors_coordinated.fetch_add(
       static_cast<int64_t>(resp.names.size()), std::memory_order_relaxed);
   for (int64_t n : resp.numels) {
@@ -1447,50 +1554,67 @@ void Engine::ExecuteResponse(const Response& resp,
         return;
       }
 
-      // fused path: pack → (prescale) → ring → (postscale) → unpack
+      // fused path: pack → (prescale) → ring → unpack, with postscale
+      // folded into the backend. Single-tensor responses — the common
+      // shape for large payloads, which fuse rarely — skip the fusion
+      // buffer entirely and run the collective in place on the entry's
+      // own input buffer: no 2·bytes pack/unpack memcpy sweep.
       int64_t total = 0;
       for (auto n : resp.numels) total += n;
-      fusion_buffer_.resize(static_cast<size_t>(total) * el);
       std::vector<EntryPtr> entries(resp.names.size());
-      int64_t off = 0;
-      for (size_t i = 0; i < resp.names.size(); ++i) {
-        entries[i] = take(resp.names[i]);
-        size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
-        if (entries[i]) {
-          memcpy(fusion_buffer_.data() + off, entries[i]->input.data(),
-                 bytes);
-        } else {
-          memset(fusion_buffer_.data() + off, 0, bytes);  // joined stand-in
+      uint8_t* work;
+      bool in_place = false;
+      if (resp.names.size() == 1) {
+        entries[0] = take(resp.names[0]);
+        in_place = entries[0] != nullptr &&
+                   entries[0]->input.size() ==
+                       static_cast<size_t>(total) * el;
+      }
+      if (in_place) {
+        work = entries[0]->input.data();
+      } else {
+        fusion_buffer_.resize(static_cast<size_t>(total) * el);
+        work = fusion_buffer_.data();
+        int64_t off = 0;
+        for (size_t i = 0; i < resp.names.size(); ++i) {
+          if (!entries[i]) entries[i] = take(resp.names[i]);
+          size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
+          if (entries[i]) {
+            memcpy(work + off, entries[i]->input.data(), bytes);
+          } else {
+            memset(work + off, 0, bytes);  // joined stand-in
+          }
+          off += bytes;
         }
-        off += bytes;
       }
       if (resp.prescale != 1.0)
-        ScaleBuffer(fusion_buffer_.data(), total, resp.dtype,
-                    resp.prescale);
+        ScaleBuffer(work, total, resp.dtype, resp.prescale);
       {
         // subset responses route through the backend list too (shm serves
         // them via per-group barrier cells; ring is the fallback) — the
         // reference serves every op from the selected backend
-        // (operation_manager.cc)
+        // (operation_manager.cc). postscale (incl. the Average divide)
+        // folds into the backend's final data pass, and the negotiated
+        // wire codec rides along for the TCP ring.
+        double post = resp.postscale;
+        if (resp.reduce == ReduceKind::AVERAGE) post /= m;
+        WireCodec wire = static_cast<WireCodec>(resp.wire);
         auto* be = PickBackend(resp, total);
         be->BeginResponse(resp_seq_);
         if (resp.members.empty())
-          be->Allreduce(fusion_buffer_.data(), total, resp.dtype,
-                        resp.reduce);
+          be->Allreduce(work, total, resp.dtype, resp.reduce, post, wire);
         else
-          be->AllreduceGroup(fusion_buffer_.data(), total, resp.dtype,
-                             resp.reduce, grp);
+          be->AllreduceGroup(work, total, resp.dtype, resp.reduce, grp,
+                             post, wire);
       }
-      double post = resp.postscale;
-      if (resp.reduce == ReduceKind::AVERAGE) post /= m;
-      if (post != 1.0)
-        ScaleBuffer(fusion_buffer_.data(), total, resp.dtype, post);
-      off = 0;
+      int64_t off = 0;
       for (size_t i = 0; i < resp.names.size(); ++i) {
         size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
         if (entries[i]) {
-          entries[i]->output.assign(fusion_buffer_.data() + off,
-                                    fusion_buffer_.data() + off + bytes);
+          if (in_place)
+            entries[i]->output = std::move(entries[i]->input);
+          else
+            entries[i]->output.assign(work + off, work + off + bytes);
           // every rank inserts in the same order → identical caches;
           // grouped tensors stay uncached (groups renegotiate as a unit)
           CachedParams p{resp.op,      resp.reduce,    resp.dtype,

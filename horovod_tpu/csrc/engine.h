@@ -52,6 +52,35 @@ namespace hvt {
 // counters unconditionally on.
 constexpr int kStatsOps = 7;  // OpType 0..6 (common.h)
 
+// Fixed log-scale latency histogram: bucket i holds observations
+// ≤ 1 µs · 4^i (matches metrics.DEFAULT_LATENCY_BUCKETS so the Python
+// bridge maps buckets 1:1), slot kLatBuckets is +Inf overflow. Writers
+// are engine/client threads, readers poll — relaxed atomics throughout.
+constexpr int kLatBuckets = 14;
+
+struct LatencyHist {
+  std::atomic<int64_t> buckets[kLatBuckets + 1]{};
+  std::atomic<int64_t> sum_ns{0};
+  std::atomic<int64_t> count{0};
+
+  void Observe(int64_t ns) {
+    int64_t bound = 1000;  // 1 µs
+    int i = 0;
+    while (i < kLatBuckets && ns > bound) {
+      bound *= 4;
+      ++i;
+    }
+    buckets[i].fetch_add(1, std::memory_order_relaxed);
+    sum_ns.fetch_add(ns, std::memory_order_relaxed);
+    count.fetch_add(1, std::memory_order_relaxed);
+  }
+  void Reset() {
+    for (auto& b : buckets) b = 0;
+    sum_ns = 0;
+    count = 0;
+  }
+};
+
 struct EngineStats {
   std::atomic<int64_t> cycles{0};               // RunCycle iterations
   std::atomic<int64_t> tensors_submitted{0};    // client Submit() calls
@@ -65,6 +94,16 @@ struct EngineStats {
   std::atomic<int64_t> stall_events{0};         // stall-inspector warnings
   std::atomic<int64_t> exec_ns[kStatsOps]{};    // per-OpType execution ns
   std::atomic<int64_t> exec_count[kStatsOps]{};
+  // TCP data-plane wire telemetry. Owned HERE (not by the DataPlane,
+  // which Shutdown destroys) so scrape threads polling hvt_engine_stats
+  // can never race a teardown; the DataPlane writes through bound
+  // pointers (DataPlane::BindTxCounters).
+  std::atomic<int64_t> wire_tx_bytes[kStatsOps]{};
+  std::atomic<int64_t> wire_tx_comp_bytes[kStatsOps]{};
+  LatencyHist cycle_hist;   // RunCycle wall time (includes the
+                            // control-plane wait for peers)
+  LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
+                            // of the event-driven loop
 
   void Reset() {
     cycles = tensors_submitted = tensors_coordinated = 0;
@@ -73,7 +112,11 @@ struct EngineStats {
     for (int i = 0; i < kStatsOps; ++i) {
       exec_ns[i] = 0;
       exec_count[i] = 0;
+      wire_tx_bytes[i] = 0;
+      wire_tx_comp_bytes[i] = 0;
     }
+    cycle_hist.Reset();
+    wakeup_hist.Reset();
   }
 };
 
@@ -133,6 +176,22 @@ class Engine {
   // introspection for tests asserting fusion behavior
   int64_t data_ops() const { return data_ops_.load(); }
   const EngineStats& stats() const { return stats_; }
+  // wire telemetry from the TCP data plane — reads the stats block, not
+  // data_, so scrapes stay safe across Shutdown (0 for a bad op)
+  int64_t wire_tx_bytes(int op) const {
+    return (op >= 0 && op < kStatsOps)
+               ? stats_.wire_tx_bytes[op].load(std::memory_order_relaxed)
+               : 0;
+  }
+  int64_t wire_tx_comp_bytes(int op) const {
+    return (op >= 0 && op < kStatsOps)
+               ? stats_.wire_tx_comp_bytes[op].load(
+                     std::memory_order_relaxed)
+               : 0;
+  }
+  // configured wire codec (WireCodec wire id; rank 0's value governs the
+  // gang — workers follow the per-response stamp)
+  int wire_mode() const { return wire_mode_; }
   EventRing& events() { return events_; }
   // JSON stall/queue snapshot for hvt_diagnostics (thread-safe).
   std::string DiagnosticsJson();
@@ -148,7 +207,12 @@ class Engine {
  private:
   Engine() = default;
   void ThreadLoop();
-  bool RunCycle();  // false → exit loop
+  // false → exit loop. Sets progressed when the cycle drained a
+  // submission or executed a response, and outstanding when
+  // negotiations remain open — the event-driven loop runs back-to-back
+  // cycles while progressing (and, within a grace window, while
+  // outstanding).
+  bool RunCycle(bool& progressed, bool& outstanding);
   void ExecuteResponse(const Response& resp,
                        std::map<std::string, EntryPtr>& pending);
   void CompleteEntry(const EntryPtr& e, const Status& s);
@@ -204,7 +268,13 @@ class Engine {
   std::thread thread_;
 
   std::mutex queue_mu_;
+  // Signaled by Submit (and Shutdown): the event-driven cycle loop
+  // wakes immediately instead of finishing a cycle_ms sleep, so
+  // cycle_ms is the MAX coalescing wait, not a latency floor.
+  std::condition_variable queue_cv_;
   std::deque<EntryPtr> submitted_;
+  bool event_driven_ = true;  // HVT_EVENT_DRIVEN (0 → legacy sleep loop)
+  uint8_t wire_mode_ = 0;     // HVT_WIRE_COMPRESSION (WireCodec wire id)
 
   std::mutex handles_mu_;
   std::condition_variable handles_cv_;

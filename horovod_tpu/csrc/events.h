@@ -43,6 +43,8 @@ enum class EventKind : int32_t {
   CYCLE = 8,            // a cycle that executed `arg` responses
   STALL = 9,            // stall inspector fired; arg = seconds waiting,
                         // arg2 = missing-rank bitmask (ranks < 64)
+  WAKEUP = 10,          // event-driven cycle drained `arg` submissions;
+                        // arg2 = submit→drain coalescing latency (µs)
 };
 
 // POD view of one event — mirrored field-for-field by the ctypes

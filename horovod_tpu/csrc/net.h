@@ -7,13 +7,16 @@
 #pragma once
 
 #include <arpa/inet.h>
+#include <errno.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <stdexcept>
@@ -21,6 +24,22 @@
 #include <vector>
 
 namespace hvt {
+
+// HVT_SOCK_BUF: explicit SO_SNDBUF/SO_RCVBUF for every data/control
+// socket (bytes; 0/unset → kernel autotuning). Large rings on fat pipes
+// want this well above the payload chunk size so the nonblocking duplex
+// pump can keep both directions moving while the reduce runs.
+inline void ConfigureSockBufs(int fd) {
+  static const long buf = [] {
+    const char* v = getenv("HVT_SOCK_BUF");
+    return v ? atol(v) : 0L;
+  }();
+  if (buf > 0) {
+    int b = static_cast<int>(buf);
+    setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &b, sizeof(b));
+    setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &b, sizeof(b));
+  }
+}
 
 class Sock {
  public:
@@ -65,11 +84,53 @@ class Sock {
       n -= static_cast<size_t>(k);
     }
   }
-  // Length-prefixed frames for control messages.
+  // Nonblocking best-effort send/recv (MSG_DONTWAIT — the socket itself
+  // stays blocking for SendAll/RecvAll users). Return bytes moved, 0 when
+  // the operation would block; throw on a lost peer.
+  size_t SendSome(const void* data, size_t n) const {
+    ssize_t k = ::send(fd_, data, n, MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (k >= 0) return static_cast<size_t>(k);
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    throw std::runtime_error("hvt: send failed (peer lost)");
+  }
+  size_t RecvSome(void* data, size_t n) const {
+    ssize_t k = ::recv(fd_, data, n, MSG_DONTWAIT);
+    if (k > 0) return static_cast<size_t>(k);
+    if (k == 0) throw std::runtime_error("hvt: recv failed (peer lost)");
+    if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
+    throw std::runtime_error("hvt: recv failed (peer lost)");
+  }
+  // Length-prefixed frames for control messages. A vectored send
+  // coalesces the 8-byte header with the payload into one syscall/TCP
+  // segment — two separate send()s cost a spare syscall per frame and,
+  // without TCP_NODELAY, a Nagle stall. sendmsg (not writev) so
+  // MSG_NOSIGNAL applies: a lost peer must surface as the catchable
+  // "peer lost" error, not SIGPIPE.
   void SendFrame(const std::vector<uint8_t>& b) const {
     uint64_t n = b.size();
-    SendAll(&n, 8);
-    if (n) SendAll(b.data(), n);
+    struct iovec iov[2];
+    iov[0].iov_base = &n;
+    iov[0].iov_len = 8;
+    iov[1].iov_base = const_cast<uint8_t*>(b.data());
+    iov[1].iov_len = b.size();
+    struct msghdr msg {};
+    msg.msg_iov = iov;
+    msg.msg_iovlen = n ? 2 : 1;
+    size_t total = 8 + b.size();
+    ssize_t k = ::sendmsg(fd_, &msg, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno != EINTR)
+        throw std::runtime_error("hvt: send failed (peer lost)");
+      k = 0;  // interrupted before any byte moved: finish byte-wise
+    }
+    if (static_cast<size_t>(k) == total) return;
+    // short write (socket buffer full mid-frame): finish byte-wise
+    size_t done = static_cast<size_t>(k);
+    if (done < 8) {
+      SendAll(reinterpret_cast<const uint8_t*>(&n) + done, 8 - done);
+      done = 8;
+    }
+    if (done - 8 < b.size()) SendAll(b.data() + (done - 8), b.size() - (done - 8));
   }
   std::vector<uint8_t> RecvFrame() const {
     uint64_t n = 0;
@@ -104,6 +165,7 @@ class Sock {
                                " timed out");
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    ConfigureSockBufs(fd);
     return Sock(fd);
   }
 
@@ -137,6 +199,7 @@ class Listener {
     if (c < 0) throw std::runtime_error("hvt: accept failed");
     int one = 1;
     setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    ConfigureSockBufs(c);
     return Sock(c);
   }
   int port() const { return port_; }

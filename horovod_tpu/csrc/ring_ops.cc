@@ -1,5 +1,7 @@
 #include "ring_ops.h"
 
+#include <poll.h>
+
 #include <algorithm>
 #include <cmath>
 #include <cstring>
@@ -67,48 +69,102 @@ static inline uint16_t FloatToBf16(float v) {
   return static_cast<uint16_t>((f + rounding) >> 16);
 }
 
-// ---- elementwise reductions ------------------------------------------------
+// ---- bf16 wire codec -------------------------------------------------------
 
-template <typename T>
-static void ReduceTyped(T* dst, const T* src, int64_t n, ReduceKind red) {
+void CompressBf16(uint16_t* dst, const float* src, int64_t n) {
+  uint16_t* __restrict d = dst;
+  const float* __restrict s = src;
+  for (int64_t i = 0; i < n; ++i) d[i] = FloatToBf16(s[i]);
+}
+
+void DecompressBf16(float* dst, const uint16_t* src, int64_t n) {
+  float* __restrict d = dst;
+  const uint16_t* __restrict s = src;
+  for (int64_t i = 0; i < n; ++i) d[i] = Bf16ToFloat(s[i]);
+}
+
+void RoundtripBf16(float* dst, int64_t n) {
+  float* __restrict d = dst;
+  for (int64_t i = 0; i < n; ++i) d[i] = Bf16ToFloat(FloatToBf16(d[i]));
+}
+
+// dst (fp32) op= widen(src bf16) — the compressed-wire reduce step,
+// fused so the widened chunk never needs its own scratch pass.
+static void ReduceFromBf16(float* dst, const uint16_t* src, int64_t n,
+                           ReduceKind red) {
+  float* __restrict d = dst;
+  const uint16_t* __restrict s = src;
   switch (red) {
-    case ReduceKind::SUM:
-    case ReduceKind::AVERAGE:  // averaged via postscale after the ring
-    case ReduceKind::ADASUM:   // engine lowers adasum to scalar+sum phases
-      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] + src[i];
-      break;
     case ReduceKind::MIN:
-      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      for (int64_t i = 0; i < n; ++i) d[i] = std::min(d[i], Bf16ToFloat(s[i]));
       break;
     case ReduceKind::MAX:
-      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      for (int64_t i = 0; i < n; ++i) d[i] = std::max(d[i], Bf16ToFloat(s[i]));
       break;
     case ReduceKind::PRODUCT:
-      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] * src[i];
+      for (int64_t i = 0; i < n; ++i) d[i] *= Bf16ToFloat(s[i]);
+      break;
+    default:  // SUM / AVERAGE / ADASUM phases
+      for (int64_t i = 0; i < n; ++i) d[i] += Bf16ToFloat(s[i]);
       break;
   }
 }
 
+// ---- elementwise reductions ------------------------------------------------
+
+template <typename T>
+static void ReduceTyped(T* dst, const T* src, int64_t n, ReduceKind red) {
+  // restrict-qualified contiguous loops with the switch hoisted out —
+  // each case body is a straight-line loop the compiler can vectorize
+  T* __restrict d = dst;
+  const T* __restrict s = src;
+  switch (red) {
+    case ReduceKind::SUM:
+    case ReduceKind::AVERAGE:  // averaged via postscale after the ring
+    case ReduceKind::ADASUM:   // engine lowers adasum to scalar+sum phases
+      for (int64_t i = 0; i < n; ++i) d[i] = d[i] + s[i];
+      break;
+    case ReduceKind::MIN:
+      for (int64_t i = 0; i < n; ++i) d[i] = std::min(d[i], s[i]);
+      break;
+    case ReduceKind::MAX:
+      for (int64_t i = 0; i < n; ++i) d[i] = std::max(d[i], s[i]);
+      break;
+    case ReduceKind::PRODUCT:
+      for (int64_t i = 0; i < n; ++i) d[i] = d[i] * s[i];
+      break;
+  }
+}
+
+// fp16/bf16: widen a block to fp32, reduce, narrow — block staging (vs
+// per-scalar through float) keeps the convert and combine loops
+// independently vectorizable and the working set in L1.
 template <typename T, float (*ToF)(T), T (*FromF)(float)>
 static void ReduceHalfTyped(T* dst, const T* src, int64_t n,
                             ReduceKind red) {
-  for (int64_t i = 0; i < n; ++i) {
-    float a = ToF(dst[i]), b = ToF(src[i]), r;
+  constexpr int64_t kBlk = 128;
+  float a[kBlk], b[kBlk];
+  T* __restrict dd = dst;
+  const T* __restrict ss = src;
+  for (int64_t base = 0; base < n; base += kBlk) {
+    const int64_t m = std::min(kBlk, n - base);
+    for (int64_t i = 0; i < m; ++i) a[i] = ToF(dd[base + i]);
+    for (int64_t i = 0; i < m; ++i) b[i] = ToF(ss[base + i]);
     switch (red) {
       case ReduceKind::MIN:
-        r = std::min(a, b);
+        for (int64_t i = 0; i < m; ++i) a[i] = std::min(a[i], b[i]);
         break;
       case ReduceKind::MAX:
-        r = std::max(a, b);
+        for (int64_t i = 0; i < m; ++i) a[i] = std::max(a[i], b[i]);
         break;
       case ReduceKind::PRODUCT:
-        r = a * b;
+        for (int64_t i = 0; i < m; ++i) a[i] *= b[i];
         break;
       default:
-        r = a + b;
+        for (int64_t i = 0; i < m; ++i) a[i] += b[i];
         break;
     }
-    dst[i] = FromF(r);
+    for (int64_t i = 0; i < m; ++i) dd[base + i] = FromF(a[i]);
   }
 }
 
@@ -140,15 +196,13 @@ void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
                   static_cast<const int8_t*>(src), count, red);
       break;
     case DataType::BOOL: {
-      auto* d = static_cast<uint8_t*>(dst);
-      auto* s = static_cast<const uint8_t*>(src);
+      auto* __restrict d = static_cast<uint8_t*>(dst);
+      auto* __restrict s = static_cast<const uint8_t*>(src);
       // bool sum == logical or; product/min == and; max == or
-      for (int64_t i = 0; i < count; ++i) {
-        bool a = d[i], b = s[i];
-        bool r = (red == ReduceKind::MIN || red == ReduceKind::PRODUCT)
-                     ? (a && b)
-                     : (a || b);
-        d[i] = r ? 1 : 0;
+      if (red == ReduceKind::MIN || red == ReduceKind::PRODUCT) {
+        for (int64_t i = 0; i < count; ++i) d[i] = (d[i] && s[i]) ? 1 : 0;
+      } else {
+        for (int64_t i = 0; i < count; ++i) d[i] = (d[i] || s[i]) ? 1 : 0;
       }
       break;
     }
@@ -169,37 +223,54 @@ void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor) {
   if (factor == 1.0) return;
   switch (dtype) {
     case DataType::FLOAT32: {
-      auto* d = static_cast<float*>(dst);
-      for (int64_t i = 0; i < count; ++i) d[i] *= static_cast<float>(factor);
+      auto* __restrict d = static_cast<float*>(dst);
+      const float f = static_cast<float>(factor);
+      for (int64_t i = 0; i < count; ++i) d[i] *= f;
       break;
     }
     case DataType::FLOAT64: {
-      auto* d = static_cast<double*>(dst);
+      auto* __restrict d = static_cast<double*>(dst);
       for (int64_t i = 0; i < count; ++i) d[i] *= factor;
       break;
     }
     case DataType::FLOAT16: {
-      auto* d = static_cast<uint16_t*>(dst);
-      for (int64_t i = 0; i < count; ++i)
-        d[i] = FloatToHalf(HalfToFloat(d[i]) * static_cast<float>(factor));
+      auto* __restrict d = static_cast<uint16_t*>(dst);
+      constexpr int64_t kBlk = 128;
+      float a[kBlk];
+      const float f = static_cast<float>(factor);
+      for (int64_t base = 0; base < count; base += kBlk) {
+        const int64_t m = std::min(kBlk, count - base);
+        for (int64_t i = 0; i < m; ++i) a[i] = HalfToFloat(d[base + i]);
+        for (int64_t i = 0; i < m; ++i) a[i] *= f;
+        for (int64_t i = 0; i < m; ++i) d[base + i] = FloatToHalf(a[i]);
+      }
       break;
     }
     case DataType::BFLOAT16: {
-      auto* d = static_cast<uint16_t*>(dst);
-      for (int64_t i = 0; i < count; ++i)
-        d[i] = FloatToBf16(Bf16ToFloat(d[i]) * static_cast<float>(factor));
+      auto* __restrict d = static_cast<uint16_t*>(dst);
+      constexpr int64_t kBlk = 128;
+      float a[kBlk];
+      const float f = static_cast<float>(factor);
+      for (int64_t base = 0; base < count; base += kBlk) {
+        const int64_t m = std::min(kBlk, count - base);
+        for (int64_t i = 0; i < m; ++i) a[i] = Bf16ToFloat(d[base + i]);
+        for (int64_t i = 0; i < m; ++i) a[i] *= f;
+        for (int64_t i = 0; i < m; ++i) d[base + i] = FloatToBf16(a[i]);
+      }
       break;
     }
     case DataType::INT32: {
-      auto* d = static_cast<int32_t*>(dst);
+      // round, don't truncate: an integral allreduce averaged over N or
+      // prescaled by a non-integral factor must not bias toward zero
+      auto* __restrict d = static_cast<int32_t*>(dst);
       for (int64_t i = 0; i < count; ++i)
-        d[i] = static_cast<int32_t>(d[i] * factor);
+        d[i] = static_cast<int32_t>(std::llround(d[i] * factor));
       break;
     }
     case DataType::INT64: {
-      auto* d = static_cast<int64_t*>(dst);
+      auto* __restrict d = static_cast<int64_t*>(dst);
       for (int64_t i = 0; i < count; ++i)
-        d[i] = static_cast<int64_t>(d[i] * factor);
+        d[i] = static_cast<int64_t>(std::llround(d[i] * factor));
       break;
     }
     default:
@@ -207,21 +278,90 @@ void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor) {
   }
 }
 
+// ---- transport pump --------------------------------------------------------
+
+DataPlane::DataPlane(int rank, int size, std::vector<Sock> peers)
+    : rank_(rank), size_(size), peers_(std::move(peers)) {
+  pipeline_ = EnvInt("HVT_RING_PIPELINE", 1) != 0;
+  // 1 MB default: measured sweet spot on loopback gangs — small enough
+  // to overlap reduce with transfer, large enough that poll/reduce
+  // interleaving overhead stays negligible (see docs/performance.md)
+  chunk_bytes_ = EnvInt("HVT_RING_CHUNK_BYTES", 1 << 20);
+  if (chunk_bytes_ < 64) chunk_bytes_ = 64;
+}
+
+void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
+                       Sock& in, uint8_t* recv_buf, size_t recv_n,
+                       size_t chunk_bytes, bool compressed,
+                       const std::function<void(size_t, size_t)>& on_chunk) {
+  size_t sent = 0, rcvd = 0, notified = 0;
+  auto flush_chunks = [&] {
+    while ((rcvd - notified >= chunk_bytes) ||
+           (rcvd == recv_n && notified < recv_n)) {
+      size_t len = std::min(chunk_bytes, recv_n - notified);
+      if (on_chunk) on_chunk(notified, len);
+      notified += len;
+    }
+  };
+  while (sent < send_n || rcvd < recv_n) {
+    struct pollfd fds[2];
+    // a COMPLETED direction is masked with fd = -1 (poll ignores
+    // negative fds) — events = 0 would not suppress POLLERR/POLLHUP,
+    // which nothing here consumes once the direction is done, and an
+    // unconsumed error event would spin the loop
+    fds[0].fd = sent < send_n ? out.fd() : -1;
+    fds[0].events = POLLOUT;
+    fds[0].revents = 0;
+    fds[1].fd = rcvd < recv_n ? in.fd() : -1;
+    fds[1].events = POLLIN;
+    fds[1].revents = 0;
+    if (::poll(fds, 2, -1) < 0) {
+      if (errno == EINTR) continue;
+      throw std::runtime_error("hvt: poll failed on data socket");
+    }
+    // service BOTH socket directions before doing any reduce work: the
+    // peer must never sit idle behind our compute. The recv is capped
+    // per iteration so a fast sender cannot monopolize the loop either.
+    if (rcvd < recv_n &&
+        (fds[1].revents & (POLLIN | POLLERR | POLLHUP))) {
+      size_t want = std::min(recv_n - rcvd, 2 * chunk_bytes);
+      rcvd += in.RecvSome(recv_buf + rcvd, want);
+    }
+    if (sent < send_n &&
+        (fds[0].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      sent += out.SendSome(send_buf + sent, send_n - sent);
+    }
+    // reduce completed chunks last, overlapping the in-flight transfer
+    // (the kernel keeps streaming into/out of the socket buffers while
+    // this runs)
+    flush_chunks();
+  }
+  flush_chunks();
+  CountTx(send_n, compressed);
+}
+
 // ---- collectives -----------------------------------------------------------
 
 void DataPlane::RingReduceScatter(uint8_t* bytes,
                                   const std::vector<int64_t>& seg_off,
                                   size_t el, DataType dtype, ReduceKind red,
-                                  const std::vector<int>& group) {
+                                  const std::vector<int>& group,
+                                  WireCodec wire) {
   const int l = static_cast<int>(group.size());
   if (l == 1) return;
   const int idx = GroupIndexOf(group, rank_);
   const int next = group[(idx + 1) % l];
   const int prev = group[(idx + l - 1) % l];
+  const bool comp = wire == WireCodec::BF16 && el == 4;
+  const size_t wel = comp ? 2 : el;  // bytes per element on the wire
   int64_t max_seg = 0;
   for (int i = 0; i < l; ++i)
     max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
-  scratch_.resize(static_cast<size_t>(max_seg) * el);
+  scratch_.resize(static_cast<size_t>(max_seg) * wel);
+  if (comp) wire_send_.resize(static_cast<size_t>(max_seg) * wel);
+  // element-aligned chunking so each completed chunk reduces in place
+  const size_t chunk =
+      std::max<size_t>(wel, (static_cast<size_t>(chunk_bytes_) / wel) * wel);
 
   // after l-1 steps, group index i owns fully-reduced segment (i+1) % l
   for (int step = 0; step < l - 1; ++step) {
@@ -229,70 +369,171 @@ void DataPlane::RingReduceScatter(uint8_t* bytes,
     int recv_seg = (idx - step - 1 + l) % l;
     int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
     int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
-    // full-duplex: send to next, recv from prev (index parity ordering
-    // avoids head-of-line deadlock on blocking sockets for small frames)
-    if (idx % 2 == 0) {
-      peer(next).SendAll(bytes + seg_off[send_seg] * el,
-                         static_cast<size_t>(send_n) * el);
-      peer(prev).RecvAll(scratch_.data(), static_cast<size_t>(recv_n) * el);
-    } else {
-      peer(prev).RecvAll(scratch_.data(), static_cast<size_t>(recv_n) * el);
-      peer(next).SendAll(bytes + seg_off[send_seg] * el,
-                         static_cast<size_t>(send_n) * el);
+    const uint8_t* sp = bytes + seg_off[send_seg] * el;
+    if (comp) {
+      CompressBf16(reinterpret_cast<uint16_t*>(wire_send_.data()),
+                   reinterpret_cast<const float*>(sp), send_n);
+      sp = wire_send_.data();
     }
-    ReduceInto(bytes + seg_off[recv_seg] * el, scratch_.data(), recv_n,
-               dtype, red);
+    uint8_t* dst_seg = bytes + seg_off[recv_seg] * el;
+    auto reduce_chunk = [&](size_t off, size_t len) {
+      if (comp)
+        ReduceFromBf16(
+            reinterpret_cast<float*>(dst_seg) + off / 2,
+            reinterpret_cast<const uint16_t*>(scratch_.data() + off),
+            static_cast<int64_t>(len / 2), red);
+      else
+        ReduceInto(dst_seg + off, scratch_.data() + off,
+                   static_cast<int64_t>(len / el), dtype, red);
+    };
+    if (pipeline_) {
+      Duplex(peer(next), sp, static_cast<size_t>(send_n) * wel, peer(prev),
+             scratch_.data(), static_cast<size_t>(recv_n) * wel, chunk,
+             comp, reduce_chunk);
+    } else {
+      // blocking baseline: full-duplex via index-parity ordering (avoids
+      // head-of-line deadlock for frames below the socket buffer size)
+      if (idx % 2 == 0) {
+        SendCounted(peer(next), sp, static_cast<size_t>(send_n) * wel, comp);
+        peer(prev).RecvAll(scratch_.data(),
+                           static_cast<size_t>(recv_n) * wel);
+      } else {
+        peer(prev).RecvAll(scratch_.data(),
+                           static_cast<size_t>(recv_n) * wel);
+        SendCounted(peer(next), sp, static_cast<size_t>(send_n) * wel, comp);
+      }
+      if (recv_n > 0)
+        reduce_chunk(0, static_cast<size_t>(recv_n) * wel);
+    }
   }
 }
 
 void DataPlane::RingAllgatherSegs(uint8_t* bytes,
                                   const std::vector<int64_t>& seg_off,
                                   size_t el,
-                                  const std::vector<int>& group) {
+                                  const std::vector<int>& group,
+                                  WireCodec wire) {
   const int l = static_cast<int>(group.size());
   if (l == 1) return;
   const int idx = GroupIndexOf(group, rank_);
   const int next = group[(idx + 1) % l];
   const int prev = group[(idx + l - 1) % l];
+  const bool comp = wire == WireCodec::BF16 && el == 4;
+  const size_t wel = comp ? 2 : el;
+  const size_t chunk =
+      std::max<size_t>(wel, (static_cast<size_t>(chunk_bytes_) / wel) * wel);
+  if (comp) {
+    int64_t max_seg = 0;
+    for (int i = 0; i < l; ++i)
+      max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
+    wire_send_.resize(static_cast<size_t>(max_seg) * wel);
+    wire_recv_.resize(static_cast<size_t>(max_seg) * wel);
+  }
   for (int step = 0; step < l - 1; ++step) {
     int send_seg = (idx + 1 - step + l) % l;
     int recv_seg = (idx - step + l) % l;
     int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
     int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
-    if (idx % 2 == 0) {
-      peer(next).SendAll(bytes + seg_off[send_seg] * el,
-                         static_cast<size_t>(send_n) * el);
+    if (comp) {
+      // step 0 compresses the owned segment; later steps forward the
+      // compressed form received last step (no recompression, and the
+      // values stay identical at every hop)
+      if (step == 0)
+        CompressBf16(
+            reinterpret_cast<uint16_t*>(wire_send_.data()),
+            reinterpret_cast<const float*>(bytes + seg_off[send_seg] * el),
+            send_n);
+      float* dst = reinterpret_cast<float*>(bytes + seg_off[recv_seg] * el);
+      auto widen_chunk = [&](size_t off, size_t len) {
+        DecompressBf16(dst + off / 2,
+                       reinterpret_cast<const uint16_t*>(
+                           wire_recv_.data() + off),
+                       static_cast<int64_t>(len / 2));
+      };
+      if (pipeline_) {
+        Duplex(peer(next), wire_send_.data(),
+               static_cast<size_t>(send_n) * wel, peer(prev),
+               wire_recv_.data(), static_cast<size_t>(recv_n) * wel, chunk,
+               true, widen_chunk);
+      } else {
+        if (idx % 2 == 0) {
+          SendCounted(peer(next), wire_send_.data(),
+                      static_cast<size_t>(send_n) * wel, true);
+          peer(prev).RecvAll(wire_recv_.data(),
+                             static_cast<size_t>(recv_n) * wel);
+        } else {
+          peer(prev).RecvAll(wire_recv_.data(),
+                             static_cast<size_t>(recv_n) * wel);
+          SendCounted(peer(next), wire_send_.data(),
+                      static_cast<size_t>(send_n) * wel, true);
+        }
+        if (recv_n > 0) widen_chunk(0, static_cast<size_t>(recv_n) * wel);
+      }
+      std::swap(wire_send_, wire_recv_);
+      continue;
+    }
+    if (pipeline_) {
+      Duplex(peer(next), bytes + seg_off[send_seg] * el,
+             static_cast<size_t>(send_n) * el, peer(prev),
+             bytes + seg_off[recv_seg] * el,
+             static_cast<size_t>(recv_n) * el, chunk, false, nullptr);
+    } else if (idx % 2 == 0) {
+      SendCounted(peer(next), bytes + seg_off[send_seg] * el,
+                  static_cast<size_t>(send_n) * el, false);
       peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
                          static_cast<size_t>(recv_n) * el);
     } else {
       peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
                          static_cast<size_t>(recv_n) * el);
-      peer(next).SendAll(bytes + seg_off[send_seg] * el,
-                         static_cast<size_t>(send_n) * el);
+      SendCounted(peer(next), bytes + seg_off[send_seg] * el,
+                  static_cast<size_t>(send_n) * el, false);
     }
   }
 }
 
 void DataPlane::AllreduceGroup(void* buf, int64_t count, DataType dtype,
                                ReduceKind red,
-                               const std::vector<int>& group) {
-  if (group.size() == 1 || count == 0) return;
+                               const std::vector<int>& group,
+                               double postscale, WireCodec wire) {
+  if (group.size() == 1 || count == 0) {
+    if (postscale != 1.0) ScaleBuffer(buf, count, dtype, postscale);
+    return;
+  }
   const size_t el = DataTypeSize(dtype);
   auto* bytes = static_cast<uint8_t*>(buf);
   const int l = static_cast<int>(group.size());
+  const bool comp = wire == WireCodec::BF16 && dtype == DataType::FLOAT32;
   // segment boundaries (element granularity)
   std::vector<int64_t> seg_off(l + 1);
   for (int i = 0; i <= l; ++i) seg_off[i] = count * i / l;
-  RingReduceScatter(bytes, seg_off, el, dtype, red, group);
-  RingAllgatherSegs(bytes, seg_off, el, group);
+  RingReduceScatter(bytes, seg_off, el, dtype, red, group,
+                    comp ? WireCodec::BF16 : WireCodec::RAW);
+  // postscale folds into the allgather: each rank scales only the one
+  // segment it owns fully-reduced, and the rotation distributes scaled
+  // data — 1/l of the scalar work and no separate full-buffer sweep
+  const int idx = GroupIndexOf(group, rank_);
+  const int own = (idx + 1) % l;
+  const int64_t own_n = seg_off[own + 1] - seg_off[own];
+  if (postscale != 1.0)
+    ScaleBuffer(bytes + seg_off[own] * el, own_n, dtype, postscale);
+  if (comp)
+    // truncate the owned segment exactly as peers will decompress it, so
+    // every rank's final buffer is bit-identical
+    RoundtripBf16(reinterpret_cast<float*>(bytes + seg_off[own] * el),
+                  own_n);
+  RingAllgatherSegs(bytes, seg_off, el, group,
+                    comp ? WireCodec::BF16 : WireCodec::RAW);
 }
 
 void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
-                          ReduceKind red) {
-  if (size_ == 1 || count == 0) return;
+                          ReduceKind red, double postscale, WireCodec wire) {
+  if (size_ == 1 || count == 0) {
+    if (postscale != 1.0) ScaleBuffer(buf, count, dtype, postscale);
+    return;
+  }
   std::vector<int> all(size_);
   for (int i = 0; i < size_; ++i) all[i] = i;
-  AllreduceGroup(buf, count, dtype, red, all);
+  AllreduceGroup(buf, count, dtype, red, all, postscale, wire);
 }
 
 void DataPlane::AllgathervGroup(const void* in, int64_t my_rows,
@@ -310,6 +551,7 @@ void DataPlane::AllgathervGroup(const void* in, int64_t my_rows,
   if (m == 1) return;
   const int next = group[(idx + 1) % m];
   const int prev = group[(idx + m - 1) % m];
+  const size_t chunk = static_cast<size_t>(chunk_bytes_);
   // ring rotation: at step s, send the block originally from position
   // (idx - s) % m, receive the block from (idx - s - 1) % m
   for (int step = 0; step < m - 1; ++step) {
@@ -317,12 +559,18 @@ void DataPlane::AllgathervGroup(const void* in, int64_t my_rows,
     int recv_blk = (idx - step - 1 + m) % m;
     size_t send_bytes = static_cast<size_t>(rows[send_blk]) * row_bytes;
     size_t recv_bytes = static_cast<size_t>(rows[recv_blk]) * row_bytes;
-    if (idx % 2 == 0) {
-      peer(next).SendAll(dst + offs[send_blk] * row_bytes, send_bytes);
+    if (pipeline_) {
+      Duplex(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
+             peer(prev), dst + offs[recv_blk] * row_bytes, recv_bytes,
+             chunk, false, nullptr);
+    } else if (idx % 2 == 0) {
+      SendCounted(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
+                  false);
       peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
     } else {
       peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
-      peer(next).SendAll(dst + offs[send_blk] * row_bytes, send_bytes);
+      SendCounted(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
+                  false);
     }
   }
 }
@@ -341,7 +589,7 @@ void DataPlane::BroadcastGroup(void* buf, int64_t bytes, int root,
   if (rank_ == root) {
     for (int r : group) {
       if (r == root) continue;
-      peer(r).SendAll(buf, static_cast<size_t>(bytes));
+      SendCounted(peer(r), buf, static_cast<size_t>(bytes), false);
     }
   } else {
     peer(root).RecvAll(buf, static_cast<size_t>(bytes));
@@ -372,18 +620,26 @@ void DataPlane::AlltoallvGroup(const void* in,
   // self block
   memcpy(dst + roff[idx] * row_bytes, src + soff[idx] * row_bytes,
          static_cast<size_t>(send_rows[idx]) * row_bytes);
-  // pairwise exchange, lower group position sends first
+  // pairwise exchange; the duplex pump moves both directions at once
+  // (the legacy path orders by group position to avoid deadlock)
   for (int opos = 0; opos < m; ++opos) {
     if (opos == idx) continue;
     int other = group[opos];
     size_t sb = static_cast<size_t>(send_rows[opos]) * row_bytes;
     size_t rb = static_cast<size_t>(recv_rows[opos]) * row_bytes;
-    if (idx < opos) {
-      if (sb) peer(other).SendAll(src + soff[opos] * row_bytes, sb);
+    if (pipeline_) {
+      if (sb || rb)
+        Duplex(peer(other), src + soff[opos] * row_bytes, sb, peer(other),
+               dst + roff[opos] * row_bytes, rb,
+               static_cast<size_t>(chunk_bytes_), false, nullptr);
+    } else if (idx < opos) {
+      if (sb) SendCounted(peer(other), src + soff[opos] * row_bytes, sb,
+                          false);
       if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
     } else {
       if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
-      if (sb) peer(other).SendAll(src + soff[opos] * row_bytes, sb);
+      if (sb) SendCounted(peer(other), src + soff[opos] * row_bytes, sb,
+                          false);
     }
   }
 }

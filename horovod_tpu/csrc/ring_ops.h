@@ -5,23 +5,41 @@
 // allgatherv, broadcast, alltoallv), rebuilt without the gloo dependency:
 //
 // - allreduce: ring reduce-scatter + ring allgather (bandwidth-optimal,
-//   2(N-1)/N * bytes on the wire per rank).
-// - allgatherv: ring rotation, N-1 steps.
+//   2(N-1)/N * bytes on the wire per rank), pipelined: each ring step
+//   pumps both socket directions with nonblocking I/O + poll and reduces
+//   each received chunk while later chunks are still in flight, so
+//   recv(k+1) overlaps reduce(k) and send(k-1) instead of the serialized
+//   send → recv → reduce of a blocking ring. HVT_RING_CHUNK_BYTES sets
+//   the chunk (default 1 MB); HVT_RING_PIPELINE=0 restores the
+//   blocking parity-ordered ring (A/B baseline).
+// - allgatherv: ring rotation, N-1 steps, same duplex pump.
 // - broadcast: star from root (N is small on the eager path; the TPU data
 //   plane handles the large-N case in XLA).
 // - alltoallv: pairwise exchange, rank-ordered to avoid deadlock.
 //
 // fp16/bf16 are accumulated in fp32 (reference half.{h,cc} + the fused
 // scale kernels do the same widening).
+//
+// Wire compression: when a response is stamped WireCodec::BF16 (fp32
+// allreduce under HVT_WIRE_COMPRESSION=bf16), both ring phases move
+// bf16-truncated payloads — half the DCN bytes — and widen back to fp32
+// for the reduce. Every rank ends with bit-identical buffers: after the
+// reduce-scatter each rank round-trips its owned segment through bf16
+// before the allgather, so owners and receivers see the same values.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
+#include <functional>
 #include <vector>
 
 #include "common.h"
 #include "net.h"
 
 namespace hvt {
+
+// Per-OpType wire-telemetry slots (OpType 0..6; mirrors engine kStatsOps).
+constexpr int kWireOps = 7;
 
 // Index of `rank` within an ascending rank group (throws if absent) —
 // shared by the ring phases and the topology builder (backends.cc).
@@ -34,32 +52,41 @@ inline int GroupIndexOf(const std::vector<int>& group, int rank) {
 class DataPlane {
  public:
   // peers: socket per rank (peers[self] unused/invalid).
-  DataPlane(int rank, int size, std::vector<Sock> peers)
-      : rank_(rank), size_(size), peers_(std::move(peers)) {}
+  DataPlane(int rank, int size, std::vector<Sock> peers);
 
   int rank() const { return rank_; }
   int size() const { return size_; }
 
-  void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red);
+  // postscale is folded into the final allgather pass: each rank scales
+  // the one segment it owns fully-reduced (1/N of the scalar work) and
+  // the rotation distributes scaled data — no separate full-buffer sweep.
+  void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
+                 double postscale = 1.0, WireCodec wire = WireCodec::RAW);
   // Group-parameterized ring collective over a subset of ranks (ascending
   // global ranks, must contain this rank). Disjoint groups may run
   // concurrently — the mesh is pairwise, so their traffic never crosses.
   // Building block of the hierarchical LOCAL/CROSS composition
   // (backends.h).
   void AllreduceGroup(void* buf, int64_t count, DataType dtype,
-                      ReduceKind red, const std::vector<int>& group);
+                      ReduceKind red, const std::vector<int>& group,
+                      double postscale = 1.0,
+                      WireCodec wire = WireCodec::RAW);
   // Ring reduce-scatter phase: after it, the rank at group index i owns
   // fully-reduced segment (i+1) % |group| of `bytes` (segments given by
-  // seg_off, element size el).
+  // seg_off, element size el). wire == BF16 requires el == 4 (fp32).
   void RingReduceScatter(uint8_t* bytes,
                          const std::vector<int64_t>& seg_off, size_t el,
                          DataType dtype, ReduceKind red,
-                         const std::vector<int>& group);
+                         const std::vector<int>& group,
+                         WireCodec wire = WireCodec::RAW);
   // Ring allgather phase rotating owned segments (inverse of the above's
   // ownership: entering, group index i holds segment (i+1) % |group|).
+  // With BF16 wire, received segments are forwarded in compressed form
+  // (no recompression at intermediate hops).
   void RingAllgatherSegs(uint8_t* bytes,
                          const std::vector<int64_t>& seg_off, size_t el,
-                         const std::vector<int>& group);
+                         const std::vector<int>& group,
+                         WireCodec wire = WireCodec::RAW);
   // rows per rank along dim 0; row_bytes = bytes of one row.
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,
@@ -84,17 +111,71 @@ class DataPlane {
                       const std::vector<int64_t>& recv_rows,
                       const std::vector<int>& group);
 
+  // ---- wire telemetry (hvt_engine_stats → metrics plane) --------------
+  // The engine stamps the OpType before dispatching a response; every
+  // byte this plane sends is attributed to it. The counters themselves
+  // are OWNED BY THE CALLER (the engine's stats block, which outlives
+  // this object) and bound here — scrape threads must be able to read
+  // them while Shutdown destroys the DataPlane. Arrays of kWireOps
+  // relaxed atomics.
+  void BindTxCounters(std::atomic<int64_t>* tx,
+                      std::atomic<int64_t>* tx_comp) {
+    tx_sink_ = tx;
+    txc_sink_ = tx_comp;
+  }
+  void set_stat_op(int op) {
+    stat_op_ = (op >= 0 && op < kWireOps) ? op : 0;
+  }
+
  private:
   Sock& peer(int r) { return peers_[static_cast<size_t>(r)]; }
+  void CountTx(size_t n, bool compressed) {
+    if (!tx_sink_) return;
+    tx_sink_[stat_op_].fetch_add(static_cast<int64_t>(n),
+                                 std::memory_order_relaxed);
+    if (compressed)
+      txc_sink_[stat_op_].fetch_add(static_cast<int64_t>(n),
+                                    std::memory_order_relaxed);
+  }
+  void SendCounted(Sock& s, const void* data, size_t n, bool compressed) {
+    s.SendAll(data, n);
+    CountTx(n, compressed);
+  }
+  // Full-duplex pump: stream send_n bytes to `out` while receiving
+  // recv_n bytes from `in` (nonblocking + poll, so neither direction
+  // head-of-line blocks the other); on_chunk(byte_off, byte_len) fires
+  // as each chunk_bytes-sized piece of the receive completes, letting
+  // the reduce overlap the remaining transfer. `out` and `in` may be
+  // the same socket (2-member rings).
+  void Duplex(Sock& out, const uint8_t* send_buf, size_t send_n, Sock& in,
+              uint8_t* recv_buf, size_t recv_n, size_t chunk_bytes,
+              bool compressed,
+              const std::function<void(size_t, size_t)>& on_chunk);
+
   int rank_, size_;
   std::vector<Sock> peers_;
+  bool pipeline_ = true;        // HVT_RING_PIPELINE
+  int64_t chunk_bytes_ = 1 << 20;  // HVT_RING_CHUNK_BYTES
+  int stat_op_ = 0;             // engine-thread-only (set_stat_op)
+  std::atomic<int64_t>* tx_sink_ = nullptr;   // [kWireOps], caller-owned
+  std::atomic<int64_t>* txc_sink_ = nullptr;  // [kWireOps], caller-owned
   std::vector<uint8_t> scratch_;
+  std::vector<uint8_t> wire_send_, wire_recv_;  // compressed ping-pong
 };
 
 // Elementwise accumulate: dst = dst (op) src, for count elements.
 void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
                 ReduceKind red);
 // dst *= factor (no-op for factor 1.0); used for pre/postscale + Average.
+// Integer dtypes round to nearest (half away from zero) rather than
+// truncating toward zero.
 void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor);
+
+// bf16 wire codec helpers (fp32 payloads only).
+void CompressBf16(uint16_t* dst, const float* src, int64_t n);
+void DecompressBf16(float* dst, const uint16_t* src, int64_t n);
+// dst[i] = bf16_roundtrip(dst[i]) — truncate in place so the owner of a
+// segment matches what its peers decompressed.
+void RoundtripBf16(float* dst, int64_t n);
 
 }  // namespace hvt

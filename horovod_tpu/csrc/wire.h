@@ -58,6 +58,10 @@ struct Response {
   // process set the collective runs over (empty → global); non-member
   // ranks skip the response entirely
   std::vector<int64_t> members;
+  // wire codec for the data-plane transfer (WireCodec wire id), stamped
+  // by rank 0 so all participants compress/decompress identically;
+  // 0 = raw bytes
+  uint8_t wire = 0;
 };
 
 class Writer {
@@ -175,6 +179,7 @@ inline void EncodeResponse(Writer& w, const Response& r) {
   w.i64(r.trailing);
   w.i32(r.group_id);
   w.i64vec(r.members);
+  w.u8(r.wire);
 }
 
 inline Response DecodeResponse(Reader& rd) {
@@ -195,6 +200,7 @@ inline Response DecodeResponse(Reader& rd) {
   r.trailing = rd.i64();
   r.group_id = rd.i32();
   r.members = rd.i64vec();
+  r.wire = rd.u8();
   return r;
 }
 

@@ -148,24 +148,32 @@ def engine_data_ops() -> int:
 
 
 # hvt_engine_stats fixed layout (c_api.cc): scalar slots, then per-op
-# exec_ns and exec_count arrays indexed by OpType wire id.
+# exec_ns / exec_count / wire_tx_bytes / wire_tx_comp_bytes arrays
+# indexed by OpType wire id, then two engine-side latency histograms
+# (cycle duration, event-driven wakeup latency).
 STATS_SCALARS = ("cycles", "tensors_submitted", "tensors_coordinated",
                  "cache_hits", "cache_misses", "fusion_bytes",
                  "responses_fused", "stall_events")
 STATS_OPS = ("allreduce", "allgather", "broadcast", "alltoall",
              "reducescatter", "join", "barrier")
+# engine-side histogram shape: kLatBuckets (14) finite buckets with
+# upper bounds 1 µs * 4^i — the same bounds as
+# metrics.DEFAULT_LATENCY_BUCKETS — plus one +Inf slot
+STATS_LAT_BUCKETS = 14
 
 
 def engine_stats() -> dict:
     """Snapshot of the engine's atomic stats block (zeros-when-absent is
     the caller's concern — this returns {} when the library or symbol is
     missing). Values are monotonic within one engine run; Init resets
-    them, starting a new scrape epoch."""
+    them, starting a new scrape epoch. A stale .so that reports fewer
+    slots zero-fills the newer fields."""
     lib = _load()
     if lib is None or getattr(lib, "hvt_engine_stats", None) is None:
         return {}
     n_ops = len(STATS_OPS)
-    want = len(STATS_SCALARS) + 2 * n_ops
+    hist = STATS_LAT_BUCKETS + 1 + 2  # buckets + sum_ns + count
+    want = len(STATS_SCALARS) + 4 * n_ops + 2 * hist
     buf = (ctypes.c_longlong * want)()
     n = min(int(lib.hvt_engine_stats(buf, want)), want)
     vals = [int(buf[i]) for i in range(n)] + [0] * (want - n)
@@ -174,7 +182,29 @@ def engine_stats() -> dict:
     out["exec_ns"] = dict(zip(STATS_OPS, vals[base:base + n_ops]))
     out["exec_count"] = dict(
         zip(STATS_OPS, vals[base + n_ops:base + 2 * n_ops]))
+    out["wire_tx_bytes"] = dict(
+        zip(STATS_OPS, vals[base + 2 * n_ops:base + 3 * n_ops]))
+    out["wire_tx_comp_bytes"] = dict(
+        zip(STATS_OPS, vals[base + 3 * n_ops:base + 4 * n_ops]))
+    hbase = base + 4 * n_ops
+    for key in ("cycle_hist", "wakeup_hist"):
+        out[key] = {
+            "buckets": vals[hbase:hbase + STATS_LAT_BUCKETS + 1],
+            "sum_ns": vals[hbase + STATS_LAT_BUCKETS + 1],
+            "count": vals[hbase + STATS_LAT_BUCKETS + 2],
+        }
+        hbase += hist
     return out
+
+
+def wire_compression() -> int:
+    """Configured wire codec of this rank's engine (0 = raw, 1 = bf16);
+    rank 0's value governs the gang via per-response stamps. 0 when the
+    library or symbol is absent."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_wire_compression", None) is None:
+        return 0
+    return int(lib.hvt_wire_compression())
 
 
 # ---------------------------------------------------------------------------
@@ -199,7 +229,7 @@ assert ctypes.sizeof(EngineEvent) == 96, "EngineEvent ABI drift"
 # index == wire id (csrc/events.h EventKind)
 EVENT_KINDS = ("ENQUEUED", "NEGOTIATE_BEGIN", "NEGOTIATE_END",
                "RANK_READY", "FUSED", "EXEC_BEGIN", "EXEC_END", "DONE",
-               "CYCLE", "STALL")
+               "CYCLE", "STALL", "WAKEUP")
 
 
 def events_supported() -> bool:

@@ -116,6 +116,21 @@ class _HistogramChild:
             self._sum += v
             self._count += 1
 
+    def set_state(self, counts, sum_, count):
+        """Overwrite the bucket/total state — ONLY for bridging an
+        external histogram source (the C++ engine's latency buckets,
+        ``common/basics.py:poll_engine_stats``) whose raw arrays already
+        ARE the running totals. ``counts`` is per-bucket
+        (non-cumulative), length ``len(buckets) + 1`` (+Inf last);
+        shorter inputs zero-fill, longer ones truncate. Regular code
+        must use ``observe``."""
+        with self._lock:
+            n = len(self._counts)
+            cs = [int(c) for c in list(counts)[:n]]
+            self._counts = cs + [0] * (n - len(cs))
+            self._sum = float(sum_)
+            self._count = int(count)
+
     def snapshot(self):
         """(cumulative_bucket_counts, sum, count) — cumulative per the
         Prometheus histogram convention (le buckets nest)."""

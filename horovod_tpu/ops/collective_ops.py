@@ -577,3 +577,17 @@ def synchronize(handle):
 def poll(handle) -> bool:
     """True if the async op has completed (``torch/mpi_ops.py:807``)."""
     return handle.done()
+
+
+def wire_compression() -> str:
+    """Negotiated wire codec of the eager data plane: ``"bf16"`` when
+    ``HVT_WIRE_COMPRESSION=bf16`` is active on this rank's engine (fp32
+    allreduces then move half the DCN bytes, within bf16 precision),
+    else ``"none"``. Rank 0's setting governs the gang — the codec is
+    stamped into every coordinated response, so mixed environments
+    still agree on transfer sizes. Distinct from ``hvt.Compression``
+    (framework-level cast before submission): wire compression is
+    transparent to callers and applies inside the TCP ring only."""
+    from horovod_tpu.engine import native
+
+    return "bf16" if native.wire_compression() == 1 else "none"

@@ -56,7 +56,7 @@ _clock_offset_us = 0.0
 
 # kind wire ids — must match csrc/events.h EventKind / native.EVENT_KINDS
 _ENQUEUED, _NEG_B, _NEG_E, _RANK_READY, _FUSED, _EXEC_B, _EXEC_E, \
-    _DONE, _CYCLE, _STALL = range(10)
+    _DONE, _CYCLE, _STALL, _WAKEUP = range(11)
 
 _ENGINE_DRAIN_SEC = 0.05
 
@@ -213,6 +213,14 @@ class _TimelineState:
                     self.cycle_mark(
                         name=f"ENGINE_CYCLE({ev['arg']} responses)",
                         ts=ts)
+                continue
+            if kind == _WAKEUP:
+                # cycle-lane instant (no tensor name): arg = submissions
+                # drained, arg2 = submit→drain coalescing latency (µs)
+                if self.mark_cycles:
+                    self.cycle_mark(
+                        name=f"WAKEUP({ev['arg']} subs, "
+                             f"{ev['arg2']} µs)", ts=ts)
                 continue
             key = ("eng", name)
             tid = self._lane(key, f"{name} (engine)")

@@ -63,6 +63,7 @@ _ensure_engine_built()
 # first-compile), not individual test bodies.
 _SLOW_MODULES = {
     "test_engine_integration",   # real 2/4/5-process engine gangs
+    "test_data_plane",           # 2/4-process ring/wire-codec gangs
     "test_flight_recorder",      # 2-process timeline/stall gangs
     "test_multiprocess_jit",     # jax.distributed subprocess pairs
     "test_engine_scaling",       # timed eager-plane benchmarks

@@ -1,0 +1,195 @@
+"""Gang tests for the PR-3 eager data-plane overhaul: event-driven
+cycle draining (small-tensor latency well under ``cycle_ms``), the
+pipelined chunked ring's numerics at chunk-boundary sizes across
+dtypes/ReduceKinds, and the negotiated bf16 wire codec (tolerance,
+halved wire bytes, cross-rank bit-identity, default-off exactness).
+
+Every test launches a real multi-process gang through hvtrun on
+loopback, with ``HVT_SHM_ALLREDUCE=0`` so the TCP ring — the code under
+test — serves the collectives.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "horovod_tpu", "csrc", "build", "libhvt_core.so")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(LIB),
+    reason="C++ engine not built (make -C horovod_tpu/csrc)")
+
+_PORT = [24000 + (os.getpid() * 613) % 10000]
+
+
+def _next_port():
+    import socket
+    while True:
+        _PORT[0] += 1
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("127.0.0.1", _PORT[0]))
+                return _PORT[0]
+            except OSError:
+                continue
+
+
+def run_workers(body, np=2, timeout=120, extra_env=None):
+    _next_port()
+    script = textwrap.dedent(f"""
+        import os, sys, time, zlib
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import horovod_tpu as hvt
+        hvt.init()
+        r, n = hvt.rank(), hvt.size()
+        {textwrap.indent(textwrap.dedent(body), '        ').strip()}
+        print(f"WORKER-{{r}}-DONE", flush=True)
+        hvt.shutdown()
+    """)
+    path = f"/tmp/hvt_dptest_{os.getpid()}_{_PORT[0]}.py"
+    with open(path, "w") as f:
+        f.write(script)
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "XLA_FLAGS": "", "HVT_SHM_ALLREDUCE": "0"})
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner.launch", "-np", str(np),
+         "--master-port", str(_PORT[0]), sys.executable, path],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    out = proc.stdout + proc.stderr
+    for i in range(np):
+        assert f"WORKER-{i}-DONE" in out
+    return out
+
+
+def test_event_driven_drains_back_to_back():
+    """With cycle_ms cranked to 200, a sleep-paced loop needs ≥ one full
+    sleep per op (10 hot ops ≥ 2 s); the event-driven loop must clear
+    all 10 in a fraction of that. Also pins the observability satellite:
+    WAKEUP events in the ring and both new histograms populated."""
+    out = run_workers("""
+        from horovod_tpu.engine import native
+        x = np.arange(1024, dtype=np.float32)
+        hvt.allreduce(x, op=hvt.Sum, name="hot")  # prime the cache
+        t0 = time.perf_counter()
+        for _ in range(10):
+            hvt.allreduce(x, op=hvt.Sum, name="hot")
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 1.0, f"10 hot 4KB ops took {elapsed:.2f}s " \
+            "with cycle_ms=200 — event-driven draining is not engaging"
+        st = native.engine_stats()
+        assert st["wakeup_hist"]["count"] > 0, "no wakeups observed"
+        assert st["cycle_hist"]["count"] > 0, "no cycle durations"
+        kinds = {e["kind_name"] for e in native.drain_events()}
+        assert "WAKEUP" in kinds, f"no WAKEUP events (saw {kinds})"
+        if r == 0:
+            print("ELAPSED", round(elapsed, 3), flush=True)
+    """, extra_env={"HVT_CYCLE_TIME_MS": "200"})
+    assert "ELAPSED" in out
+
+
+def test_pipelined_ring_numerics_at_chunk_boundaries():
+    """Chunk size forced to 4 KB (1024 fp32 elems) so payloads cross
+    chunk boundaries: below, at, just past, several-chunks+remainder,
+    and count < ranks. All dtypes, all elementwise ReduceKinds."""
+    run_workers("""
+        sizes = [1, 2, 3, 1023, 1024, 1025, 4103]
+        dtypes = [np.float32, np.float64, np.float16, np.int32,
+                  np.int64, np.uint8, np.int8]
+        try:
+            import ml_dtypes
+            dtypes.append(np.dtype("bfloat16"))
+        except Exception:
+            pass
+        for numel in sizes:
+            for dt in dtypes:
+                base = (np.arange(numel) % 5 + 1)
+                x = (base + r).astype(dt)
+                nm = f"s.{numel}.{np.dtype(dt).name}"
+                res = np.asarray(hvt.allreduce(x, op=hvt.Sum, name=nm))
+                exp = sum((base + i).astype(dt) for i in range(n))
+                np.testing.assert_array_equal(
+                    res.astype(np.float64), exp.astype(np.float64),
+                    err_msg=nm)
+        # other ReduceKinds at a boundary-crossing size
+        numel = 1025
+        base = np.arange(numel) % 7 + 1
+        for op, fn in ((hvt.Min, np.minimum), (hvt.Max, np.maximum)):
+            x = ((base + 11 * r) % 13).astype(np.float32)
+            res = np.asarray(hvt.allreduce(x, op=op, name=f"mm.{op.name}"))
+            exp = ((base + 0) % 13).astype(np.float32)
+            for i in range(1, n):
+                exp = fn(exp, ((base + 11 * i) % 13).astype(np.float32))
+            np.testing.assert_array_equal(res, exp)
+        x = np.where(base % 2 == 0, 2.0, 1.0).astype(np.float32)
+        res = np.asarray(hvt.allreduce(x, op=hvt.Product, name="prod"))
+        np.testing.assert_array_equal(res, x ** n)
+        # Average exercises the postscale fold (scale rides the ring's
+        # allgather pass); ints now round rather than truncate
+        x = np.full((numel,), float(r + 1), np.float32)
+        res = np.asarray(hvt.allreduce(x, op=hvt.Average, name="avgf"))
+        np.testing.assert_allclose(res, (1 + n) / 2.0)
+        xi = np.full((numel,), r + 1, np.int32)
+        res = np.asarray(hvt.allreduce(xi, op=hvt.Average, name="avgi"))
+        # llround semantics: positive halves round AWAY from zero
+        exp_avg = int(np.floor((n * (n + 1) / 2) / n + 0.5))
+        np.testing.assert_array_equal(res, exp_avg)
+    """, extra_env={"HVT_RING_CHUNK_BYTES": "4096"}, timeout=180)
+
+
+def test_bf16_wire_allreduce_4proc():
+    """HVT_WIRE_COMPRESSION=bf16 on a 4-proc gang: fp32 results within
+    bf16 tolerance, bit-identical across ranks, and exactly half the
+    raw plane's wire bytes (counted by the per-op tx counters)."""
+    run_workers("""
+        from horovod_tpu.engine import native
+        assert hvt.wire_compression() == "bf16"
+        numel = 1 << 16
+        x = (np.arange(numel, dtype=np.float32) % 997) * 0.123 + r
+        res = np.asarray(hvt.allreduce(x, op=hvt.Sum, name="c"))
+        exp = sum((np.arange(numel, dtype=np.float32) % 997) * 0.123 + i
+                  for i in range(n))
+        # documented tolerance: bf16 has an 8-bit mantissa → relative
+        # error ≤ ~2^-7 per wire hop (docs/performance.md)
+        np.testing.assert_allclose(res, exp, rtol=1e-2)
+        st = native.engine_stats()
+        tx = st["wire_tx_bytes"]["allreduce"]
+        txc = st["wire_tx_comp_bytes"]["allreduce"]
+        # ring sends 2(n-1)/n of the payload per rank; compressed form
+        # halves it, and every allreduce byte went out compressed
+        raw_wire = 2 * (n - 1) * numel * 4 // n
+        assert tx == raw_wire // 2, (tx, raw_wire)
+        assert txc == tx > 0
+        # all ranks end bit-identical (owners round-trip through bf16)
+        crcs = hvt.allgather(
+            np.array([zlib.crc32(res.tobytes())], np.int64), name="crc")
+        assert len(set(int(c) for c in np.asarray(crcs))) == 1
+    """, np=4, extra_env={"HVT_WIRE_COMPRESSION": "bf16"}, timeout=180)
+
+
+def test_wire_default_off_exact_and_uncompressed():
+    """Without HVT_WIRE_COMPRESSION the plane must be bit-exact (integer
+    payloads sum exactly in fp32) and count zero compressed bytes."""
+    run_workers("""
+        from horovod_tpu.engine import native
+        assert hvt.wire_compression() == "none"
+        numel = 1 << 16
+        x = (np.arange(numel) % 1001 + r).astype(np.float32)
+        res = np.asarray(hvt.allreduce(x, op=hvt.Sum, name="exact"))
+        exp = sum((np.arange(numel) % 1001 + i).astype(np.float32)
+                  for i in range(n))
+        np.testing.assert_array_equal(res, exp)
+        st = native.engine_stats()
+        assert st["wire_tx_comp_bytes"]["allreduce"] == 0
+        assert st["wire_tx_bytes"]["allreduce"] == \
+            2 * (n - 1) * numel * 4 // n
+    """)
