@@ -7,6 +7,9 @@
 #   ./ci.sh --fast     # inner loop: quick-marked tests only (~minutes
 #                      # vs ~37 min full on the 1-core host), skip the
 #                      # bench smoke
+#   ./ci.sh --chaos    # build + the fault-injection / failure-
+#                      # containment suite only (SIGKILL/SIGSTOP gangs,
+#                      # deadline bounds, abort metrics)
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so)
@@ -20,7 +23,18 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 FAST=0
+CHAOS=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
+[[ "${1:-}" == "--chaos" ]] && CHAOS=1
+
+# Hard wall-clock guard around every pytest stage: a failure-containment
+# regression must FAIL CI (timeout rc 124), never stall it — the gang
+# tests hold raw subprocesses that a hung collective would otherwise
+# park forever.
+PYTEST_GUARD_SEC=${PYTEST_GUARD_SEC:-3600}
+run_pytest() {
+  timeout -k 30 "$PYTEST_GUARD_SEC" python -m pytest "$@"
+}
 
 echo "=== [1/4] build C++ engine ==="
 make -C horovod_tpu/csrc -j
@@ -44,9 +58,11 @@ fi
 
 # The rebuilt .so must export the full C API surface — a stale build
 # dir can silently serve an old .so whose missing symbols make the
-# Python bridge degrade to zeros (PR 3 added the data-plane symbols).
+# Python bridge degrade to zeros (PR 3 added the data-plane symbols,
+# PR 4 the abort/timed-wait containment symbols).
 REQUIRED_SYMS="hvt_init hvt_submit hvt_engine_stats hvt_events_drain \
-hvt_diagnostics hvt_wire_compression hvt_scale_buffer"
+hvt_diagnostics hvt_wire_compression hvt_scale_buffer \
+hvt_wait_timeout hvt_engine_broken"
 for sym in $REQUIRED_SYMS; do
   if ! nm -D "$CORE_SO" 2>/dev/null | grep -q " T $sym\$"; then
     echo "FATAL: $CORE_SO does not export $sym (stale build?)" >&2
@@ -55,14 +71,21 @@ for sym in $REQUIRED_SYMS; do
 done
 echo "C API symbol check OK ($(echo $REQUIRED_SYMS | wc -w) symbols)"
 
+if [[ "$CHAOS" == "1" ]]; then
+  echo "=== [2/2] chaos / failure-containment suite ==="
+  run_pytest tests/test_failure_containment.py -q
+  echo "CI OK (chaos)"
+  exit 0
+fi
+
 echo "=== [2/4] test suite ==="
 if [[ "$FAST" == "1" ]]; then
   # quick subset: modules outside tests/conftest.py's known-slow list
   # (subprocess gangs, TF imports, pallas interpret). Full suite stays
   # the round gate.
-  python -m pytest tests/ -x -q -m quick
+  run_pytest tests/ -x -q -m quick
 else
-  python -m pytest tests/ -x -q
+  run_pytest tests/ -x -q
 fi
 
 echo "=== [3/4] multi-chip dryrun (8 virtual devices) ==="

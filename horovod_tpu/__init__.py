@@ -56,6 +56,7 @@ from horovod_tpu.common.basics import (
 )
 from horovod_tpu.common.exceptions import (
     HorovodInternalError,
+    HorovodTimeoutError,
     HostsUpdatedInterrupt,
 )
 from horovod_tpu.common.process_sets import (
@@ -150,5 +151,6 @@ __all__ = [
     # telemetry (lazy submodule)
     "metrics",
     # exceptions
-    "HorovodInternalError", "HostsUpdatedInterrupt",
+    "HorovodInternalError", "HorovodTimeoutError",
+    "HostsUpdatedInterrupt",
 ]

@@ -477,6 +477,22 @@ def poll_engine_stats(registry=None):
               "configured wire codec (0 raw, 1 bf16); rank 0's value "
               "governs the gang").set(native.wire_compression())
 
+    # failure containment: coordinated aborts by cause + the sticky
+    # broken flag (alerts page on either; the cause label says whether
+    # it was a deadline, a dropped peer, a missed heartbeat, or a
+    # forwarded ABORT frame)
+    abort_c = reg.counter(
+        "hvt_engine_aborts_total",
+        "coordinated engine aborts by cause (sticky broken state; at "
+        "most one per engine run)", ("cause",))
+    ab = stats.get("aborts", {})
+    for cause in native.ABORT_CAUSES:
+        abort_c.labels(cause=cause).set_total(ab.get(cause, 0))
+    broken, _info = native.engine_broken()
+    reg.gauge("hvt_engine_broken",
+              "1 while the engine is in the sticky broken state "
+              "(shutdown + re-init to recover)").set(1 if broken else 0)
+
     up = reg.gauge("hvt_engine_up",
                    "1 when the C++ engine is initialized")
     running = native.engine_running()

@@ -12,6 +12,18 @@ class HorovodInternalError(RuntimeError):
     """
 
 
+class HorovodTimeoutError(TimeoutError):
+    """A bounded wait expired before the collective completed.
+
+    Raised by ``Handle.wait(timeout=...)`` when the handle is still
+    pending at the deadline (the collective keeps running — wait again
+    or release the handle). Distinct from :class:`HorovodInternalError`:
+    a timeout does not mean the gang failed, only that this wait was
+    bounded. Subclasses :class:`TimeoutError` so existing callers that
+    catch the builtin keep working.
+    """
+
+
 class HostsUpdatedInterrupt(RuntimeError):
     """Raised at a commit point when the elastic driver has notified this
     worker of a host-set change (reference ``horovod/common/exceptions.py:26``).

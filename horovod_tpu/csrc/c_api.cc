@@ -71,6 +71,22 @@ int hvt_wait(int handle) {
   return 0;
 }
 
+// Deadline-bounded hvt_wait: 0 done-ok, <0 done-error (same codes as
+// hvt_wait), 1 when the handle is still pending after timeout_ms (no
+// result loaded — the collective keeps running; wait again or release).
+int hvt_wait_timeout(int handle, long long timeout_ms) {
+  hvt::HandleState st;
+  if (!Engine::Get().WaitFor(handle, static_cast<int64_t>(timeout_ms),
+                             st))
+    return 1;
+  g_last_state = std::move(st);
+  if (!g_last_state.status.ok()) {
+    g_last_error = g_last_state.status.reason;
+    return -static_cast<int>(g_last_state.status.type);
+  }
+  return 0;
+}
+
 long long hvt_result_bytes(int handle) {
   (void)handle;
   return static_cast<long long>(g_last_state.output.size());
@@ -182,6 +198,8 @@ int hvt_engine_flags() {
 //   51     cycle-duration sum (ns)        52 cycle-duration count
 //   53..67 wakeup-latency histogram buckets (same bounds)
 //   68     wakeup-latency sum (ns)        69 wakeup-latency count
+//   70..74 aborts by cause (timeout, peer_lost, remote_abort,
+//          heartbeat, internal) — hvt_engine_aborts_total{cause}
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
@@ -189,7 +207,7 @@ int hvt_engine_stats(long long* out, int max_n) {
   auto& eng = Engine::Get();
   const auto& s = eng.stats();
   constexpr int kHist = hvt::kLatBuckets + 1 + 2;  // buckets + sum + count
-  long long v[8 + 4 * hvt::kStatsOps + 2 * kHist] = {
+  long long v[8 + 4 * hvt::kStatsOps + 2 * kHist + hvt::kAbortCauses] = {
       s.cycles.load(std::memory_order_relaxed),
       s.tensors_submitted.load(std::memory_order_relaxed),
       s.tensors_coordinated.load(std::memory_order_relaxed),
@@ -213,7 +231,9 @@ int hvt_engine_stats(long long* out, int max_n) {
     v[base++] = h->sum_ns.load(std::memory_order_relaxed);
     v[base++] = h->count.load(std::memory_order_relaxed);
   }
-  const int n = 8 + 4 * hvt::kStatsOps + 2 * kHist;
+  for (int i = 0; i < hvt::kAbortCauses; ++i)
+    v[base++] = s.aborts[i].load(std::memory_order_relaxed);
+  const int n = 8 + 4 * hvt::kStatsOps + 2 * kHist + hvt::kAbortCauses;
   for (int i = 0; i < n && i < max_n; ++i) out[i] = v[i];
   return n;
 }
@@ -221,6 +241,27 @@ int hvt_engine_stats(long long* out, int max_n) {
 // Negotiated wire codec as configured on this rank (WireCodec wire id;
 // rank 0's value governs the gang via per-response stamps).
 int hvt_wire_compression() { return Engine::Get().wire_mode(); }
+
+// Sticky broken state (coordinated abort landed): returns 1 and fills
+// dst with "<cause>: <reason>" (NUL-terminated, truncated to max_n)
+// when broken, 0 when healthy. Submits fail fast while broken; recover
+// with hvt_shutdown + a fresh hvt_init.
+int hvt_engine_broken(char* dst, int max_n) {
+  auto& eng = Engine::Get();
+  if (!eng.broken()) {
+    if (dst && max_n > 0) dst[0] = '\0';
+    return 0;
+  }
+  std::string s = eng.BrokenInfo();
+  if (dst && max_n > 0) {
+    int k = static_cast<int>(s.size()) < max_n - 1
+                ? static_cast<int>(s.size())
+                : max_n - 1;
+    memcpy(dst, s.data(), static_cast<size_t>(k));
+    dst[k] = '\0';
+  }
+  return 1;
+}
 
 // Direct ScaleBuffer entry point for unit tests (pins the integer
 // round-vs-truncate semantics without spinning up a gang). dtype is the

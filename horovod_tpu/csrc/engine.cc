@@ -2,6 +2,7 @@
 
 #include "logging.h"
 
+#include <csignal>
 #include <chrono>
 #include <cstdio>
 #include <cstdlib>
@@ -13,6 +14,84 @@ namespace hvt {
 Engine& Engine::Get() {
   static Engine* engine = new Engine();
   return *engine;
+}
+
+// --------------------------------------------------------------------------
+// coordinated-abort control frames
+// --------------------------------------------------------------------------
+// The first byte of every control frame is a flags byte (worker→rank 0)
+// or resp_flags byte (rank 0→worker); neither protocol uses bit 7, so an
+// ABORT frame is any frame whose first byte has kAbortFrameFlag set:
+//   u8(0x80) | i32(origin rank) | str(reason)
+// It can arrive in place of ANY expected frame — both readers check the
+// bit before parsing — which is what lets a failing rank interrupt the
+// gang mid-protocol.
+static constexpr uint8_t kAbortFrameFlag = 0x80;
+
+static bool IsAbortFrame(const std::vector<uint8_t>& f) {
+  return !f.empty() && (f[0] & kAbortFrameFlag) != 0;
+}
+
+static std::vector<uint8_t> BuildAbortFrame(int origin_rank,
+                                            const std::string& reason) {
+  Writer w;
+  w.u8(kAbortFrameFlag);
+  w.i32(origin_rank);
+  w.str(reason);
+  return std::move(w.buf);
+}
+
+static std::string ParseAbortFrame(const std::vector<uint8_t>& f) {
+  Reader rd(f);
+  rd.u8();
+  int32_t origin = rd.i32();
+  std::string reason = rd.str();
+  return "abort from rank " + std::to_string(origin) + ": " + reason;
+}
+
+// HVT_FAULT_INJECT grammar (chaos harness; see docs/troubleshooting.md):
+//   kill:rank=R:after_ops=N   raise(SIGKILL) before data-plane op N+1
+//   drop_conn:rank=R[:after_ops=N]   close every engine socket (default
+//                                    after the first op)
+//   delay_ms:rank=R:MS        sleep MS ms before every data-plane op
+// Specs for other ranks (or Python-level specs like after_sec, owned by
+// task_runner) are ignored here.
+static void ParseFaultInject(const std::string& spec, int my_rank,
+                             Engine::FaultSpec& out) {
+  out = Engine::FaultSpec{};
+  size_t p = spec.find(':');
+  std::string kind = spec.substr(0, p);
+  int64_t rank = -1, after_ops = -1, bare = -1;
+  bool has_after_sec = false;
+  while (p != std::string::npos) {
+    size_t q = spec.find(':', p + 1);
+    std::string tok = spec.substr(p + 1, q == std::string::npos
+                                             ? std::string::npos
+                                             : q - p - 1);
+    if (tok.rfind("rank=", 0) == 0)
+      rank = atoll(tok.c_str() + 5);
+    else if (tok.rfind("after_ops=", 0) == 0)
+      after_ops = atoll(tok.c_str() + 10);
+    else if (tok.rfind("after_sec=", 0) == 0)
+      has_after_sec = true;  // Python-level trigger (task_runner)
+    else if (!tok.empty() && (isdigit(tok[0]) || tok[0] == '-'))
+      bare = atoll(tok.c_str());
+    p = q;
+  }
+  if (rank != my_rank) return;
+  if (kind == "kill" && after_ops >= 0) {
+    // after_sec-triggered kills belong to task_runner; arm here only
+    // for the op-count trigger
+    out.kind = Engine::FaultKind::KILL;
+    out.after_ops = after_ops;
+  } else if (kind == "drop_conn" && !has_after_sec) {
+    out.kind = Engine::FaultKind::DROP_CONN;
+    out.after_ops = after_ops >= 0 ? after_ops : 0;
+  } else if (kind == "delay_ms") {
+    out.kind = Engine::FaultKind::DELAY_MS;
+    out.after_ops = after_ops >= 0 ? after_ops : 0;
+    out.arg = bare > 0 ? bare : 0;
+  }
 }
 
 // --------------------------------------------------------------------------
@@ -39,6 +118,18 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   fusion_threshold_ = EnvInt("HVT_FUSION_THRESHOLD", 64 << 20);
   stall_warn_sec_ =
       static_cast<double>(EnvInt("HVT_STALL_WARN_SEC", 60));
+  // liveness: idle-gang control frames double as heartbeats; this is
+  // the deadline applied to them when no work is outstanding (0 → use
+  // HVT_OP_TIMEOUT_MS everywhere)
+  heartbeat_ms_ = EnvInt("HVT_HEARTBEAT_MS", 30000);
+  if (const char* fi = getenv("HVT_FAULT_INJECT"); fi && *fi) {
+    ParseFaultInject(fi, rank, fault_);
+    if (fault_.kind != FaultKind::NONE) {
+      HVT_LOG(WARNING, rank) << "fault injection armed: " << fi;
+    }
+  } else {
+    fault_ = FaultSpec{};
+  }
   disable_group_fusion_ = EnvInt("HVT_DISABLE_GROUP_FUSION", 0) != 0;
   cache_ = ResponseCache(
       static_cast<size_t>(EnvInt("HVT_CACHE_CAPACITY", 1024)));
@@ -158,6 +249,12 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   announced_.clear();
   shutdown_requested_ = false;
   fatal_ = false;
+  broken_ = false;  // a fresh init starts healthy (elastic re-init path)
+  {
+    std::lock_guard<std::mutex> lk(broken_mu_);
+    broken_reason_.clear();
+    broken_cause_ = kAbortInternal;
+  }
   // only the coordinator writes the timeline file (reference
   // operations.cc:422-425); started only after a successful rendezvous
   // so an Init failure leaves no orphan writer thread / open file
@@ -200,6 +297,10 @@ void Engine::Shutdown() {
   // reset engine-thread state for a potential re-init (elastic restart)
   pending_.clear();
   counts_.clear();
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    inflight_.clear();
+  }
   cache_ = ResponseCache(1024);
   join_pending_ = false;
   join_entry_.reset();
@@ -229,12 +330,38 @@ int32_t Engine::Submit(EntryPtr entry) {
   }
   entry->handle = h;
   if (fatal_.load()) {
-    CompleteEntry(entry, Status::Aborted("hvt engine failed earlier"));
+    // sticky broken state: fail fast (bounded, never a hang) until the
+    // caller runs shutdown() + a fresh init()
+    std::string why = BrokenInfo();
+    CompleteEntry(entry,
+                  Status::Aborted(why.empty()
+                                      ? "hvt engine failed earlier"
+                                      : "hvt engine aborted (" + why +
+                                            "); shutdown() and re-init() "
+                                            "to recover"));
     return h;
   }
+  bool accepted = false;
   {
+    // FailAll sets fatal_ and then drains this queue under the same
+    // mutex, so re-checking fatal_ here closes the submit/abort race:
+    // without it, an entry pushed between Submit's fast-path check and
+    // FailAll's drain would never complete and its Wait would hang.
     std::lock_guard<std::mutex> lk(queue_mu_);
-    submitted_.push_back(std::move(entry));
+    if (!fatal_.load()) {
+      submitted_.push_back(std::move(entry));
+      accepted = true;
+    }
+  }
+  if (!accepted) {
+    std::string why = BrokenInfo();
+    CompleteEntry(entry,
+                  Status::Aborted(why.empty()
+                                      ? "hvt engine failed earlier"
+                                      : "hvt engine aborted (" + why +
+                                            "); shutdown() and re-init() "
+                                            "to recover"));
+    return h;
   }
   queue_cv_.notify_one();  // wake the engine mid-coalescing-wait
   return h;
@@ -265,6 +392,29 @@ HandleState Engine::Wait(int32_t handle) {
   return out;
 }
 
+bool Engine::WaitFor(int32_t handle, int64_t timeout_ms,
+                     HandleState& out) {
+  std::unique_lock<std::mutex> lk(handles_mu_);
+  auto done = [&] {
+    auto it = handles_.find(handle);
+    return it == handles_.end() || it->second.done;
+  };
+  if (!handles_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                            done))
+    return false;
+  auto it = handles_.find(handle);
+  if (it == handles_.end()) {
+    out = HandleState{};
+    return true;
+  }
+  // move semantics identical to Wait (handles are waited at most once)
+  out = std::move(it->second);
+  it->second.done = out.done;
+  it->second.status = out.status;
+  it->second.join_result = out.join_result;
+  return true;
+}
+
 void Engine::Release(int32_t handle) {
   std::lock_guard<std::mutex> lk(handles_mu_);
   handles_.erase(handle);
@@ -275,6 +425,11 @@ void Engine::CompleteEntry(const EntryPtr& e, const Status& s) {
                  static_cast<int32_t>(s.type), 0);
   {
     std::lock_guard<std::mutex> lk(handles_mu_);
+    for (size_t i = 0; i < inflight_.size(); ++i)
+      if (inflight_[i] == e) {
+        inflight_.erase(inflight_.begin() + static_cast<long>(i));
+        break;
+      }
     auto it = handles_.find(e->handle);
     if (it == handles_.end()) return;
     it->second.done = true;
@@ -289,6 +444,14 @@ void Engine::CompleteEntry(const EntryPtr& e, const Status& s) {
 
 void Engine::FailAll(const std::string& why) {
   fatal_ = true;
+  // entries mid-execution when the data plane threw: their handles must
+  // complete too, or Engine::Wait would hang past the abort
+  std::vector<EntryPtr> inflight;
+  {
+    std::lock_guard<std::mutex> lk(handles_mu_);
+    inflight.swap(inflight_);
+  }
+  for (auto& e : inflight) CompleteEntry(e, Status::Aborted(why));
   for (auto& [name, e] : pending_)
     CompleteEntry(e, Status::Aborted(why));
   pending_.clear();
@@ -300,6 +463,104 @@ void Engine::FailAll(const std::string& why) {
   std::lock_guard<std::mutex> lk(queue_mu_);
   for (auto& e : submitted_) CompleteEntry(e, Status::Aborted(why));
   submitted_.clear();
+}
+
+// --------------------------------------------------------------------------
+// failure containment
+// --------------------------------------------------------------------------
+
+std::string Engine::BrokenInfo() {
+  if (!broken_.load()) return "";
+  std::lock_guard<std::mutex> lk(broken_mu_);
+  return std::string(AbortCauseName(broken_cause_)) + ": " +
+         broken_reason_;
+}
+
+void Engine::EnterBroken(int cause, const std::string& why) {
+  bool expected = false;
+  if (!broken_.compare_exchange_strong(expected, true)) return;
+  if (cause < 0 || cause >= kAbortCauses) cause = kAbortInternal;
+  {
+    std::lock_guard<std::mutex> lk(broken_mu_);
+    broken_cause_ = cause;
+    broken_reason_ = why;
+  }
+  stats_.aborts[cause].fetch_add(1, std::memory_order_relaxed);
+  events_.Record(EventKind::ABORT, why, -1, cause, 0);
+  HVT_LOG(ERROR, rank_) << "engine aborting ("
+                        << AbortCauseName(cause) << "): " << why
+                        << " — completing all pending collectives with "
+                        << "errors; submits fail fast until re-init";
+  // Fan the ABORT out over the control star (best effort — peers may
+  // already be gone). Rank 0 tells every worker; a worker tells rank 0,
+  // which re-broadcasts when it aborts in turn. Either way each
+  // survivor reads the frame in place of its next expected control
+  // message and aborts within one cycle instead of its own deadline.
+  auto frame = BuildAbortFrame(rank_, why);
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r) {
+      if (!workers_[static_cast<size_t>(r)].valid()) continue;
+      try {
+        workers_[static_cast<size_t>(r)].SendFrame(frame, 1000);
+      } catch (const std::exception&) {
+      }
+    }
+  } else if (control_.valid()) {
+    try {
+      control_.SendFrame(frame, 1000);
+    } catch (const std::exception&) {
+    }
+  }
+  // Close the data mesh: peers blocked mid-collective on a socket to
+  // this rank wake with PeerLostError immediately (FIN from Close), so
+  // the abort cascades through the gang in one deadline, not N.
+  if (data_) data_->Abort();
+  FailAll("hvt engine aborted (" + std::string(AbortCauseName(cause)) +
+          "): " + why);
+}
+
+void Engine::MaybeInjectFault() {
+  if (fault_.kind == FaultKind::NONE) return;
+  int64_t ops = data_ops_.load();
+  switch (fault_.kind) {
+    case FaultKind::KILL:
+      if (ops > fault_.after_ops) {
+        HVT_LOG(WARNING, rank_) << "HVT_FAULT_INJECT: raising SIGKILL "
+                                << "after " << fault_.after_ops
+                                << " data ops";
+        raise(SIGKILL);
+      }
+      break;
+    case FaultKind::DROP_CONN:
+      if (ops > fault_.after_ops) {
+        HVT_LOG(WARNING, rank_)
+            << "HVT_FAULT_INJECT: dropping all engine connections";
+        fault_ = FaultSpec{};  // fire once
+        if (data_) data_->Abort();
+        control_.Close();
+        for (auto& s : workers_) s.Close();
+      }
+      break;
+    case FaultKind::DELAY_MS:
+      if (ops > fault_.after_ops && fault_.arg > 0)
+        std::this_thread::sleep_for(
+            std::chrono::milliseconds(fault_.arg));
+      break;
+    case FaultKind::NONE:
+      break;
+  }
+}
+
+int64_t Engine::ControlTimeoutMs(bool idle) const {
+  // Idle-gang control frames flow every cycle regardless of user work,
+  // so they double as heartbeats: bound them with the (typically much
+  // shorter) HVT_HEARTBEAT_MS so a silently dead peer — SIGSTOP, kernel
+  // hang, network partition — surfaces without waiting out the full op
+  // deadline. With work outstanding the op deadline governs, since a
+  // peer may legitimately be grinding a large data-plane transfer
+  // between frames.
+  if (idle && heartbeat_ms_ > 0) return heartbeat_ms_;
+  return OpTimeoutMs();
 }
 
 // --------------------------------------------------------------------------
@@ -318,8 +579,20 @@ void Engine::ThreadLoop() {
     bool outstanding = false;
     try {
       if (!RunCycle(progressed, outstanding)) return;
+    } catch (const RemoteAbortError& e) {
+      EnterBroken(kAbortRemote, e.what());
+      return;
+    } catch (const HeartbeatLostError& e) {
+      EnterBroken(kAbortHeartbeat, e.what());
+      return;
+    } catch (const OpTimeoutError& e) {
+      EnterBroken(kAbortTimeout, e.what());
+      return;
+    } catch (const PeerLostError& e) {
+      EnterBroken(kAbortPeerLost, e.what());
+      return;
     } catch (const std::exception& e) {
-      FailAll(std::string("hvt engine: ") + e.what());
+      EnterBroken(kAbortInternal, std::string("hvt engine: ") + e.what());
       return;
     }
     double now = NowSec();
@@ -467,7 +740,30 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
   } else if (rank_ == 0) {
     std::vector<std::vector<uint8_t>> frames(size_);
     frames[0] = std::move(w.buf);
-    for (int r = 1; r < size_; ++r) frames[r] = workers_[r].RecvFrame();
+    // deadline-bounded worker frames: heartbeat pace when idle, op
+    // deadline when negotiations/entries are outstanding. Any frame may
+    // be an ABORT from a failing worker (checked before parsing).
+    bool idle = pending_.empty() && !join_pending_ && counts_.empty();
+    int64_t ctl_ms = ControlTimeoutMs(idle);
+    for (int r = 1; r < size_; ++r) {
+      try {
+        frames[r] = workers_[r].RecvFrame(ctl_ms);
+      } catch (const OpTimeoutError&) {
+        if (idle && heartbeat_ms_ > 0 && ctl_ms == heartbeat_ms_)
+          throw HeartbeatLostError(
+              "no heartbeat from rank " + std::to_string(r) + " for " +
+              std::to_string(heartbeat_ms_) + " ms (HVT_HEARTBEAT_MS)");
+        throw OpTimeoutError("no control frame from rank " +
+                             std::to_string(r) + " within " +
+                             std::to_string(ctl_ms) +
+                             " ms (HVT_OP_TIMEOUT_MS)");
+      } catch (const PeerLostError&) {
+        throw PeerLostError("control connection to rank " +
+                            std::to_string(r) + " lost");
+      }
+      if (IsAbortFrame(frames[r]))
+        throw RemoteAbortError(ParseAbortFrame(frames[r]));
+    }
     responses = Coordinate(frames);
     bool all_down = true;
     for (bool b : rank_shutdown_)
@@ -493,7 +789,25 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     pending_evictions_.clear();
   } else {
     control_.SendFrame(w.buf);
-    auto frame = control_.RecvFrame();
+    bool idle = pending_.empty() && !join_pending_;
+    int64_t ctl_ms = ControlTimeoutMs(idle);
+    std::vector<uint8_t> frame;
+    try {
+      frame = control_.RecvFrame(ctl_ms);
+    } catch (const OpTimeoutError&) {
+      if (idle && heartbeat_ms_ > 0 && ctl_ms == heartbeat_ms_)
+        throw HeartbeatLostError(
+            "no heartbeat from rank 0 (coordinator) for " +
+            std::to_string(heartbeat_ms_) + " ms (HVT_HEARTBEAT_MS)");
+      throw OpTimeoutError("no response from rank 0 (coordinator) "
+                           "within " + std::to_string(ctl_ms) +
+                           " ms (HVT_OP_TIMEOUT_MS)");
+    } catch (const PeerLostError&) {
+      throw PeerLostError("control connection to rank 0 (coordinator) "
+                          "lost");
+    }
+    if (IsAbortFrame(frame))
+      throw RemoteAbortError(ParseAbortFrame(frame));
     Reader rd(frame);
     resp_flags = rd.u8();
     int tuned_cycle = rd.i32();
@@ -1312,6 +1626,16 @@ std::string Engine::DiagnosticsJson() {
   snprintf(num, sizeof(num), "%.3f", d.stall_warn_sec);
   out += std::string(",\"stall_warn_sec\":") + num;
   out += ",\"events_dropped\":" + std::to_string(events_.dropped());
+  out += ",\"broken\":";
+  out += broken_.load() ? "true" : "false";
+  if (broken_.load()) {
+    std::lock_guard<std::mutex> lk(broken_mu_);
+    out += ",\"abort_cause\":\"";
+    out += AbortCauseName(broken_cause_);
+    out += "\",\"abort_reason\":\"";
+    JsonAppendEscaped(out, broken_reason_);
+    out += "\"";
+  }
   out += "},\"pending\":[";
   for (size_t i = 0; i < d.pending.size(); ++i) {
     if (i) out += ',';
@@ -1423,6 +1747,13 @@ void Engine::ExecuteResponse(const Response& resp,
     EntryPtr e = it->second;
     pending.erase(it);
     announced_.erase(name);
+    {
+      // track as in-flight until CompleteEntry: if the data plane
+      // throws mid-collective, FailAll must error-complete this entry
+      // or its waiter would hang past the abort
+      std::lock_guard<std::mutex> lk(handles_mu_);
+      inflight_.push_back(e);
+    }
     return e;
   };
 
@@ -1498,6 +1829,7 @@ void Engine::ExecuteResponse(const Response& resp,
 
   const size_t el = DataTypeSize(resp.dtype);
   data_ops_++;  // one per TENSOR response = one data-plane collective
+  MaybeInjectFault();  // HVT_FAULT_INJECT chaos hook (no-op when unset)
   // attribute this response's wire bytes to its OpType (engine thread
   // is the only data-plane user, so a plain member set suffices)
   if (data_) data_->set_stat_op(static_cast<int>(resp.op));

@@ -52,6 +52,38 @@ namespace hvt {
 // counters unconditionally on.
 constexpr int kStatsOps = 7;  // OpType 0..6 (common.h)
 
+// Abort causes for the coordinated-abort path — index into
+// EngineStats::aborts and the {cause} label of
+// hvt_engine_aborts_total. Wire ids (part of the stats-slot ABI).
+enum AbortCause : int {
+  kAbortTimeout = 0,      // an op hit its HVT_OP_TIMEOUT_MS deadline
+  kAbortPeerLost = 1,     // a connection dropped (FIN/RST/EPIPE)
+  kAbortRemote = 2,       // an ABORT control frame arrived from a peer
+  kAbortHeartbeat = 3,    // idle-gang heartbeat missed (HVT_HEARTBEAT_MS)
+  kAbortInternal = 4,     // any other engine-thread exception
+};
+constexpr int kAbortCauses = 5;
+inline const char* AbortCauseName(int c) {
+  switch (c) {
+    case kAbortTimeout: return "timeout";
+    case kAbortPeerLost: return "peer_lost";
+    case kAbortRemote: return "remote_abort";
+    case kAbortHeartbeat: return "heartbeat";
+  }
+  return "internal";
+}
+
+// Engine-thrown abort classifications layered over the net.h transport
+// errors (PeerLostError / OpTimeoutError).
+struct RemoteAbortError : std::runtime_error {
+  explicit RemoteAbortError(const std::string& w)
+      : std::runtime_error(w) {}
+};
+struct HeartbeatLostError : std::runtime_error {
+  explicit HeartbeatLostError(const std::string& w)
+      : std::runtime_error(w) {}
+};
+
 // Fixed log-scale latency histogram: bucket i holds observations
 // ≤ 1 µs · 4^i (matches metrics.DEFAULT_LATENCY_BUCKETS so the Python
 // bridge maps buckets 1:1), slot kLatBuckets is +Inf overflow. Writers
@@ -100,6 +132,9 @@ struct EngineStats {
   // pointers (DataPlane::BindTxCounters).
   std::atomic<int64_t> wire_tx_bytes[kStatsOps]{};
   std::atomic<int64_t> wire_tx_comp_bytes[kStatsOps]{};
+  // coordinated aborts by cause (hvt_engine_aborts_total{cause}); at
+  // most one increment per engine run — the broken state is sticky
+  std::atomic<int64_t> aborts[kAbortCauses]{};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -115,6 +150,7 @@ struct EngineStats {
       wire_tx_bytes[i] = 0;
       wire_tx_comp_bytes[i] = 0;
     }
+    for (auto& a : aborts) a = 0;
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -154,6 +190,14 @@ struct DiagState {
 class Engine {
  public:
   static Engine& Get();
+
+  // HVT_FAULT_INJECT (chaos harness) — parsed at Init for this rank.
+  enum class FaultKind { NONE, KILL, DROP_CONN, DELAY_MS };
+  struct FaultSpec {
+    FaultKind kind = FaultKind::NONE;
+    int64_t after_ops = 0;
+    int64_t arg = 0;
+  };
 
   Status Init(int rank, int size, const std::string& master_addr,
               int master_port, int cycle_ms);
@@ -196,12 +240,21 @@ class Engine {
   // JSON stall/queue snapshot for hvt_diagnostics (thread-safe).
   std::string DiagnosticsJson();
 
+  // Sticky broken state (coordinated abort landed). Submits fail fast
+  // and waits return errors until Shutdown() + a fresh Init().
+  bool broken() const { return broken_.load(); }
+  // "<cause>: <reason>" (empty when healthy); thread-safe.
+  std::string BrokenInfo();
+
   // Returns handle (>=0) or -1 when not initialized.
   int32_t Submit(EntryPtr entry);
 
   bool Poll(int32_t handle);
   // Blocks; returns snapshot of the handle state.
   HandleState Wait(int32_t handle);
+  // Bounded wait: false when the handle is still pending after
+  // timeout_ms (out untouched), true with the snapshot otherwise.
+  bool WaitFor(int32_t handle, int64_t timeout_ms, HandleState& out);
   void Release(int32_t handle);
 
  private:
@@ -217,6 +270,16 @@ class Engine {
                        std::map<std::string, EntryPtr>& pending);
   void CompleteEntry(const EntryPtr& e, const Status& s);
   void FailAll(const std::string& why);
+  // Coordinated abort: sticky broken flag, ABORT fan-out to connected
+  // peers, data-plane teardown, error-complete every pending and
+  // in-flight entry. Engine-thread only; idempotent.
+  void EnterBroken(int cause, const std::string& why);
+  // HVT_FAULT_INJECT hook, called once per data-plane response.
+  void MaybeInjectFault();
+  // Control-plane recv deadline: HVT_HEARTBEAT_MS when this side is
+  // idle (frames are then pure keepalives), HVT_OP_TIMEOUT_MS when
+  // work is outstanding.
+  int64_t ControlTimeoutMs(bool idle) const;
 
   // coordinator (rank 0) state + logic
   struct TensorCount {
@@ -265,6 +328,16 @@ class Engine {
   std::atomic<bool> initialized_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<bool> fatal_{false};
+  // sticky containment state (EnterBroken): set with fatal_, but also
+  // carries the cause/reason for hvt_engine_broken / diagnostics
+  std::atomic<bool> broken_{false};
+  std::mutex broken_mu_;
+  std::string broken_reason_;  // guarded by broken_mu_
+  int broken_cause_ = kAbortInternal;  // guarded by broken_mu_
+  int64_t heartbeat_ms_ = 30000;  // HVT_HEARTBEAT_MS (0 → off)
+  // HVT_FAULT_INJECT: parsed at Init when the rank matches; checked
+  // once per data-plane response
+  FaultSpec fault_;
   std::thread thread_;
 
   std::mutex queue_mu_;
@@ -280,6 +353,12 @@ class Engine {
   std::condition_variable handles_cv_;
   std::unordered_map<int32_t, HandleState> handles_;
   int32_t next_handle_ = 0;
+  // Entries taken out of pending_ for the response being executed RIGHT
+  // NOW. If execution throws mid-collective, FailAll error-completes
+  // these too — without this, their handles would never complete and
+  // Engine::Wait would hang forever on an aborted gang. Guarded by
+  // handles_mu_ (CompleteEntry removes; ExecuteResponse adds).
+  std::vector<EntryPtr> inflight_;
 
   // engine-thread-only state
   std::map<std::string, EntryPtr> pending_;  // ordered for determinism

@@ -45,6 +45,9 @@ enum class EventKind : int32_t {
                         // arg2 = missing-rank bitmask (ranks < 64)
   WAKEUP = 10,          // event-driven cycle drained `arg` submissions;
                         // arg2 = submit→drain coalescing latency (µs)
+  ABORT = 11,           // engine entered the sticky broken state;
+                        // arg = abort cause (kAbortCauseNames index),
+                        // name = truncated reason
 };
 
 // POD view of one event — mirrored field-for-field by the ctypes

@@ -11,10 +11,12 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -24,6 +26,62 @@
 #include <vector>
 
 namespace hvt {
+
+// Typed transport failures so the engine can classify its abort cause
+// (hvt_engine_aborts_total{cause}) and the containment path can react
+// differently to a dead peer vs a stalled one. Both inherit
+// runtime_error, so legacy catch sites keep working.
+struct PeerLostError : std::runtime_error {
+  explicit PeerLostError(const std::string& w) : std::runtime_error(w) {}
+};
+struct OpTimeoutError : std::runtime_error {
+  explicit OpTimeoutError(const std::string& w) : std::runtime_error(w) {}
+};
+
+// HVT_OP_TIMEOUT_MS: progress deadline for every control/data socket
+// operation (default 60000; 0 disables). The deadline bounds STALL time,
+// not total transfer time — it re-arms whenever bytes move — so a large
+// collective on a slow link never false-positives while a wedged or
+// silently-dead peer surfaces within one deadline instead of hanging
+// recv forever (the pre-containment failure mode).
+inline int64_t OpTimeoutMs() {
+  static const int64_t ms = [] {
+    const char* v = getenv("HVT_OP_TIMEOUT_MS");
+    return v ? atoll(v) : int64_t{60000};
+  }();
+  return ms;
+}
+
+inline int64_t NowMs() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Block until fd is ready for `events` (POLLIN/POLLOUT) or deadline_ms
+// (absolute, NowMs clock; <0 → no deadline). Throws OpTimeoutError on
+// expiry, PeerLostError when poll itself fails.
+inline void WaitReady(int fd, short events, int64_t deadline_ms,
+                      const char* what) {
+  if (fd < 0)
+    throw PeerLostError(std::string("hvt: ") + what +
+                        " on a closed socket");
+  while (true) {
+    struct pollfd p {fd, events, 0};
+    int wait_ms = -1;
+    if (deadline_ms >= 0) {
+      int64_t left = deadline_ms - NowMs();
+      if (left <= 0)
+        throw OpTimeoutError(std::string("hvt: ") + what +
+                             " deadline exceeded");
+      wait_ms = left > 1000 ? 1000 : static_cast<int>(left);
+    }
+    int rc = ::poll(&p, 1, wait_ms);
+    if (rc > 0) return;  // ready (POLLERR/POLLHUP surface via recv/send)
+    if (rc < 0 && errno != EINTR)
+      throw PeerLostError(std::string("hvt: poll failed during ") + what);
+  }
+}
 
 // HVT_SOCK_BUF: explicit SO_SNDBUF/SO_RCVBUF for every data/control
 // socket (bytes; 0/unset → kernel autotuning). Large rings on fat pipes
@@ -66,22 +124,41 @@ class Sock {
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }
 
-  void SendAll(const void* data, size_t n) const {
+  // Deadline-bounded blocking transfers: the progress deadline
+  // (timeout_ms, default HVT_OP_TIMEOUT_MS; 0 → none) re-arms after
+  // every chunk that moves, so only a stalled peer trips it. A lost
+  // peer (FIN/RST) throws PeerLostError, a stall OpTimeoutError — the
+  // engine maps both to a coordinated abort instead of a hang.
+  void SendAll(const void* data, size_t n, int64_t timeout_ms = -1) const {
+    if (timeout_ms < 0) timeout_ms = OpTimeoutMs();
     auto* p = static_cast<const uint8_t*>(data);
+    int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
     while (n > 0) {
-      ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
-      if (k <= 0) throw std::runtime_error("hvt: send failed (peer lost)");
+      WaitReady(fd_, POLLOUT, deadline, "send (HVT_OP_TIMEOUT_MS)");
+      ssize_t k = ::send(fd_, p, n, MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                    errno == EINTR))
+        continue;
+      if (k <= 0) throw PeerLostError("hvt: send failed (peer lost)");
       p += k;
       n -= static_cast<size_t>(k);
+      if (deadline >= 0) deadline = NowMs() + timeout_ms;  // progress
     }
   }
-  void RecvAll(void* data, size_t n) const {
+  void RecvAll(void* data, size_t n, int64_t timeout_ms = -1) const {
+    if (timeout_ms < 0) timeout_ms = OpTimeoutMs();
     auto* p = static_cast<uint8_t*>(data);
+    int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
     while (n > 0) {
-      ssize_t k = ::recv(fd_, p, n, 0);
-      if (k <= 0) throw std::runtime_error("hvt: recv failed (peer lost)");
+      WaitReady(fd_, POLLIN, deadline, "recv (HVT_OP_TIMEOUT_MS)");
+      ssize_t k = ::recv(fd_, p, n, MSG_DONTWAIT);
+      if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                    errno == EINTR))
+        continue;
+      if (k <= 0) throw PeerLostError("hvt: recv failed (peer lost)");
       p += k;
       n -= static_cast<size_t>(k);
+      if (deadline >= 0) deadline = NowMs() + timeout_ms;  // progress
     }
   }
   // Nonblocking best-effort send/recv (MSG_DONTWAIT — the socket itself
@@ -91,14 +168,14 @@ class Sock {
     ssize_t k = ::send(fd_, data, n, MSG_DONTWAIT | MSG_NOSIGNAL);
     if (k >= 0) return static_cast<size_t>(k);
     if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
-    throw std::runtime_error("hvt: send failed (peer lost)");
+    throw PeerLostError("hvt: send failed (peer lost)");
   }
   size_t RecvSome(void* data, size_t n) const {
     ssize_t k = ::recv(fd_, data, n, MSG_DONTWAIT);
     if (k > 0) return static_cast<size_t>(k);
-    if (k == 0) throw std::runtime_error("hvt: recv failed (peer lost)");
+    if (k == 0) throw PeerLostError("hvt: recv failed (peer lost)");
     if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return 0;
-    throw std::runtime_error("hvt: recv failed (peer lost)");
+    throw PeerLostError("hvt: recv failed (peer lost)");
   }
   // Length-prefixed frames for control messages. A vectored send
   // coalesces the 8-byte header with the payload into one syscall/TCP
@@ -106,7 +183,8 @@ class Sock {
   // without TCP_NODELAY, a Nagle stall. sendmsg (not writev) so
   // MSG_NOSIGNAL applies: a lost peer must surface as the catchable
   // "peer lost" error, not SIGPIPE.
-  void SendFrame(const std::vector<uint8_t>& b) const {
+  void SendFrame(const std::vector<uint8_t>& b,
+                 int64_t timeout_ms = -1) const {
     uint64_t n = b.size();
     struct iovec iov[2];
     iov[0].iov_base = &n;
@@ -117,31 +195,42 @@ class Sock {
     msg.msg_iov = iov;
     msg.msg_iovlen = n ? 2 : 1;
     size_t total = 8 + b.size();
-    ssize_t k = ::sendmsg(fd_, &msg, MSG_NOSIGNAL);
+    // nonblocking first try: a full socket buffer (e.g. a stalled peer)
+    // must fall through to the deadline-bounded byte-wise path, never
+    // wedge inside a blocking sendmsg
+    ssize_t k = ::sendmsg(fd_, &msg, MSG_DONTWAIT | MSG_NOSIGNAL);
     if (k < 0) {
-      if (errno != EINTR)
-        throw std::runtime_error("hvt: send failed (peer lost)");
-      k = 0;  // interrupted before any byte moved: finish byte-wise
+      if (errno != EINTR && errno != EAGAIN && errno != EWOULDBLOCK)
+        throw PeerLostError("hvt: send failed (peer lost)");
+      k = 0;  // nothing moved: finish byte-wise
     }
     if (static_cast<size_t>(k) == total) return;
     // short write (socket buffer full mid-frame): finish byte-wise
     size_t done = static_cast<size_t>(k);
     if (done < 8) {
-      SendAll(reinterpret_cast<const uint8_t*>(&n) + done, 8 - done);
+      SendAll(reinterpret_cast<const uint8_t*>(&n) + done, 8 - done,
+              timeout_ms);
       done = 8;
     }
-    if (done - 8 < b.size()) SendAll(b.data() + (done - 8), b.size() - (done - 8));
+    if (done - 8 < b.size())
+      SendAll(b.data() + (done - 8), b.size() - (done - 8), timeout_ms);
   }
-  std::vector<uint8_t> RecvFrame() const {
+  std::vector<uint8_t> RecvFrame(int64_t timeout_ms = -1) const {
     uint64_t n = 0;
-    RecvAll(&n, 8);
+    RecvAll(&n, 8, timeout_ms);
     std::vector<uint8_t> b(n);
-    if (n) RecvAll(b.data(), n);
+    if (n) RecvAll(b.data(), n, timeout_ms);
     return b;
   }
 
   static Sock Connect(const std::string& host, int port,
                       int timeout_sec = 60) {
+    // HVT_CONNECT_TIMEOUT (seconds) overrides the caller's budget —
+    // slow pods need more than the default startup window
+    if (const char* v = getenv("HVT_CONNECT_TIMEOUT")) {
+      int t = atoi(v);
+      if (t > 0) timeout_sec = t;
+    }
     addrinfo hints{}, *res = nullptr;
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
@@ -149,20 +238,43 @@ class Sock {
     if (getaddrinfo(host.c_str(), p.c_str(), &hints, &res) != 0 || !res)
       throw std::runtime_error("hvt: getaddrinfo failed for " + host);
     int fd = -1;
-    // retry loop: peers come up in arbitrary order
-    for (int attempt = 0; attempt < timeout_sec * 10; ++attempt) {
+    // Retry loop: peers come up in arbitrary order. Exponential backoff
+    // with jitter (10 ms → 1 s) instead of a fixed 100 ms spin: at pod
+    // scale thousands of workers re-dialing a late rank 0 in lockstep
+    // is a listen-backlog thundering herd; jitter decorrelates them.
+    int64_t deadline = NowMs() + int64_t{timeout_sec} * 1000;
+    unsigned seed = static_cast<unsigned>(NowMs() ^ (port << 8) ^
+                                          reinterpret_cast<uintptr_t>(&fd));
+    int64_t backoff_ms = 10;
+    while (true) {
       fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
-      if (fd < 0) continue;
-      if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
-      ::close(fd);
-      fd = -1;
-      struct timespec ts {0, 100000000};  // 100 ms
-      nanosleep(&ts, nullptr);
+      if (fd >= 0) {
+        if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+        ::close(fd);
+        fd = -1;
+      }
+      if (NowMs() >= deadline) break;
+      // ±25% jitter around the current backoff, clamped to the deadline
+      int64_t jitter = backoff_ms / 4;
+      int64_t sleep_ms = backoff_ms - jitter +
+                         (jitter > 0
+                              ? static_cast<int64_t>(rand_r(&seed)) %
+                                    (2 * jitter + 1)
+                              : 0);
+      int64_t left = deadline - NowMs();
+      if (sleep_ms > left) sleep_ms = left;
+      if (sleep_ms > 0) {
+        struct timespec ts {sleep_ms / 1000, (sleep_ms % 1000) * 1000000};
+        nanosleep(&ts, nullptr);
+      }
+      backoff_ms = backoff_ms < 1000 ? backoff_ms * 2 : 1000;
     }
     freeaddrinfo(res);
     if (fd < 0)
-      throw std::runtime_error("hvt: connect to " + host + ":" + p +
-                               " timed out");
+      throw OpTimeoutError("hvt: connect to " + host + ":" + p +
+                           " timed out after " +
+                           std::to_string(timeout_sec) +
+                           " s (HVT_CONNECT_TIMEOUT)");
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     ConfigureSockBufs(fd);
@@ -194,7 +306,15 @@ class Listener {
     getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
     port_ = ntohs(addr.sin_port);
   }
-  Sock Accept() const {
+  Sock Accept(int timeout_sec = 60) const {
+    // bounded like Connect (HVT_CONNECT_TIMEOUT): a peer that never
+    // dials in must fail the rendezvous, not hang it
+    if (const char* v = getenv("HVT_CONNECT_TIMEOUT")) {
+      int t = atoi(v);
+      if (t > 0) timeout_sec = t;
+    }
+    WaitReady(fd_, POLLIN, NowMs() + int64_t{timeout_sec} * 1000,
+              "accept (HVT_CONNECT_TIMEOUT)");
     int c = ::accept(fd_, nullptr, nullptr);
     if (c < 0) throw std::runtime_error("hvt: accept failed");
     int one = 1;

@@ -303,6 +303,12 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
       notified += len;
     }
   };
+  // progress deadline (HVT_OP_TIMEOUT_MS): re-armed whenever bytes move
+  // in either direction, so a genuinely slow transfer keeps going but a
+  // wedged/dead peer trips OpTimeoutError within one deadline instead
+  // of parking the engine thread in poll forever
+  const int64_t timeout_ms = OpTimeoutMs();
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
   while (sent < send_n || rcvd < recv_n) {
     struct pollfd fds[2];
     // a COMPLETED direction is masked with fd = -1 (poll ignores
@@ -315,10 +321,20 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
     fds[1].fd = rcvd < recv_n ? in.fd() : -1;
     fds[1].events = POLLIN;
     fds[1].revents = 0;
-    if (::poll(fds, 2, -1) < 0) {
-      if (errno == EINTR) continue;
-      throw std::runtime_error("hvt: poll failed on data socket");
+    int wait_ms = -1;
+    if (deadline >= 0) {
+      int64_t left = deadline - NowMs();
+      if (left <= 0)
+        throw OpTimeoutError(
+            "hvt: data-plane transfer made no progress for " +
+            std::to_string(timeout_ms) + " ms (HVT_OP_TIMEOUT_MS)");
+      wait_ms = left > 1000 ? 1000 : static_cast<int>(left);
     }
+    if (::poll(fds, 2, wait_ms) < 0) {
+      if (errno == EINTR) continue;
+      throw PeerLostError("hvt: poll failed on data socket");
+    }
+    size_t before = sent + rcvd;
     // service BOTH socket directions before doing any reduce work: the
     // peer must never sit idle behind our compute. The recv is capped
     // per iteration so a fast sender cannot monopolize the loop either.
@@ -331,6 +347,8 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
         (fds[0].revents & (POLLOUT | POLLERR | POLLHUP))) {
       sent += out.SendSome(send_buf + sent, send_n - sent);
     }
+    if (deadline >= 0 && sent + rcvd > before)
+      deadline = NowMs() + timeout_ms;  // progress re-arms the deadline
     // reduce completed chunks last, overlapping the in-flight transfer
     // (the kernel keeps streaming into/out of the socket buffers while
     // this runs)

@@ -111,6 +111,16 @@ class DataPlane {
                       const std::vector<int64_t>& recv_rows,
                       const std::vector<int>& group);
 
+  // Coordinated-abort fan-out: close every peer socket. shutdown(2)
+  // inside Sock::Close sends a FIN, so any peer blocked in a data-plane
+  // recv on this rank wakes immediately with PeerLostError instead of
+  // waiting out its own HVT_OP_TIMEOUT_MS deadline — survivors of a
+  // gang failure converge in one deadline, not N. Engine-thread only
+  // (called on the abort path after the collective in flight threw).
+  void Abort() {
+    for (auto& s : peers_) s.Close();
+  }
+
   // ---- wire telemetry (hvt_engine_stats → metrics plane) --------------
   // The engine stamps the OpType before dispatching a response; every
   // byte this plane sends is attributed to it. The counters themselves

@@ -53,7 +53,12 @@ def run(func):
                 if not skip_sync:
                     state.sync()
                 return func(state, *args, **kwargs)
-            except HorovodInternalError:
+            except HorovodInternalError as e:
+                # a collective failed (peer lost / deadline / abort):
+                # tell the driver which peer we believe died so it can
+                # blacklist the host before the next round, then roll
+                # back to the last commit and re-rendezvous
+                _report_failure(round_, e)
                 state.restore()
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
@@ -93,6 +98,59 @@ def _my_identity():
         _identity = (os.environ.get("HVT_HOSTNAME") or socket.gethostname(),
                      os.environ.get("HVT_LOCAL_PROCESS_ID", "0"))
     return _identity
+
+
+# abort causes where the broken reason's rank annotation names a peer
+# THIS engine directly observed failing. A remote_abort reason instead
+# starts with "abort from rank N" where N is the (healthy, surviving)
+# ORIGINATOR of the abort frame — parsing it would get an innocent
+# host blacklisted — so remote aborts report nothing and leave the
+# attribution to the rank that detected the failure first-hand.
+_DIRECT_DETECTION_CAUSES = ("peer_lost", "timeout", "heartbeat")
+
+
+def _failed_ranks_from_engine() -> list:
+    """Best-effort list of peer ranks this worker believes failed,
+    parsed from the engine's broken reason (the containment layer
+    annotates control-plane failures with the peer's rank, e.g.
+    "peer_lost: control connection to rank 2 lost"; data-plane failures
+    carry no rank and yield [])."""
+    import re
+
+    try:
+        from horovod_tpu.engine import native
+
+        broken, info = native.engine_broken()
+    except Exception:
+        return []
+    if not broken:
+        return []
+    cause = info.split(":", 1)[0].strip()
+    if cause not in _DIRECT_DETECTION_CAUSES:
+        return []
+    return sorted({int(m) for m in re.findall(r"\brank (\d+)\b", info)})
+
+
+def _report_failure(round_: int, err: Exception):
+    """PUT a failure report to the driver (``/kv/failure/<host>/<slot>``)
+    so it can blacklist the failed peer's host ahead of the worker-exit
+    signal. Best-effort — recovery proceeds regardless."""
+    addr = _elastic_addr()
+    if not addr:
+        return
+    from horovod_tpu.runner.http_client import put_json
+
+    host, slot = _my_identity()
+    try:
+        # retries=0: this sits on the recovery path and the driver may
+        # itself be down (e.g. the lost host was the driver's) — a
+        # backoff here would stall every survivor's re-rendezvous
+        put_json(addr, f"/kv/failure/{host}/{slot}",
+                 {"round": round_, "error": str(err)[:2048],
+                  "failed_ranks": _failed_ranks_from_engine()},
+                 timeout=5, retries=0)
+    except OSError:
+        pass
 
 
 def _report_state(state_name: str, round_: int):

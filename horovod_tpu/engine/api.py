@@ -52,7 +52,10 @@ class Handle:
 
     def wait(self, timeout=None):
         if not self._event.wait(timeout):
-            raise TimeoutError("collective did not complete in time")
+            from horovod_tpu.common.exceptions import HorovodTimeoutError
+
+            raise HorovodTimeoutError(
+                "collective did not complete in time")
         if self._error is not None:
             raise self._error
         return self._result

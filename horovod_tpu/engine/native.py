@@ -22,7 +22,8 @@ import time
 
 import numpy as np
 
-from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HorovodTimeoutError)
 
 # wire ids must match csrc/common.h DataType / OpType / ReduceKind
 _DT = {
@@ -95,6 +96,13 @@ def _load():
                                              ctypes.c_int]
             lib.hvt_events_dropped.restype = ctypes.c_longlong
             lib.hvt_diagnostics.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        if getattr(lib, "hvt_wait_timeout", None) is not None:
+            # failure-containment surface (PR 4); a stale .so degrades
+            # to the blocking wait + poll fallback
+            lib.hvt_wait_timeout.argtypes = [ctypes.c_int,
+                                             ctypes.c_longlong]
+            lib.hvt_engine_broken.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_int]
         lib.hvt_result_read.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                         ctypes.c_longlong]
         lib.hvt_result_recv_splits.argtypes = [
@@ -173,7 +181,7 @@ def engine_stats() -> dict:
         return {}
     n_ops = len(STATS_OPS)
     hist = STATS_LAT_BUCKETS + 1 + 2  # buckets + sum_ns + count
-    want = len(STATS_SCALARS) + 4 * n_ops + 2 * hist
+    want = len(STATS_SCALARS) + 4 * n_ops + 2 * hist + len(ABORT_CAUSES)
     buf = (ctypes.c_longlong * want)()
     n = min(int(lib.hvt_engine_stats(buf, want)), want)
     vals = [int(buf[i]) for i in range(n)] + [0] * (want - n)
@@ -194,6 +202,8 @@ def engine_stats() -> dict:
             "count": vals[hbase + STATS_LAT_BUCKETS + 2],
         }
         hbase += hist
+    out["aborts"] = dict(
+        zip(ABORT_CAUSES, vals[hbase:hbase + len(ABORT_CAUSES)]))
     return out
 
 
@@ -229,7 +239,12 @@ assert ctypes.sizeof(EngineEvent) == 96, "EngineEvent ABI drift"
 # index == wire id (csrc/events.h EventKind)
 EVENT_KINDS = ("ENQUEUED", "NEGOTIATE_BEGIN", "NEGOTIATE_END",
                "RANK_READY", "FUSED", "EXEC_BEGIN", "EXEC_END", "DONE",
-               "CYCLE", "STALL", "WAKEUP")
+               "CYCLE", "STALL", "WAKEUP", "ABORT")
+
+# index == wire id (csrc/engine.h AbortCause) — the {cause} label of
+# hvt_engine_aborts_total and slots 70..74 of hvt_engine_stats
+ABORT_CAUSES = ("timeout", "peer_lost", "remote_abort", "heartbeat",
+                "internal")
 
 
 def events_supported() -> bool:
@@ -292,6 +307,24 @@ def diagnostics() -> dict:
         return _json.loads(buf.value.decode(errors="replace"))
     except Exception:
         return {}
+
+
+def engine_broken():
+    """``(broken, info)`` — the engine's sticky containment state.
+
+    ``broken`` is True after a coordinated abort (peer lost, deadline
+    exceeded, heartbeat missed, remote ABORT frame); ``info`` is then
+    ``"<cause>: <reason>"`` with cause one of :data:`ABORT_CAUSES`.
+    While broken, submits fail fast and waits raise
+    :class:`HorovodInternalError`; recovery is ``shutdown()`` + a fresh
+    ``init()`` (the elastic wrapper does this automatically).
+    ``(False, "")`` when the library or symbol is absent."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_engine_broken", None) is None:
+        return False, ""
+    buf = ctypes.create_string_buffer(4096)
+    rc = int(lib.hvt_engine_broken(buf, len(buf)))
+    return bool(rc), buf.value.decode(errors="replace")
 
 
 def engine_rank() -> int:
@@ -360,14 +393,29 @@ class NativeHandle:
                 raise self._error
             return self._result
         lib = _lib
-        if timeout is not None:
+        if timeout is None:
+            # unbounded from the caller's side, but never a hang: the
+            # engine error-completes every handle when it aborts
+            rc = lib.hvt_wait(self._h)
+        elif getattr(lib, "hvt_wait_timeout", None) is not None:
+            rc = lib.hvt_wait_timeout(
+                self._h, ctypes.c_longlong(max(0, int(timeout * 1000))))
+            if rc == 1:  # still pending at the deadline
+                raise HorovodTimeoutError(
+                    f"collective '{self._op}' did not complete within "
+                    f"{timeout} s (still pending; the handle remains "
+                    f"waitable)")
+        else:
+            # stale .so without the timed C API: poll fallback
             deadline = time.monotonic() + timeout
             while not lib.hvt_poll(self._h):
                 if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        "collective did not complete in time")
+                    raise HorovodTimeoutError(
+                        f"collective '{self._op}' did not complete "
+                        f"within {timeout} s (still pending; the "
+                        f"handle remains waitable)")
                 time.sleep(0.001)
-        rc = lib.hvt_wait(self._h)
+            rc = lib.hvt_wait(self._h)
         if rc != 0:
             buf = ctypes.create_string_buffer(4096)
             lib.hvt_error_message(buf, 4096)

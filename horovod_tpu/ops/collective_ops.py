@@ -567,11 +567,14 @@ def barrier(process_set=global_process_set):
                         lambda: engine.barrier(process_set=process_set))
 
 
-def synchronize(handle):
+def synchronize(handle, timeout=None):
     """Block until an async handle completes; returns its output
     (``torch/mpi_ops.py:823``). Raises HorovodInternalError on engine
-    failure, which elastic training interprets as a peer loss."""
-    return handle.wait()
+    failure — bounded by the engine's containment deadlines, never a
+    hang — which elastic training interprets as a peer loss. With
+    ``timeout`` (seconds), raises :class:`hvt.HorovodTimeoutError` if
+    still pending at the deadline; the handle stays waitable."""
+    return handle.wait(timeout=timeout)
 
 
 def poll(handle) -> bool:

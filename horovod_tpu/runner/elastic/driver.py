@@ -192,6 +192,13 @@ class ElasticDriver:
             # commit point and re-rendezvous before the chips vanish
             self._notify_workers_host_changes()
             return
+        if scope == "failure":
+            # a surviving worker caught HorovodInternalError and named
+            # the ranks it believes died (parsed from the engine's abort
+            # reason); blacklist their hosts now rather than waiting for
+            # the dead workers' exit codes to trickle in
+            self._on_failure_report(key, value)
+            return
         if scope != "state":
             return
         try:
@@ -205,6 +212,35 @@ class ElasticDriver:
             return
         if state == "READY":
             self._registry.record_ready(host, int(slot))
+
+    def _on_failure_report(self, key: str, value: bytes):
+        """A survivor's /kv/failure report (key = ``<host>/<slot>`` of
+        the REPORTER): blacklist the hosts of the ranks it named as
+        failed. A rank maps to a host through the CURRENT round's
+        assignment. The reporter's own host is never blacklisted from
+        its report — a process crash sharing the survivor's host is not
+        a lost host (the worker-exit path applies the per-host policy
+        there); this also keeps single-host jobs recoverable. Reports
+        that name no rank (data-plane failures carry no attribution)
+        blacklist nothing — the dead worker's exit handles that."""
+        try:
+            reporter_host = key.rsplit("/", 1)[0]
+            body = json.loads(value)
+            ranks = [int(r) for r in body.get("failed_ranks") or []]
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return
+        if not ranks:
+            return
+        with self._lock:
+            by_rank = {s.rank: s.hostname
+                       for s in self._assignments.values()}
+        for r in ranks:
+            host = by_rank.get(r)
+            if host is not None and host != reporter_host:
+                if self._settings.verbose:
+                    print(f"[elastic driver] failure report names rank "
+                          f"{r} ({host}); blacklisting")
+                self._host_manager.blacklist(host)
 
     def _rendezvous_round(self) -> int:
         return getattr(self._rendezvous, "round", -1)
@@ -346,7 +382,10 @@ class ElasticDriver:
         payload = {"timestamp": time.time(), "res": 1}
         for addr in addrs:
             try:
-                put_json(addr, "/notify", payload, timeout=2)
+                # retries=0: this fans out to every registered worker,
+                # dead ones included — backoff here would stall the
+                # notification of the live ones
+                put_json(addr, "/notify", payload, timeout=2, retries=0)
             except OSError:
                 continue
 

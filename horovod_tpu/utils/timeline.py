@@ -56,7 +56,7 @@ _clock_offset_us = 0.0
 
 # kind wire ids — must match csrc/events.h EventKind / native.EVENT_KINDS
 _ENQUEUED, _NEG_B, _NEG_E, _RANK_READY, _FUSED, _EXEC_B, _EXEC_E, \
-    _DONE, _CYCLE, _STALL, _WAKEUP = range(11)
+    _DONE, _CYCLE, _STALL, _WAKEUP, _ABORT = range(12)
 
 _ENGINE_DRAIN_SEC = 0.05
 
@@ -221,6 +221,22 @@ class _TimelineState:
                     self.cycle_mark(
                         name=f"WAKEUP({ev['arg']} subs, "
                              f"{ev['arg2']} µs)", ts=ts)
+                continue
+            if kind == _ABORT:
+                # always recorded (mark_cycles or not): an abort is the
+                # headline event of any trace that contains one. The
+                # event name field carries the truncated reason; arg is
+                # the cause id (native.ABORT_CAUSES).
+                from horovod_tpu.engine.native import ABORT_CAUSES
+
+                cause = (ABORT_CAUSES[ev["arg"]]
+                         if 0 <= ev["arg"] < len(ABORT_CAUSES)
+                         else "internal")
+                self._emit({"ph": "i", "pid": self.pid,
+                            "tid": self._cycle_lane(),
+                            "name": f"ENGINE_ABORT({cause})", "ts": ts,
+                            "s": "g",
+                            "args": {"cause": cause, "reason": name}})
                 continue
             key = ("eng", name)
             tid = self._lane(key, f"{name} (engine)")

@@ -74,6 +74,7 @@ _SLOW_MODULES = {
     "test_tensorflow_real",      # real keras fits
     "test_torch_parallel",       # multi-process torch gangs
     "test_examples",             # every example as a subprocess
+    "test_failure_containment",  # chaos gangs (SIGKILL/SIGSTOP + deadlines)
     "test_elastic_driver",       # launcher + failure/growth scenarios
     "test_runner",               # launcher subprocesses
     "test_preemption",           # signal/recovery scenarios
