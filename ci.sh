@@ -3,29 +3,47 @@
 # tree (the reference pins its matrix in .buildkite/gen-pipeline.sh; this
 # is the same intent for one TPU/CPU host).
 #
-#   ./ci.sh            # full: build + tests + dryrun + bench smoke
+#   ./ci.sh            # full: build + lint + tests + dryrun + bench smoke
 #   ./ci.sh --fast     # inner loop: quick-marked tests only (~minutes
 #                      # vs ~37 min full on the 1-core host), skip the
 #                      # bench smoke
 #   ./ci.sh --chaos    # build + the fault-injection / failure-
 #                      # containment suite only (SIGKILL/SIGSTOP gangs,
 #                      # deadline bounds, abort metrics)
+#   ./ci.sh --lint     # cross-language contract linter only (~1 s, no
+#                      # build): C API parity, stats-slot ABI, event
+#                      # kinds / frame flags, env-var docs coverage
+#   ./ci.sh --sanitize # TSan + UBSan engine builds + the sanitizer
+#                      # gang suite (one command instead of the
+#                      # hand-assembled HVT_CORE_LIB/LD_PRELOAD dance)
 #
 # Stages:
-#   1. build the C++ core engine (csrc -> libhvt_core.so)
-#   2. full test suite (8-device virtual CPU mesh; includes the
+#   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
+#      -Wthread-safety `tidy` gate (skips when clang is absent)
+#   2. contract lint (hvt_lint; also emits the C-API symbol list the
+#      nm export check consumes)
+#   3. full test suite (8-device virtual CPU mesh; includes the
 #      multi-process engine/launcher/elastic integration suites)
-#   3. driver multi-chip dryrun: dp/sp/tp + MoE ep + GPipe pp on an
+#   4. driver multi-chip dryrun: dp/sp/tp + MoE ep + GPipe pp on an
 #      8-device mesh with exact single-device parity checks
-#   4. bench smoke: tiny ResNet block through bench.py end to end
+#   5. bench smoke: tiny ResNet block through bench.py end to end
 #      (CPU shapes; validates the harness, not the numbers)
 set -euo pipefail
 cd "$(dirname "$0")"
 
 FAST=0
 CHAOS=0
+SANITIZE=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
+[[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
+
+if [[ "${1:-}" == "--lint" ]]; then
+  # pure text analysis — no build, no jax session, ~1 s
+  python -m horovod_tpu.tools.hvt_lint
+  echo "CI OK (lint)"
+  exit 0
+fi
 
 # Hard wall-clock guard around every pytest stage: a failure-containment
 # regression must FAIL CI (timeout rc 124), never stall it — the gang
@@ -36,9 +54,10 @@ run_pytest() {
   timeout -k 30 "$PYTEST_GUARD_SEC" python -m pytest "$@"
 }
 
-echo "=== [1/4] build C++ engine ==="
+echo "=== [1/5] build C++ engine ==="
 make -C horovod_tpu/csrc -j
 make -C horovod_tpu/csrc tf_ops   # no-op when TF is not importable
+make -C horovod_tpu/csrc tidy    # clang -Wthread-safety (skips w/o clang)
 
 # Post-build link smoke check: the seed shipped a .so with an unresolved
 # shm_open that silently skipped every engine test until PR 1 (see
@@ -58,11 +77,11 @@ fi
 
 # The rebuilt .so must export the full C API surface — a stale build
 # dir can silently serve an old .so whose missing symbols make the
-# Python bridge degrade to zeros (PR 3 added the data-plane symbols,
-# PR 4 the abort/timed-wait containment symbols).
-REQUIRED_SYMS="hvt_init hvt_submit hvt_engine_stats hvt_events_drain \
-hvt_diagnostics hvt_wire_compression hvt_scale_buffer \
-hvt_wait_timeout hvt_engine_broken"
+# Python bridge degrade to zeros. The symbol list comes from the lint's
+# c_api.cc parse (single source of truth), so adding a C API in a
+# future PR can never silently skip this check.
+REQUIRED_SYMS="$(python -m horovod_tpu.tools.hvt_lint --emit-symbols)"
+[[ -n "$REQUIRED_SYMS" ]] || { echo "FATAL: --emit-symbols came back empty" >&2; exit 1; }
 for sym in $REQUIRED_SYMS; do
   if ! nm -D "$CORE_SO" 2>/dev/null | grep -q " T $sym\$"; then
     echo "FATAL: $CORE_SO does not export $sym (stale build?)" >&2
@@ -78,7 +97,28 @@ if [[ "$CHAOS" == "1" ]]; then
   exit 0
 fi
 
-echo "=== [2/4] test suite ==="
+if [[ "$SANITIZE" == "1" ]]; then
+  echo "=== [2/2] sanitizer suite (TSan + UBSan gangs) ==="
+  SAN_LOG=$(mktemp)
+  run_pytest tests/test_sanitizers.py -q -ra 2>&1 | tee "$SAN_LOG"
+  # skip-if-unavailable must not make the gate vacuous: at least one
+  # sanitizer gang has to have actually run (gcc<11 skips TSan, a
+  # missing libubsan would skip UBSan — all-skipped means nothing was
+  # checked, which is a failed gate, not a green one)
+  if ! grep -qE "[1-9][0-9]* passed" "$SAN_LOG"; then
+    echo "FATAL: no sanitizer test actually ran (all skipped?)" >&2
+    rm -f "$SAN_LOG"
+    exit 1
+  fi
+  rm -f "$SAN_LOG"
+  echo "CI OK (sanitize)"
+  exit 0
+fi
+
+echo "=== [2/5] contract lint ==="
+python -m horovod_tpu.tools.hvt_lint
+
+echo "=== [3/5] test suite ==="
 if [[ "$FAST" == "1" ]]; then
   # quick subset: modules outside tests/conftest.py's known-slow list
   # (subprocess gangs, TF imports, pallas interpret). Full suite stays
@@ -88,18 +128,18 @@ else
   run_pytest tests/ -x -q
 fi
 
-echo "=== [3/4] multi-chip dryrun (8 virtual devices) ==="
+echo "=== [4/5] multi-chip dryrun (8 virtual devices) ==="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun OK')"
 
 if [[ "$FAST" == "0" ]]; then
-  echo "=== [4/4] bench smoke (CPU harness validation) ==="
+  echo "=== [5/5] bench smoke (CPU harness validation) ==="
   # --force-cpu applies the in-process platform override; the env var
   # alone does not beat platform-pinning site plugins, and CI must never
   # depend on (or collide over) the single-process TPU tunnel
   python bench.py --force-cpu --model resnet50 --batch-size 2 \
     --num-iters 1 --num-batches-per-iter 2 --image-size 32 --no-scaling
 else
-  echo "=== [4/4] bench smoke skipped (--fast) ==="
+  echo "=== [5/5] bench smoke skipped (--fast) ==="
 fi
 
 echo "CI OK"

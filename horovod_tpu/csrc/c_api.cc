@@ -4,6 +4,7 @@
 #include <cstring>
 
 #include "engine.h"
+#include "stats_slots.h"
 
 using hvt::DataType;
 using hvt::Engine;
@@ -185,7 +186,9 @@ int hvt_engine_flags() {
 
 // Live engine stats block for the telemetry bridge
 // (horovod_tpu/metrics; polled by common/basics.py:poll_engine_stats).
-// Fixed layout, in slots:
+// The authoritative slot-by-slot manifest is csrc/stats_slots.h
+// (append-only ABI, machine-checked by tools/hvt_lint.py); the summary
+// below is a convenience copy. Fixed layout, in slots:
 //   0 cycles                 4 cache_misses
 //   1 tensors_submitted      5 fusion_bytes
 //   2 tensors_coordinated    6 responses_fused (coordinator-side)
@@ -203,11 +206,20 @@ int hvt_engine_flags() {
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
+constexpr int kStatsScalars = 8;  // the slot-0..7 scalar block
+constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
+constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
+                                2 * kStatsHist + hvt::kAbortCauses;
+static_assert(kStatsSlotCount == HVT_STATS_SLOT_COUNT,
+              "hvt_engine_stats layout drifted from stats_slots.h — the "
+              "slot ABI is append-only: add new slots to the end of the "
+              "manifest and bump HVT_STATS_SLOT_COUNT (see "
+              "docs/development.md)");
+
 int hvt_engine_stats(long long* out, int max_n) {
   auto& eng = Engine::Get();
   const auto& s = eng.stats();
-  constexpr int kHist = hvt::kLatBuckets + 1 + 2;  // buckets + sum + count
-  long long v[8 + 4 * hvt::kStatsOps + 2 * kHist + hvt::kAbortCauses] = {
+  long long v[kStatsSlotCount] = {
       s.cycles.load(std::memory_order_relaxed),
       s.tensors_submitted.load(std::memory_order_relaxed),
       s.tensors_coordinated.load(std::memory_order_relaxed),
@@ -218,13 +230,14 @@ int hvt_engine_stats(long long* out, int max_n) {
       s.stall_events.load(std::memory_order_relaxed),
   };
   for (int i = 0; i < hvt::kStatsOps; ++i) {
-    v[8 + i] = s.exec_ns[i].load(std::memory_order_relaxed);
-    v[8 + hvt::kStatsOps + i] =
+    v[kStatsScalars + i] = s.exec_ns[i].load(std::memory_order_relaxed);
+    v[kStatsScalars + hvt::kStatsOps + i] =
         s.exec_count[i].load(std::memory_order_relaxed);
-    v[8 + 2 * hvt::kStatsOps + i] = eng.wire_tx_bytes(i);
-    v[8 + 3 * hvt::kStatsOps + i] = eng.wire_tx_comp_bytes(i);
+    v[kStatsScalars + 2 * hvt::kStatsOps + i] = eng.wire_tx_bytes(i);
+    v[kStatsScalars + 3 * hvt::kStatsOps + i] =
+        eng.wire_tx_comp_bytes(i);
   }
-  int base = 8 + 4 * hvt::kStatsOps;
+  int base = kStatsScalars + 4 * hvt::kStatsOps;
   for (const hvt::LatencyHist* h : {&s.cycle_hist, &s.wakeup_hist}) {
     for (int i = 0; i <= hvt::kLatBuckets; ++i)
       v[base++] = h->buckets[i].load(std::memory_order_relaxed);
@@ -233,9 +246,8 @@ int hvt_engine_stats(long long* out, int max_n) {
   }
   for (int i = 0; i < hvt::kAbortCauses; ++i)
     v[base++] = s.aborts[i].load(std::memory_order_relaxed);
-  const int n = 8 + 4 * hvt::kStatsOps + 2 * kHist + hvt::kAbortCauses;
-  for (int i = 0; i < n && i < max_n; ++i) out[i] = v[i];
-  return n;
+  for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
+  return kStatsSlotCount;
 }
 
 // Negotiated wire codec as configured on this rank (WireCodec wire id;

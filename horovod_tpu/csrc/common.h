@@ -18,6 +18,10 @@
 #include <string>
 #include <vector>
 
+// clang -Wthread-safety macros (no-ops under gcc) — included from the
+// root header so every engine file can annotate its locking contracts.
+#include "thread_annotations.h"
+
 namespace hvt {
 
 inline double NowSec() {

@@ -22,11 +22,12 @@ Engine& Engine::Get() {
 // The first byte of every control frame is a flags byte (worker→rank 0)
 // or resp_flags byte (rank 0→worker); neither protocol uses bit 7, so an
 // ABORT frame is any frame whose first byte has kAbortFrameFlag set:
-//   u8(0x80) | i32(origin rank) | str(reason)
+//   u8(kAbortFrameFlag) | i32(origin rank) | str(reason)
 // It can arrive in place of ANY expected frame — both readers check the
 // bit before parsing — which is what lets a failing rank interrupt the
-// gang mid-protocol.
-static constexpr uint8_t kAbortFrameFlag = 0x80;
+// gang mid-protocol. All flag bits live in the wire.h registry
+// (kCtrlFlag* / kRespFlag* / kAbortFrameFlag) so a new flag can never
+// silently collide with the abort bit.
 
 static bool IsAbortFrame(const std::vector<uint8_t>& f) {
   return !f.empty() && (f[0] & kAbortFrameFlag) != 0;
@@ -251,7 +252,7 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   fatal_ = false;
   broken_ = false;  // a fresh init starts healthy (elastic re-init path)
   {
-    std::lock_guard<std::mutex> lk(broken_mu_);
+    MutexLock lk(broken_mu_);
     broken_reason_.clear();
     broken_cause_ = kAbortInternal;
   }
@@ -283,7 +284,7 @@ void Engine::Shutdown() {
   {
     // pair with the cv wait's predicate check so the wakeup can't be
     // missed between predicate evaluation and sleep
-    std::lock_guard<std::mutex> lk(queue_mu_);
+    MutexLock lk(queue_mu_);
   }
   queue_cv_.notify_all();
   if (thread_.joinable()) thread_.join();
@@ -298,7 +299,7 @@ void Engine::Shutdown() {
   pending_.clear();
   counts_.clear();
   {
-    std::lock_guard<std::mutex> lk(handles_mu_);
+    MutexLock lk(handles_mu_);
     inflight_.clear();
   }
   cache_ = ResponseCache(1024);
@@ -324,7 +325,7 @@ int32_t Engine::Submit(EntryPtr entry) {
                  static_cast<int64_t>(entry->input.size()));
   int32_t h;
   {
-    std::lock_guard<std::mutex> lk(handles_mu_);
+    MutexLock lk(handles_mu_);
     h = next_handle_++;
     handles_[h] = HandleState{};
   }
@@ -347,7 +348,7 @@ int32_t Engine::Submit(EntryPtr entry) {
     // mutex, so re-checking fatal_ here closes the submit/abort race:
     // without it, an entry pushed between Submit's fast-path check and
     // FailAll's drain would never complete and its Wait would hang.
-    std::lock_guard<std::mutex> lk(queue_mu_);
+    MutexLock lk(queue_mu_);
     if (!fatal_.load()) {
       submitted_.push_back(std::move(entry));
       accepted = true;
@@ -368,14 +369,18 @@ int32_t Engine::Submit(EntryPtr entry) {
 }
 
 bool Engine::Poll(int32_t handle) {
-  std::lock_guard<std::mutex> lk(handles_mu_);
+  MutexLock lk(handles_mu_);
   auto it = handles_.find(handle);
   return it == handles_.end() || it->second.done;
 }
 
 HandleState Engine::Wait(int32_t handle) {
-  std::unique_lock<std::mutex> lk(handles_mu_);
-  handles_cv_.wait(lk, [&] {
+  CvLock lk(handles_mu_);
+  // REQUIRES on the predicate: clang's thread-safety analysis treats
+  // lambda bodies as separate functions that do not inherit the
+  // enclosing scope's held capabilities — and cv predicates do run
+  // with the lock held.
+  handles_cv_.wait(lk.native(), [&]() REQUIRES(handles_mu_) {
     auto it = handles_.find(handle);
     return it == handles_.end() || it->second.done;
   });
@@ -394,13 +399,13 @@ HandleState Engine::Wait(int32_t handle) {
 
 bool Engine::WaitFor(int32_t handle, int64_t timeout_ms,
                      HandleState& out) {
-  std::unique_lock<std::mutex> lk(handles_mu_);
-  auto done = [&] {
+  CvLock lk(handles_mu_);
+  auto done = [&]() REQUIRES(handles_mu_) {  // see Wait's predicate note
     auto it = handles_.find(handle);
     return it == handles_.end() || it->second.done;
   };
-  if (!handles_cv_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                            done))
+  if (!handles_cv_.wait_for(lk.native(),
+                            std::chrono::milliseconds(timeout_ms), done))
     return false;
   auto it = handles_.find(handle);
   if (it == handles_.end()) {
@@ -416,7 +421,7 @@ bool Engine::WaitFor(int32_t handle, int64_t timeout_ms,
 }
 
 void Engine::Release(int32_t handle) {
-  std::lock_guard<std::mutex> lk(handles_mu_);
+  MutexLock lk(handles_mu_);
   handles_.erase(handle);
 }
 
@@ -424,7 +429,7 @@ void Engine::CompleteEntry(const EntryPtr& e, const Status& s) {
   events_.Record(EventKind::DONE, e->name, static_cast<int32_t>(e->op),
                  static_cast<int32_t>(s.type), 0);
   {
-    std::lock_guard<std::mutex> lk(handles_mu_);
+    MutexLock lk(handles_mu_);
     for (size_t i = 0; i < inflight_.size(); ++i)
       if (inflight_[i] == e) {
         inflight_.erase(inflight_.begin() + static_cast<long>(i));
@@ -448,7 +453,7 @@ void Engine::FailAll(const std::string& why) {
   // complete too, or Engine::Wait would hang past the abort
   std::vector<EntryPtr> inflight;
   {
-    std::lock_guard<std::mutex> lk(handles_mu_);
+    MutexLock lk(handles_mu_);
     inflight.swap(inflight_);
   }
   for (auto& e : inflight) CompleteEntry(e, Status::Aborted(why));
@@ -460,7 +465,7 @@ void Engine::FailAll(const std::string& why) {
     join_entry_.reset();
     join_pending_ = false;
   }
-  std::lock_guard<std::mutex> lk(queue_mu_);
+  MutexLock lk(queue_mu_);
   for (auto& e : submitted_) CompleteEntry(e, Status::Aborted(why));
   submitted_.clear();
 }
@@ -471,7 +476,7 @@ void Engine::FailAll(const std::string& why) {
 
 std::string Engine::BrokenInfo() {
   if (!broken_.load()) return "";
-  std::lock_guard<std::mutex> lk(broken_mu_);
+  MutexLock lk(broken_mu_);
   return std::string(AbortCauseName(broken_cause_)) + ": " +
          broken_reason_;
 }
@@ -481,7 +486,7 @@ void Engine::EnterBroken(int cause, const std::string& why) {
   if (!broken_.compare_exchange_strong(expected, true)) return;
   if (cause < 0 || cause >= kAbortCauses) cause = kAbortInternal;
   {
-    std::lock_guard<std::mutex> lk(broken_mu_);
+    MutexLock lk(broken_mu_);
     broken_cause_ = cause;
     broken_reason_ = why;
   }
@@ -621,10 +626,12 @@ void Engine::ThreadLoop() {
     bool hot = progressed ||
                (outstanding && now - last_progress < grace_sec);
     if (hot || shutdown_requested_.load()) continue;
-    std::unique_lock<std::mutex> lk(queue_mu_);
-    queue_cv_.wait_for(lk, std::chrono::milliseconds(cycle_ms_), [&] {
-      return !submitted_.empty() || shutdown_requested_.load();
-    });
+    CvLock lk(queue_mu_);
+    queue_cv_.wait_for(lk.native(), std::chrono::milliseconds(cycle_ms_),
+                       [&]() REQUIRES(queue_mu_) {  // see Wait's note
+                         return !submitted_.empty() ||
+                                shutdown_requested_.load();
+                       });
   }
 }
 
@@ -634,7 +641,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     timeline_.CycleMark();
   // 1. drain submissions
   {
-    std::lock_guard<std::mutex> lk(queue_mu_);
+    MutexLock lk(queue_mu_);
     if (!submitted_.empty()) {
       progressed = true;
       // wakeup latency: how long the oldest submission sat in the queue
@@ -677,8 +684,8 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
 
   // 2. build the control frame
   uint8_t flags = 0;
-  if (shutdown_requested_.load()) flags |= 1;
-  if (join_pending_) flags |= 2;
+  if (shutdown_requested_.load()) flags |= kCtrlFlagShutdown;
+  if (join_pending_) flags |= kCtrlFlagJoin;
   std::vector<int64_t> hit_positions, invalid_positions;
   std::vector<Request> misses;
   for (auto& [name, e] : pending_) {
@@ -736,7 +743,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     std::vector<std::vector<uint8_t>> frames;
     frames.push_back(std::move(w.buf));
     responses = Coordinate(frames);
-    resp_flags = rank_shutdown_[0] ? 1 : 0;
+    resp_flags = rank_shutdown_[0] ? kRespFlagShutdown : 0;
   } else if (rank_ == 0) {
     std::vector<std::vector<uint8_t>> frames(size_);
     frames[0] = std::move(w.buf);
@@ -768,7 +775,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     bool all_down = true;
     for (bool b : rank_shutdown_)
       all_down = all_down && b;
-    resp_flags = all_down ? 1 : 0;
+    resp_flags = all_down ? kRespFlagShutdown : 0;
     // evictions gathered by Coordinate into pending_evictions_
     Writer out;
     out.u8(resp_flags);
@@ -888,7 +895,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
   if (rank_ == 0) CheckStalls();
   UpdateDiag();
 
-  if (resp_flags & 1) {
+  if (resp_flags & kRespFlagShutdown) {
     // coordinated shutdown: drain anything left as errors
     for (auto& [n, e] : pending_)
       CompleteEntry(e, Status::Aborted("hvt shut down"));
@@ -972,8 +979,8 @@ std::vector<Response> Engine::Coordinate(
   for (int r = 0; r < static_cast<int>(frames.size()); ++r) {
     Reader rd(frames[r]);
     uint8_t flags = rd.u8();
-    rank_shutdown_[r] = rank_shutdown_[r] || (flags & 1);
-    bool joined = (flags & 2) != 0;
+    rank_shutdown_[r] = rank_shutdown_[r] || (flags & kCtrlFlagShutdown);
+    bool joined = (flags & kCtrlFlagJoin) != 0;
     if (joined && !rank_joined_[r])
       last_join_rank_ = r;  // join order is observed here, cycle by cycle
     rank_joined_[r] = joined;
@@ -1543,14 +1550,14 @@ void Engine::CheckStalls() {
 void Engine::UpdateDiag() {
   double now = NowSec();
   {
-    std::lock_guard<std::mutex> lk(diag_mu_);
+    MutexLock lk(diag_mu_);
     if (diag_.valid && now - diag_.updated_sec < 0.1) return;
   }
   DiagState d;
   d.valid = true;
   d.cycles = stats_.cycles.load(std::memory_order_relaxed);
   {
-    std::lock_guard<std::mutex> lk(queue_mu_);
+    MutexLock lk(queue_mu_);
     d.queue_depth = static_cast<int>(submitted_.size());
   }
   for (auto& [name, e] : pending_)
@@ -1581,7 +1588,7 @@ void Engine::UpdateDiag() {
   }
   d.stall_warn_sec = stall_warn_sec_;
   d.updated_sec = now;
-  std::lock_guard<std::mutex> lk(diag_mu_);
+  MutexLock lk(diag_mu_);
   diag_ = std::move(d);
 }
 
@@ -1612,7 +1619,7 @@ static void JsonAppendRanks(std::string& out, const std::vector<int>& v) {
 std::string Engine::DiagnosticsJson() {
   DiagState d;
   {
-    std::lock_guard<std::mutex> lk(diag_mu_);
+    MutexLock lk(diag_mu_);
     d = diag_;
   }
   bool running = initialized_.load();
@@ -1629,7 +1636,7 @@ std::string Engine::DiagnosticsJson() {
   out += ",\"broken\":";
   out += broken_.load() ? "true" : "false";
   if (broken_.load()) {
-    std::lock_guard<std::mutex> lk(broken_mu_);
+    MutexLock lk(broken_mu_);
     out += ",\"abort_cause\":\"";
     out += AbortCauseName(broken_cause_);
     out += "\",\"abort_reason\":\"";
@@ -1751,7 +1758,7 @@ void Engine::ExecuteResponse(const Response& resp,
       // track as in-flight until CompleteEntry: if the data plane
       // throws mid-collective, FailAll must error-complete this entry
       // or its waiter would hang past the abort
-      std::lock_guard<std::mutex> lk(handles_mu_);
+      MutexLock lk(handles_mu_);
       inflight_.push_back(e);
     }
     return e;
@@ -1782,7 +1789,7 @@ void Engine::ExecuteResponse(const Response& resp,
         join_entry_->output.clear();
         HandleState hs;
         {
-          std::lock_guard<std::mutex> lk(handles_mu_);
+          MutexLock lk(handles_mu_);
           auto it = handles_.find(join_entry_->handle);
           if (it != handles_.end()) {
             it->second.join_result = resp.root;

@@ -18,6 +18,22 @@
 // per-tensor ERROR responses instead of deadlocks, matching
 // controller.cc:481-706. The stall inspector (stall_inspector.h lineage)
 // warns from rank 0 when some ranks submitted a tensor and others haven't.
+//
+// Concurrency map (machine-checked by `make tidy` via the clang
+// -Wthread-safety annotations below; see thread_annotations.h):
+//   queue_mu_   guards the submission queue (client threads push, the
+//               engine thread drains);
+//   handles_mu_ guards the handle table + the in-flight entry list
+//               (client threads wait/poll/release, engine thread
+//               completes);
+//   broken_mu_  guards the sticky abort cause/reason strings;
+//   diag_mu_    guards the diagnostics snapshot.
+// Documented lock order: broken_mu_ and queue_mu_ may each be held when
+// handles_mu_ is acquired (FailAll drains submitted_ under queue_mu_
+// and completes each entry, which takes handles_mu_); never the
+// reverse. Fields with no GUARDED_BY are either atomics, engine-thread-
+// only state (pending_/counts_/groups_/...), or set once at Init before
+// the engine thread starts.
 #pragma once
 
 #include <atomic>
@@ -238,24 +254,25 @@ class Engine {
   int wire_mode() const { return wire_mode_; }
   EventRing& events() { return events_; }
   // JSON stall/queue snapshot for hvt_diagnostics (thread-safe).
-  std::string DiagnosticsJson();
+  std::string DiagnosticsJson() EXCLUDES(diag_mu_, broken_mu_);
 
   // Sticky broken state (coordinated abort landed). Submits fail fast
   // and waits return errors until Shutdown() + a fresh Init().
   bool broken() const { return broken_.load(); }
   // "<cause>: <reason>" (empty when healthy); thread-safe.
-  std::string BrokenInfo();
+  std::string BrokenInfo() EXCLUDES(broken_mu_);
 
   // Returns handle (>=0) or -1 when not initialized.
-  int32_t Submit(EntryPtr entry);
+  int32_t Submit(EntryPtr entry) EXCLUDES(queue_mu_, handles_mu_);
 
-  bool Poll(int32_t handle);
+  bool Poll(int32_t handle) EXCLUDES(handles_mu_);
   // Blocks; returns snapshot of the handle state.
-  HandleState Wait(int32_t handle);
+  HandleState Wait(int32_t handle) EXCLUDES(handles_mu_);
   // Bounded wait: false when the handle is still pending after
   // timeout_ms (out untouched), true with the snapshot otherwise.
-  bool WaitFor(int32_t handle, int64_t timeout_ms, HandleState& out);
-  void Release(int32_t handle);
+  bool WaitFor(int32_t handle, int64_t timeout_ms, HandleState& out)
+      EXCLUDES(handles_mu_);
+  void Release(int32_t handle) EXCLUDES(handles_mu_);
 
  private:
   Engine() = default;
@@ -267,13 +284,17 @@ class Engine {
   // outstanding).
   bool RunCycle(bool& progressed, bool& outstanding);
   void ExecuteResponse(const Response& resp,
-                       std::map<std::string, EntryPtr>& pending);
-  void CompleteEntry(const EntryPtr& e, const Status& s);
-  void FailAll(const std::string& why);
+                       std::map<std::string, EntryPtr>& pending)
+      EXCLUDES(handles_mu_);
+  void CompleteEntry(const EntryPtr& e, const Status& s)
+      EXCLUDES(handles_mu_);
+  void FailAll(const std::string& why)
+      EXCLUDES(queue_mu_, handles_mu_);
   // Coordinated abort: sticky broken flag, ABORT fan-out to connected
   // peers, data-plane teardown, error-complete every pending and
   // in-flight entry. Engine-thread only; idempotent.
-  void EnterBroken(int cause, const std::string& why);
+  void EnterBroken(int cause, const std::string& why)
+      EXCLUDES(broken_mu_, queue_mu_, handles_mu_);
   // HVT_FAULT_INJECT hook, called once per data-plane response.
   void MaybeInjectFault();
   // Control-plane recv deadline: HVT_HEARTBEAT_MS when this side is
@@ -293,7 +314,7 @@ class Engine {
   Response BuildResponse(const std::vector<Request>& reqs);
   void FuseResponses(std::vector<Response>& responses);
   void CheckStalls();
-  void UpdateDiag();
+  void UpdateDiag() EXCLUDES(diag_mu_, queue_mu_);
   void HitToArrival(int rank, int64_t pos, double now_sec);
   bool RegisterArrival(const std::string& key, int rank, Request q,
                        double now_sec);
@@ -331,34 +352,39 @@ class Engine {
   // sticky containment state (EnterBroken): set with fatal_, but also
   // carries the cause/reason for hvt_engine_broken / diagnostics
   std::atomic<bool> broken_{false};
-  std::mutex broken_mu_;
-  std::string broken_reason_;  // guarded by broken_mu_
-  int broken_cause_ = kAbortInternal;  // guarded by broken_mu_
+  Mutex broken_mu_ ACQUIRED_BEFORE(handles_mu_);
+  std::string broken_reason_ GUARDED_BY(broken_mu_);
+  int broken_cause_ GUARDED_BY(broken_mu_) = kAbortInternal;
   int64_t heartbeat_ms_ = 30000;  // HVT_HEARTBEAT_MS (0 → off)
   // HVT_FAULT_INJECT: parsed at Init when the rank matches; checked
   // once per data-plane response
   FaultSpec fault_;
   std::thread thread_;
 
-  std::mutex queue_mu_;
+  // FailAll completes drained entries while still holding queue_mu_
+  // (CompleteEntry then takes handles_mu_) — hence the declared order.
+  Mutex queue_mu_ ACQUIRED_BEFORE(handles_mu_);
   // Signaled by Submit (and Shutdown): the event-driven cycle loop
   // wakes immediately instead of finishing a cycle_ms sleep, so
   // cycle_ms is the MAX coalescing wait, not a latency floor.
+  // Waits go through CvLock::native() — the std::unique_lock over the
+  // annotated Mutex's underlying std::mutex.
   std::condition_variable queue_cv_;
-  std::deque<EntryPtr> submitted_;
+  std::deque<EntryPtr> submitted_ GUARDED_BY(queue_mu_);
   bool event_driven_ = true;  // HVT_EVENT_DRIVEN (0 → legacy sleep loop)
   uint8_t wire_mode_ = 0;     // HVT_WIRE_COMPRESSION (WireCodec wire id)
 
-  std::mutex handles_mu_;
+  Mutex handles_mu_;
   std::condition_variable handles_cv_;
-  std::unordered_map<int32_t, HandleState> handles_;
-  int32_t next_handle_ = 0;
+  std::unordered_map<int32_t, HandleState> handles_
+      GUARDED_BY(handles_mu_);
+  int32_t next_handle_ GUARDED_BY(handles_mu_) = 0;
   // Entries taken out of pending_ for the response being executed RIGHT
   // NOW. If execution throws mid-collective, FailAll error-completes
   // these too — without this, their handles would never complete and
-  // Engine::Wait would hang forever on an aborted gang. Guarded by
-  // handles_mu_ (CompleteEntry removes; ExecuteResponse adds).
-  std::vector<EntryPtr> inflight_;
+  // Engine::Wait would hang forever on an aborted gang
+  // (CompleteEntry removes; ExecuteResponse adds).
+  std::vector<EntryPtr> inflight_ GUARDED_BY(handles_mu_);
 
   // engine-thread-only state
   std::map<std::string, EntryPtr> pending_;  // ordered for determinism
@@ -396,8 +422,8 @@ class Engine {
   EngineStats stats_;             // live telemetry (hvt_engine_stats)
   EngineTimeline timeline_;       // rank-0 chrome trace (HVT_TIMELINE)
   EventRing events_;              // flight recorder (hvt_events_drain)
-  std::mutex diag_mu_;
-  DiagState diag_;                // see DiagState docs above
+  Mutex diag_mu_;
+  DiagState diag_ GUARDED_BY(diag_mu_);  // see DiagState docs above
 
   std::vector<uint8_t> fusion_buffer_;
 };

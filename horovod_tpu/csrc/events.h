@@ -28,6 +28,8 @@
 #include <mutex>
 #include <string>
 
+#include "thread_annotations.h"
+
 namespace hvt {
 
 // Wire ids — part of the C ABI (EVENT_KINDS in engine/native.py).
@@ -94,8 +96,8 @@ class EventRing {
   // Copies up to max_n published events into out, oldest first; returns
   // the number copied. Events overwritten before they were drained are
   // skipped and counted in dropped().
-  int Drain(EventView* out, int max_n) {
-    std::lock_guard<std::mutex> lk(drain_mu_);
+  int Drain(EventView* out, int max_n) EXCLUDES(drain_mu_) {
+    MutexLock lk(drain_mu_);
     int n = 0;
     while (n < max_n) {
       uint64_t want = tail_ + 1;
@@ -144,7 +146,7 @@ class EventRing {
 
   // Jump the read cursor to the oldest slot that can still be intact,
   // counting everything skipped as dropped.
-  void SkipToWindow() {
+  void SkipToWindow() REQUIRES(drain_mu_) {
     uint64_t head = head_.load(std::memory_order_relaxed);
     uint64_t oldest = head > kCapacity ? head - kCapacity : 0;
     // one extra slot of slack: the slot at `oldest` may be the one a
@@ -159,9 +161,9 @@ class EventRing {
 
   Slot slots_[kCapacity];
   std::atomic<uint64_t> head_{0};
-  uint64_t tail_ = 0;  // guarded by drain_mu_
+  uint64_t tail_ GUARDED_BY(drain_mu_) = 0;
   std::atomic<int64_t> dropped_{0};
-  std::mutex drain_mu_;
+  Mutex drain_mu_;
 };
 
 }  // namespace hvt

@@ -20,12 +20,23 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
-#include <mutex>
 #include <stdexcept>
 #include <string>
 #include <vector>
 
+#include "thread_annotations.h"
+
 namespace hvt {
+
+// Thread-safety contract (checked by the engine-layer annotations
+// rather than locks here): Sock and Listener are NOT internally
+// synchronized. Every socket is engine-thread affine after Init — the
+// rendezvous builds them on the caller's thread before the engine
+// thread starts, and Shutdown closes them only after joining it. The
+// only cross-thread transition is DataPlane::Abort / fault injection,
+// both of which run ON the engine thread. Static env-derived settings
+// (OpTimeoutMs, ConfigureSockBufs) are initialized via thread-safe
+// function-local statics.
 
 // Typed transport failures so the engine can classify its abort cause
 // (hvt_engine_aborts_total{cause}) and the containment path can react

@@ -1,0 +1,102 @@
+// hvt_engine_stats slot manifest — THE single source of truth for the
+// stats-slot ABI shared by csrc/c_api.cc (producer), engine/native.py
+// (ctypes decoder), and common/basics.py poll_engine_stats (metrics
+// bridge). The contract is APPEND-ONLY:
+//
+//   * never renumber, reorder, reuse, or delete a slot — older .so /
+//     newer Python (and vice versa) must keep agreeing on every index
+//     that both sides know about;
+//   * to add telemetry, append new slots at the end, bump
+//     HVT_STATS_SLOT_COUNT, extend the layout constants in
+//     engine/native.py, and read the new fields in poll_engine_stats
+//     (docs/development.md walks through it).
+//
+// The cross-language lint (horovod_tpu/tools/hvt_lint.py, `ci.sh
+// --lint`) machine-checks all of this: indices contiguous and unique,
+// names matching the Python layout exactly, the count matching the
+// C++ formula (static_assert in c_api.cc), and every slot group read
+// by the metrics bridge. Names use the Python-layout spelling:
+// scalar slots are bare names, per-op arrays are `group[op]`, and the
+// two engine histograms are `hist.bucket[i]` / `hist.sum_ns` /
+// `hist.count`.
+#pragma once
+
+#define HVT_STATS_SLOT_COUNT 75
+
+// X-macro: HVT_STATS_SLOT(index, "name")
+#define HVT_STATS_SLOTS(X)                  \
+  X(0, "cycles")                            \
+  X(1, "tensors_submitted")                 \
+  X(2, "tensors_coordinated")               \
+  X(3, "cache_hits")                        \
+  X(4, "cache_misses")                      \
+  X(5, "fusion_bytes")                      \
+  X(6, "responses_fused")                   \
+  X(7, "stall_events")                      \
+  X(8, "exec_ns[allreduce]")                \
+  X(9, "exec_ns[allgather]")                \
+  X(10, "exec_ns[broadcast]")               \
+  X(11, "exec_ns[alltoall]")                \
+  X(12, "exec_ns[reducescatter]")           \
+  X(13, "exec_ns[join]")                    \
+  X(14, "exec_ns[barrier]")                 \
+  X(15, "exec_count[allreduce]")            \
+  X(16, "exec_count[allgather]")            \
+  X(17, "exec_count[broadcast]")            \
+  X(18, "exec_count[alltoall]")             \
+  X(19, "exec_count[reducescatter]")        \
+  X(20, "exec_count[join]")                 \
+  X(21, "exec_count[barrier]")              \
+  X(22, "wire_tx_bytes[allreduce]")         \
+  X(23, "wire_tx_bytes[allgather]")         \
+  X(24, "wire_tx_bytes[broadcast]")         \
+  X(25, "wire_tx_bytes[alltoall]")          \
+  X(26, "wire_tx_bytes[reducescatter]")     \
+  X(27, "wire_tx_bytes[join]")              \
+  X(28, "wire_tx_bytes[barrier]")           \
+  X(29, "wire_tx_comp_bytes[allreduce]")    \
+  X(30, "wire_tx_comp_bytes[allgather]")    \
+  X(31, "wire_tx_comp_bytes[broadcast]")    \
+  X(32, "wire_tx_comp_bytes[alltoall]")     \
+  X(33, "wire_tx_comp_bytes[reducescatter]") \
+  X(34, "wire_tx_comp_bytes[join]")         \
+  X(35, "wire_tx_comp_bytes[barrier]")      \
+  X(36, "cycle_hist.bucket[0]")             \
+  X(37, "cycle_hist.bucket[1]")             \
+  X(38, "cycle_hist.bucket[2]")             \
+  X(39, "cycle_hist.bucket[3]")             \
+  X(40, "cycle_hist.bucket[4]")             \
+  X(41, "cycle_hist.bucket[5]")             \
+  X(42, "cycle_hist.bucket[6]")             \
+  X(43, "cycle_hist.bucket[7]")             \
+  X(44, "cycle_hist.bucket[8]")             \
+  X(45, "cycle_hist.bucket[9]")             \
+  X(46, "cycle_hist.bucket[10]")            \
+  X(47, "cycle_hist.bucket[11]")            \
+  X(48, "cycle_hist.bucket[12]")            \
+  X(49, "cycle_hist.bucket[13]")            \
+  X(50, "cycle_hist.bucket[14]")            \
+  X(51, "cycle_hist.sum_ns")                \
+  X(52, "cycle_hist.count")                 \
+  X(53, "wakeup_hist.bucket[0]")            \
+  X(54, "wakeup_hist.bucket[1]")            \
+  X(55, "wakeup_hist.bucket[2]")            \
+  X(56, "wakeup_hist.bucket[3]")            \
+  X(57, "wakeup_hist.bucket[4]")            \
+  X(58, "wakeup_hist.bucket[5]")            \
+  X(59, "wakeup_hist.bucket[6]")            \
+  X(60, "wakeup_hist.bucket[7]")            \
+  X(61, "wakeup_hist.bucket[8]")            \
+  X(62, "wakeup_hist.bucket[9]")            \
+  X(63, "wakeup_hist.bucket[10]")           \
+  X(64, "wakeup_hist.bucket[11]")           \
+  X(65, "wakeup_hist.bucket[12]")           \
+  X(66, "wakeup_hist.bucket[13]")           \
+  X(67, "wakeup_hist.bucket[14]")           \
+  X(68, "wakeup_hist.sum_ns")               \
+  X(69, "wakeup_hist.count")                \
+  X(70, "aborts[timeout]")                  \
+  X(71, "aborts[peer_lost]")                \
+  X(72, "aborts[remote_abort]")             \
+  X(73, "aborts[heartbeat]")                \
+  X(74, "aborts[internal]")

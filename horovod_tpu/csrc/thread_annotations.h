@@ -1,0 +1,104 @@
+// Clang thread-safety annotations (-Wthread-safety) for the engine's
+// five-mutex concurrency (queue/handles/broken/diag locks + the event
+// ring's drain lock). The macros expand to real attributes under clang
+// and to nothing under gcc, so the default build is unaffected while
+// `make tidy` (clang++ -fsyntax-only -Wthread-safety -Werror) machine-
+// checks every GUARDED_BY / REQUIRES / EXCLUDES contract and the
+// declared lock order. Reference: the Horovod lineage relies on TSan at
+// runtime for this (SURVEY §5.2); the annotations move the same class
+// of bug to compile time.
+//
+// std::mutex is not a capability-annotated type, so the analysis cannot
+// follow it; hvt::Mutex wraps it with the capability attributes and
+// hvt::MutexLock / hvt::CvLock are the annotated scoped guards (the
+// std::lock_guard / std::unique_lock equivalents). Condition variables
+// stay std::condition_variable, waiting on CvLock::native() — the
+// underlying std::unique_lock<std::mutex>. (Not condition_variable_any:
+// its internal shared mutex trips known TSan false positives on
+// libstdc++ — double-lock / lock-order reports inside wait/notify —
+// which would poison the `ci.sh --sanitize` gangs.) The wait's
+// unlock/relock is invisible to the analysis, which is sound: the
+// capability is held at every point the waiting code touches guarded
+// state (predicates run with the lock held).
+#pragma once
+
+#include <mutex>
+
+#if defined(__clang__) && (!defined(SWIG))
+#define HVT_THREAD_ANNOTATION__(x) __attribute__((x))
+#else
+#define HVT_THREAD_ANNOTATION__(x)  // no-op under gcc
+#endif
+
+#define CAPABILITY(x) HVT_THREAD_ANNOTATION__(capability(x))
+#define SCOPED_CAPABILITY HVT_THREAD_ANNOTATION__(scoped_lockable)
+#define GUARDED_BY(x) HVT_THREAD_ANNOTATION__(guarded_by(x))
+#define PT_GUARDED_BY(x) HVT_THREAD_ANNOTATION__(pt_guarded_by(x))
+#define ACQUIRED_BEFORE(...) \
+  HVT_THREAD_ANNOTATION__(acquired_before(__VA_ARGS__))
+#define ACQUIRED_AFTER(...) \
+  HVT_THREAD_ANNOTATION__(acquired_after(__VA_ARGS__))
+#define REQUIRES(...) \
+  HVT_THREAD_ANNOTATION__(requires_capability(__VA_ARGS__))
+#define ACQUIRE(...) \
+  HVT_THREAD_ANNOTATION__(acquire_capability(__VA_ARGS__))
+#define RELEASE(...) \
+  HVT_THREAD_ANNOTATION__(release_capability(__VA_ARGS__))
+#define TRY_ACQUIRE(...) \
+  HVT_THREAD_ANNOTATION__(try_acquire_capability(__VA_ARGS__))
+#define EXCLUDES(...) HVT_THREAD_ANNOTATION__(locks_excluded(__VA_ARGS__))
+#define RETURN_CAPABILITY(x) HVT_THREAD_ANNOTATION__(lock_returned(x))
+#define NO_THREAD_SAFETY_ANALYSIS \
+  HVT_THREAD_ANNOTATION__(no_thread_safety_analysis)
+
+namespace hvt {
+
+// std::mutex with the capability attribute the analysis needs.
+// native() exposes the wrapped mutex for std::condition_variable waits
+// (via CvLock below) — the capability and the lockable object are the
+// same mutex, so the annotation stays truthful.
+class CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+
+  void lock() ACQUIRE() { mu_.lock(); }
+  void unlock() RELEASE() { mu_.unlock(); }
+  bool try_lock() TRY_ACQUIRE(true) { return mu_.try_lock(); }
+  std::mutex& native() { return mu_; }
+
+ private:
+  std::mutex mu_;
+};
+
+// Annotated std::lock_guard equivalent.
+class SCOPED_CAPABILITY MutexLock {
+ public:
+  explicit MutexLock(Mutex& mu) ACQUIRE(mu) : mu_(mu) { mu_.lock(); }
+  ~MutexLock() RELEASE() { mu_.unlock(); }
+  MutexLock(const MutexLock&) = delete;
+  MutexLock& operator=(const MutexLock&) = delete;
+
+ private:
+  Mutex& mu_;
+};
+
+// Annotated std::unique_lock equivalent for condition-variable waits:
+// pass native() to std::condition_variable::wait / wait_for. The lock
+// is held whenever control is outside the wait (including inside wait
+// predicates), which is exactly what the scope annotation claims.
+class SCOPED_CAPABILITY CvLock {
+ public:
+  explicit CvLock(Mutex& mu) ACQUIRE(mu) : lk_(mu.native()) {}
+  ~CvLock() RELEASE() {}
+  CvLock(const CvLock&) = delete;
+  CvLock& operator=(const CvLock&) = delete;
+
+  std::unique_lock<std::mutex>& native() { return lk_; }
+
+ private:
+  std::unique_lock<std::mutex> lk_;
+};
+
+}  // namespace hvt

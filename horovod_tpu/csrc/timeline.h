@@ -20,12 +20,14 @@
 #include <thread>
 #include <unordered_map>
 
+#include "thread_annotations.h"
+
 namespace hvt {
 
 class EngineTimeline {
  public:
   void Initialize(const std::string& path, bool mark_cycles) {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     if (file_) return;
     file_ = fopen(path.c_str(), "w");
     if (!file_) return;
@@ -61,7 +63,7 @@ class EngineTimeline {
 
   void Shutdown() {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       if (!file_) return;
       stop_ = true;
     }
@@ -106,7 +108,7 @@ class EngineTimeline {
 
   void Emit(const std::string& tensor, const char* phase,
             const std::string& name) {
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     if (!file_) return;
     auto it = lanes_.find(tensor);
     int lane;
@@ -123,7 +125,7 @@ class EngineTimeline {
   void WriterLoop() {
     while (true) {
       {
-        std::lock_guard<std::mutex> lk(mu_);
+        MutexLock lk(mu_);
         if (stop_) return;
       }
       Drain();
@@ -135,7 +137,7 @@ class EngineTimeline {
     std::deque<Event> local;
     std::deque<std::pair<int, std::string>> names;
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      MutexLock lk(mu_);
       local.swap(queue_);
       names.swap(lane_names_);
     }
@@ -162,16 +164,19 @@ class EngineTimeline {
     fflush(file_);
   }
 
-  std::mutex mu_;
+  Mutex mu_;
+  // file_ / first_ / mark_cycles_ / start_us_ are writer-thread (and
+  // Initialize/Shutdown) state — cross-thread reads are the benign
+  // active() flag check, so they stay unguarded by design.
   FILE* file_ = nullptr;
   bool mark_cycles_ = false;
-  bool stop_ = false;
+  bool stop_ GUARDED_BY(mu_) = false;
   bool first_ = true;
   int64_t start_us_ = 0;
-  int next_lane_ = 0;
-  std::unordered_map<std::string, int> lanes_;
-  std::deque<std::pair<int, std::string>> lane_names_;
-  std::deque<Event> queue_;
+  int next_lane_ GUARDED_BY(mu_) = 0;
+  std::unordered_map<std::string, int> lanes_ GUARDED_BY(mu_);
+  std::deque<std::pair<int, std::string>> lane_names_ GUARDED_BY(mu_);
+  std::deque<Event> queue_ GUARDED_BY(mu_);
   std::thread writer_;
 };
 

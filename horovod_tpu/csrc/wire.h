@@ -13,6 +13,23 @@
 
 namespace hvt {
 
+// --------------------------------------------------------------------------
+// Frame-flag registry — every control-frame flag bit is defined ONCE,
+// here. The first byte of a worker→rank-0 frame is the kCtrlFlag* set;
+// the first byte of a rank-0→worker frame is the kRespFlag* set; a
+// frame whose first byte has kAbortFrameFlag set is an ABORT in EITHER
+// direction (it replaces any expected frame, so both readers check it
+// before parsing — engine.cc IsAbortFrame). A new flag must claim an
+// unused bit in its direction AND must not collide with the abort bit;
+// the cross-language lint (tools/hvt_lint.py) enforces both, plus that
+// no other file re-defines these constants.
+// --------------------------------------------------------------------------
+constexpr uint8_t kCtrlFlagShutdown = 0x01;  // rank requests shutdown
+constexpr uint8_t kCtrlFlagJoin = 0x02;      // rank has joined
+constexpr uint8_t kRespFlagShutdown = 0x01;  // whole gang shut down
+constexpr uint8_t kAbortFrameFlag = 0x80;    // frame is an ABORT
+                                             // (origin rank + reason)
+
 struct Request {
   int32_t rank = 0;
   OpType op = OpType::ALLREDUCE;

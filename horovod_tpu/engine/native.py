@@ -181,7 +181,7 @@ def engine_stats() -> dict:
         return {}
     n_ops = len(STATS_OPS)
     hist = STATS_LAT_BUCKETS + 1 + 2  # buckets + sum_ns + count
-    want = len(STATS_SCALARS) + 4 * n_ops + 2 * hist + len(ABORT_CAUSES)
+    want = STATS_SLOT_COUNT
     buf = (ctypes.c_longlong * want)()
     n = min(int(lib.hvt_engine_stats(buf, want)), want)
     vals = [int(buf[i]) for i in range(n)] + [0] * (want - n)
@@ -245,6 +245,13 @@ EVENT_KINDS = ("ENQUEUED", "NEGOTIATE_BEGIN", "NEGOTIATE_END",
 # hvt_engine_aborts_total and slots 70..74 of hvt_engine_stats
 ABORT_CAUSES = ("timeout", "peer_lost", "remote_abort", "heartbeat",
                 "internal")
+
+# Total hvt_engine_stats slots this bridge decodes. Must equal
+# HVT_STATS_SLOT_COUNT in csrc/stats_slots.h — the manifest is the
+# append-only ABI record and tools/hvt_lint.py cross-checks both sides
+# (plus the slot names) on every `ci.sh --lint`.
+STATS_SLOT_COUNT = (len(STATS_SCALARS) + 4 * len(STATS_OPS)
+                    + 2 * (STATS_LAT_BUCKETS + 1 + 2) + len(ABORT_CAUSES))
 
 
 def events_supported() -> bool:
@@ -333,6 +340,19 @@ def engine_rank() -> int:
 
 def engine_size() -> int:
     return _lib.hvt_size() if engine_running() else 1
+
+
+def engine_local_rank() -> int:
+    """This rank's index within its host group as the C++ topology
+    builder sees it (``hvt_local_rank``) — lets callers cross-check the
+    engine's view against the launcher-provided env layout."""
+    return _lib.hvt_local_rank() if engine_running() else 0
+
+
+def engine_local_size() -> int:
+    """Number of engine ranks the topology builder co-located on this
+    host (``hvt_local_size``); 1 when the engine is not running."""
+    return _lib.hvt_local_size() if engine_running() else 1
 
 
 def _np_dtype_id(arr: np.ndarray) -> int:

@@ -1,0 +1,3 @@
+"""Developer tooling for the repository itself (not part of the runtime
+API surface). ``hvt_lint`` is the cross-language contract checker run as
+a tier-1 test and as ``./ci.sh --lint``."""

@@ -1,0 +1,586 @@
+"""Cross-language contract linter for the hvt engine (``ci.sh --lint``).
+
+The C++ core and the Python bindings share several hand-maintained
+contracts: the ``hvt_*`` C-API symbol list, the append-only
+``hvt_engine_stats`` slot ABI, the flight-recorder event kinds, the
+control-frame flag bits, and the ``HVT_*`` environment knobs. Each lives
+in 3-4 places (``csrc/``, ``engine/native.py``, ``common/basics.py``,
+``ci.sh``, ``docs/``); before this linter nothing but reviewer
+discipline kept them in sync (the reference pins the same class of
+contract with FlatBuffers codegen + a CI sanitizer matrix, SURVEY §5.2).
+
+Four passes, each dependency-free (stdlib ``re``/``ast`` text analysis —
+no compiler, no imports of the checked modules):
+
+``capi``
+    every ``extern "C"`` function in ``csrc/c_api.cc`` is referenced by
+    a binding file and every bound name exists in C. Also the source of
+    ``--emit-symbols``, which ci.sh's ``nm -D`` export check consumes
+    (the symbol list can no longer be hand-copied and go stale).
+``slots``
+    ``csrc/stats_slots.h`` is the append-only manifest of the
+    ``hvt_engine_stats`` ABI: indices contiguous and unique, names
+    matching the layout constants in ``engine/native.py`` slot for
+    slot, the count matching the C++ formula (``static_assert`` in
+    c_api.cc), and every slot group read by
+    ``common/basics.py:poll_engine_stats``.
+``events``
+    ``csrc/events.h`` EventKind ↔ ``native.EVENT_KINDS`` ↔ the
+    ``utils/timeline.py`` drainer mapping (an event kind nobody drains
+    is telemetry silently thrown away), plus the wire.h frame-flag
+    registry: single-bit values, no collisions per direction (including
+    with the 0x80 abort flag), defined once, and actually used.
+``env``
+    every ``getenv("HVT_…")`` / ``os.environ[...]("HVT_…")`` read in the
+    tree has a docs row, and every documented knob still has a read
+    site (no ghost documentation).
+
+Run ``python -m horovod_tpu.tools.hvt_lint`` (all passes), optionally
+naming a subset, ``--root`` for an alternate tree (the fixture tests
+use it), or ``--emit-symbols`` to print the canonical C-API symbol
+list. Exit status 0 = clean, 1 = violations, 2 = usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+from pathlib import Path
+
+# ---------------------------------------------------------------------------
+# tree layout — relative to the repo root. tests/test_hvt_lint.py builds
+# fixture trees with these same paths, so keep them data, not code.
+# ---------------------------------------------------------------------------
+C_API_CC = "horovod_tpu/csrc/c_api.cc"
+ENGINE_H = "horovod_tpu/csrc/engine.h"
+ENGINE_CC = "horovod_tpu/csrc/engine.cc"
+EVENTS_H = "horovod_tpu/csrc/events.h"
+WIRE_H = "horovod_tpu/csrc/wire.h"
+STATS_SLOTS_H = "horovod_tpu/csrc/stats_slots.h"
+NATIVE_PY = "horovod_tpu/engine/native.py"
+BASICS_PY = "horovod_tpu/common/basics.py"
+TIMELINE_PY = "horovod_tpu/utils/timeline.py"
+CSRC_DIR = "horovod_tpu/csrc"
+DOCS_DIR = "docs"
+
+# Files allowed (and required) to bind hvt_* symbols over ctypes. The
+# first is the production bridge; the test files bind the test-only
+# entry points (GP/BO internals, ScaleBuffer, autotune state).
+BINDING_FILES = (
+    NATIVE_PY,
+    "tests/test_autotune.py",
+    "tests/test_ring_kernels.py",
+)
+
+# Where HVT_* env reads count as product surface needing documentation.
+# tests/ and examples/ set knobs but their reads are not user surface.
+ENV_SCAN_DIRS = ("horovod_tpu", "benchmarks")
+ENV_SCAN_FILES = ("bench.py",)
+
+# The four per-op slot groups and the two engine histograms, in the
+# exact order hvt_engine_stats emits them (after the scalar block,
+# before the abort-cause block).
+SLOT_OP_GROUPS = ("exec_ns", "exec_count", "wire_tx_bytes",
+                  "wire_tx_comp_bytes")
+SLOT_HISTS = ("cycle_hist", "wakeup_hist")
+
+
+def _read(root: Path, rel: str, vios: list, pass_name: str):
+    p = root / rel
+    try:
+        return p.read_text()
+    except OSError:
+        vios.append(f"{pass_name}: {rel}: file missing (the {pass_name} "
+                    f"pass cannot run without it)")
+        return None
+
+
+def _py_literals(text: str, names: set):
+    """Top-level ``NAME = <literal>`` assignments from a module's source
+    (ast.literal_eval — no import, so jax/numpy never load)."""
+    out = {}
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name) and tgt.id in names:
+            try:
+                out[tgt.id] = ast.literal_eval(node.value)
+            except ValueError:
+                pass
+    return out
+
+
+def _c_int_const(text: str, name: str):
+    m = re.search(rf'constexpr\s+int\s+{name}\s*=\s*(\d+)\s*;', text)
+    return int(m.group(1)) if m else None
+
+
+# ---------------------------------------------------------------------------
+# pass 1: C-API parity
+# ---------------------------------------------------------------------------
+
+# Any non-static file-scope definition/declaration `<type tokens>
+# hvt_name(` — deliberately loose on the return type (int, void,
+# long long, const char*, int64_t, …) so a new entry point can never
+# dodge the parity check by returning a type the regex never met.
+# Call sites don't match: they are indented (the anchor is column 0).
+_C_DEF_RE = re.compile(
+    r'^(?!static\b)(?:[A-Za-z_][A-Za-z0-9_:<>]*[ \t*]+)+(hvt_\w+)\s*\(',
+    re.M)
+# ctypes references: `lib.hvt_x` / `_lib.hvt_x(...)` / `lib().hvt_x`,
+# plus the getattr probe used for graceful degradation on stale .so's.
+_PY_ATTR_RE = re.compile(r'\.\s*(hvt_\w+)\b')
+_PY_GETATTR_RE = re.compile(r'getattr\(\s*\w+\s*,\s*"(hvt_\w+)"')
+
+
+def c_api_symbols(root: Path):
+    """The extern-C surface of c_api.cc (sorted). Raises on a missing
+    file — callers that want a violation instead use check_capi."""
+    text = (root / C_API_CC).read_text()
+    return sorted(set(_C_DEF_RE.findall(text)))
+
+
+def check_capi(root: Path):
+    vios = []
+    text = _read(root, C_API_CC, vios, "capi")
+    if text is None:
+        return vios
+    defs = _C_DEF_RE.findall(text)
+    dup = {s for s in defs if defs.count(s) > 1}
+    for s in sorted(dup):
+        vios.append(f"capi: {C_API_CC}: symbol {s} defined more than once")
+    syms = set(defs)
+    if 'extern "C"' not in text:
+        vios.append(f'capi: {C_API_CC}: no extern "C" block — every '
+                    f'hvt_* entry point must have C linkage for ctypes')
+    refs = {}  # symbol -> first referencing file
+    for rel in BINDING_FILES:
+        p = root / rel
+        if not p.exists():
+            # test-binding files are optional in fixture trees; the
+            # production bridge is not
+            if rel == NATIVE_PY:
+                vios.append(f"capi: {rel}: file missing (the ctypes "
+                            f"bridge is the binding side of the parity "
+                            f"check)")
+            continue
+        body = p.read_text()
+        for sym in (_PY_ATTR_RE.findall(body)
+                    + _PY_GETATTR_RE.findall(body)):
+            refs.setdefault(sym, rel)
+    for sym in sorted(syms - set(refs)):
+        vios.append(
+            f"capi: {C_API_CC}: {sym} is exported but bound nowhere in "
+            f"{', '.join(BINDING_FILES)} — dead C API surface (bind it "
+            f"or remove it)")
+    for sym, rel in sorted(refs.items()):
+        if sym not in syms:
+            vios.append(
+                f"capi: {rel}: binds {sym}, which c_api.cc does not "
+                f"define — the call will fail at runtime on attribute "
+                f"lookup")
+    return vios
+
+
+# ---------------------------------------------------------------------------
+# pass 2: stats-slot ABI manifest
+# ---------------------------------------------------------------------------
+
+_SLOT_RE = re.compile(r'X\(\s*(\d+)\s*,\s*"([^"]+)"\s*\)')
+_SLOT_COUNT_RE = re.compile(r'#define\s+HVT_STATS_SLOT_COUNT\s+(\d+)')
+
+
+def check_slots(root: Path):
+    vios = []
+    manifest = _read(root, STATS_SLOTS_H, vios, "slots")
+    native = _read(root, NATIVE_PY, vios, "slots")
+    engine_h = _read(root, ENGINE_H, vios, "slots")
+    c_api = _read(root, C_API_CC, vios, "slots")
+    basics = _read(root, BASICS_PY, vios, "slots")
+    if None in (manifest, native, engine_h, c_api, basics):
+        return vios
+
+    slots = [(int(i), n) for i, n in _SLOT_RE.findall(manifest)]
+    m = _SLOT_COUNT_RE.search(manifest)
+    declared = int(m.group(1)) if m else None
+    if declared is None:
+        vios.append(f"slots: {STATS_SLOTS_H}: no "
+                    f"#define HVT_STATS_SLOT_COUNT")
+    elif declared != len(slots):
+        vios.append(
+            f"slots: {STATS_SLOTS_H}: HVT_STATS_SLOT_COUNT is "
+            f"{declared} but the manifest lists {len(slots)} slots")
+
+    # append-only structure: indices must be 0..n-1 in order, no reuse
+    seen = {}
+    for pos, (idx, name) in enumerate(slots):
+        if idx in seen:
+            vios.append(
+                f"slots: {STATS_SLOTS_H}: slot index {idx} is used by "
+                f"both \"{seen[idx]}\" and \"{name}\" — slot indices "
+                f"are an append-only ABI and may never be reused")
+        seen[idx] = name
+        if idx != pos:
+            vios.append(
+                f"slots: {STATS_SLOTS_H}: slot \"{name}\" has index "
+                f"{idx} at manifest position {pos} — indices must be "
+                f"contiguous from 0 (append new slots at the end; "
+                f"never renumber)")
+    names = [n for _, n in slots]
+    for n in sorted({x for x in names if names.count(x) > 1}):
+        vios.append(f"slots: {STATS_SLOTS_H}: slot name \"{n}\" appears "
+                    f"more than once")
+
+    # Python layout parity: rebuild the expected slot list from the
+    # constants the ctypes decoder actually uses.
+    consts = _py_literals(native, {"STATS_SCALARS", "STATS_OPS",
+                                   "STATS_LAT_BUCKETS", "ABORT_CAUSES"})
+    missing = [k for k in ("STATS_SCALARS", "STATS_OPS",
+                           "STATS_LAT_BUCKETS", "ABORT_CAUSES")
+               if k not in consts]
+    if missing:
+        vios.append(f"slots: {NATIVE_PY}: layout constants "
+                    f"{missing} not found as literal assignments")
+        return vios
+    expected = list(consts["STATS_SCALARS"])
+    for grp in SLOT_OP_GROUPS:
+        expected += [f"{grp}[{op}]" for op in consts["STATS_OPS"]]
+    for h in SLOT_HISTS:
+        expected += [f"{h}.bucket[{i}]"
+                     for i in range(consts["STATS_LAT_BUCKETS"] + 1)]
+        expected += [f"{h}.sum_ns", f"{h}.count"]
+    expected += [f"aborts[{c}]" for c in consts["ABORT_CAUSES"]]
+    if names != expected:
+        diffs = [i for i, (a, b) in enumerate(zip(names, expected))
+                 if a != b]
+        where = (f"first mismatch at slot {diffs[0]}: manifest "
+                 f"\"{names[diffs[0]]}\" vs python layout "
+                 f"\"{expected[diffs[0]]}\"" if diffs else
+                 f"manifest has {len(names)} slots, python layout "
+                 f"implies {len(expected)}")
+        vios.append(f"slots: {STATS_SLOTS_H}: manifest does not match "
+                    f"the {NATIVE_PY} layout constants ({where})")
+
+    # C++ side: the formula must reproduce the manifest count, and
+    # c_api.cc must pin it with a static_assert against the manifest.
+    ops = _c_int_const(engine_h, "kStatsOps")
+    lat = _c_int_const(engine_h, "kLatBuckets")
+    causes = _c_int_const(engine_h, "kAbortCauses")
+    scalars = _c_int_const(c_api, "kStatsScalars")
+    if None in (ops, lat, causes, scalars):
+        vios.append(
+            f"slots: could not parse kStatsOps/kLatBuckets/kAbortCauses "
+            f"({ENGINE_H}) and kStatsScalars ({C_API_CC})")
+    else:
+        c_count = (scalars + len(SLOT_OP_GROUPS) * ops
+                   + len(SLOT_HISTS) * (lat + 1 + 2) + causes)
+        if declared is not None and c_count != declared:
+            vios.append(
+                f"slots: {C_API_CC}: C++ layout emits {c_count} slots "
+                f"but HVT_STATS_SLOT_COUNT is {declared} — append the "
+                f"new slots to {STATS_SLOTS_H} (never renumber)")
+        if scalars != len(consts["STATS_SCALARS"]):
+            vios.append(
+                f"slots: {C_API_CC}: kStatsScalars={scalars} but "
+                f"{NATIVE_PY} STATS_SCALARS has "
+                f"{len(consts['STATS_SCALARS'])} entries")
+    if "stats_slots.h" not in c_api or \
+            not re.search(r'static_assert[^;]*HVT_STATS_SLOT_COUNT',
+                          c_api, re.S):
+        vios.append(
+            f"slots: {C_API_CC}: must #include \"stats_slots.h\" and "
+            f"static_assert its emitted slot count against "
+            f"HVT_STATS_SLOT_COUNT so the C side cannot drift silently")
+
+    # metrics bridge coverage: every slot group the manifest lists must
+    # be consumed by poll_engine_stats (a slot nobody reads is telemetry
+    # silently thrown away).
+    claimed = list(consts["STATS_SCALARS"]) + list(SLOT_OP_GROUPS) + \
+        list(SLOT_HISTS) + ["aborts"]
+    for key in claimed:
+        if f'"{key}"' not in basics:
+            vios.append(
+                f"slots: {BASICS_PY}: poll_engine_stats never reads "
+                f"\"{key}\" — every manifest slot group must reach the "
+                f"metrics plane")
+    return vios
+
+
+# ---------------------------------------------------------------------------
+# pass 3: event-kind and wire-flag parity
+# ---------------------------------------------------------------------------
+
+_ENUM_RE = re.compile(r'enum\s+class\s+EventKind[^{]*\{(.*?)\};', re.S)
+_ENUM_ENTRY_RE = re.compile(r'^\s*(\w+)\s*=\s*(\d+)\s*,?', re.M)
+_FLAG_RE = re.compile(
+    r'constexpr\s+uint8_t\s+(k\w*Flag\w*)\s*=\s*(0x[0-9A-Fa-f]+|\d+)\s*;')
+
+
+def _timeline_kind_locals(text: str):
+    """The positional `_ENQUEUED, ... = range(N)` unpack in timeline.py:
+    returns (names, N, use_counts) or None."""
+    try:
+        tree = ast.parse(text)
+    except SyntaxError:
+        return None
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Tuple)):
+            continue
+        elts = node.targets[0].elts
+        if not elts or not all(isinstance(e, ast.Name)
+                               and e.id.startswith("_") for e in elts):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Call) and isinstance(v.func, ast.Name)
+                and v.func.id == "range" and len(v.args) == 1
+                and isinstance(v.args[0], ast.Constant)):
+            continue
+        names = [e.id for e in elts]
+        uses = {n: 0 for n in names}
+        for n2 in ast.walk(tree):
+            if isinstance(n2, ast.Name) and n2.id in uses and \
+                    isinstance(n2.ctx, ast.Load):
+                uses[n2.id] += 1
+        return names, int(v.args[0].value), uses
+    return None
+
+
+def check_events(root: Path):
+    vios = []
+    events_h = _read(root, EVENTS_H, vios, "events")
+    native = _read(root, NATIVE_PY, vios, "events")
+    timeline = _read(root, TIMELINE_PY, vios, "events")
+    wire_h = _read(root, WIRE_H, vios, "events")
+    if None in (events_h, native, timeline, wire_h):
+        return vios
+
+    m = _ENUM_RE.search(events_h)
+    if not m:
+        vios.append(f"events: {EVENTS_H}: enum class EventKind not found")
+        return vios
+    entries = [(name, int(val))
+               for name, val in _ENUM_ENTRY_RE.findall(m.group(1))]
+    kinds = [name for name, _ in entries]
+    for pos, (name, val) in enumerate(entries):
+        if val != pos:
+            vios.append(
+                f"events: {EVENTS_H}: EventKind::{name} = {val} at "
+                f"position {pos} — wire ids are append-only and must "
+                f"stay contiguous from 0")
+
+    consts = _py_literals(native, {"EVENT_KINDS"})
+    ek = list(consts.get("EVENT_KINDS", ()))
+    if not ek:
+        vios.append(f"events: {NATIVE_PY}: EVENT_KINDS tuple not found")
+    elif ek != kinds:
+        vios.append(
+            f"events: {NATIVE_PY}: EVENT_KINDS {ek} does not match "
+            f"{EVENTS_H} EventKind {kinds} — the index-is-wire-id "
+            f"mapping would mislabel drained events")
+
+    # drainer coverage: the timeline's positional kind ids must cover
+    # every kind, and each must be referenced by the converter.
+    tl = _timeline_kind_locals(timeline)
+    if tl is None:
+        vios.append(f"events: {TIMELINE_PY}: positional kind-id unpack "
+                    f"(`_ENQUEUED, ... = range(N)`) not found")
+    else:
+        names, n, uses = tl
+        if n != len(kinds) or len(names) != len(kinds):
+            vios.append(
+                f"events: {TIMELINE_PY}: drainer knows {len(names)} "
+                f"kind ids (range({n})) but {EVENTS_H} defines "
+                f"{len(kinds)} — new kinds must be mapped onto timeline "
+                f"lanes (or explicitly skipped) in the drainer")
+        for pos, local in enumerate(names):
+            if uses.get(local, 0) == 0:
+                kind = kinds[pos] if pos < len(kinds) else f"#{pos}"
+                vios.append(
+                    f"events: {TIMELINE_PY}: kind {kind} ({local}) is "
+                    f"never referenced by the drainer — its events are "
+                    f"recorded by the engine and then silently dropped")
+
+    # wire-flag registry
+    flags = [(name, int(val, 0)) for name, val in _FLAG_RE.findall(wire_h)]
+    flag_names = [n for n, _ in flags]
+    for name, val in flags:
+        if val == 0 or (val & (val - 1)) != 0 or val > 0xFF:
+            vios.append(
+                f"events: {WIRE_H}: {name} = {val:#x} is not a single "
+                f"uint8 bit — frame flags are OR-combined and must each "
+                f"own one bit")
+    abort = dict(flags).get("kAbortFrameFlag")
+    if abort is None:
+        vios.append(f"events: {WIRE_H}: kAbortFrameFlag is not "
+                    f"registered (the abort bit must live in the "
+                    f"registry like every other flag)")
+    for prefix, direction in (("kCtrlFlag", "worker→rank-0"),
+                              ("kRespFlag", "rank-0→worker")):
+        group = [(n, v) for n, v in flags if n.startswith(prefix)]
+        if abort is not None:
+            group.append(("kAbortFrameFlag", abort))
+        used = {}
+        for n, v in group:
+            if v in used:
+                vios.append(
+                    f"events: {WIRE_H}: {n} and {used[v]} both claim "
+                    f"bit {v:#x} in the {direction} frame byte")
+            used[v] = n
+    # defined once, and actually used: the registry is the ONLY home of
+    # flag constants, and a registered flag nobody reads is stale.
+    csrc = root / CSRC_DIR
+    other = [p for p in csrc.glob("*.cc")] + \
+        [p for p in csrc.glob("*.h") if p.name != Path(WIRE_H).name]
+    bodies = {p: p.read_text() for p in other if p.exists()}
+    for name, _ in flags:
+        if any(re.search(rf'constexpr[^;\n]*\b{name}\s*=', b)
+               for b in bodies.values()):
+            culprit = [p.name for p, b in bodies.items()
+                       if re.search(rf'constexpr[^;\n]*\b{name}\s*=', b)]
+            vios.append(
+                f"events: {culprit[0]}: re-defines {name} — frame-flag "
+                f"bits are registered exactly once, in {WIRE_H}")
+        if not any(re.search(rf'\b{name}\b', b) for b in bodies.values()):
+            vios.append(
+                f"events: {WIRE_H}: {name} is registered but never used "
+                f"by the engine — remove it or wire it up")
+    return vios
+
+
+# ---------------------------------------------------------------------------
+# pass 4: env-var documentation coverage
+# ---------------------------------------------------------------------------
+
+_PY_ENV_RE = re.compile(
+    r'(?:environ\.get\(\s*|environ\[\s*|getenv\(\s*)"(HVT_[A-Z0-9_]+)"')
+_C_ENV_RE = re.compile(r'(?:getenv|EnvInt)\(\s*"(HVT_[A-Z0-9_]+)"')
+_DOC_TOKEN_RE = re.compile(r'\bHVT_[A-Z0-9_]+\b')
+# HVT_-prefixed C macros the docs legitimately mention — not env knobs.
+_NOT_ENV_VARS = {"HVT_STATS_SLOT_COUNT", "HVT_STATS_SLOTS", "HVT_LOG",
+                 "HVT_THREAD_ANNOTATION__"}
+
+
+def _env_read_sites(root: Path):
+    reads = {}  # var -> first "path" seen
+
+    def scan(path: Path, rel: str):
+        if path.suffix == ".py":
+            env_re = _PY_ENV_RE
+        elif path.suffix in (".cc", ".h"):
+            env_re = _C_ENV_RE
+        else:
+            return
+        try:
+            text = path.read_text(errors="replace")
+        except OSError:
+            return
+        for var in env_re.findall(text):
+            reads.setdefault(var, rel)
+
+    for d in ENV_SCAN_DIRS:
+        base = root / d
+        if not base.is_dir():
+            continue
+        for p in sorted(base.rglob("*")):
+            if p.is_file():
+                scan(p, str(p.relative_to(root)))
+    for f in ENV_SCAN_FILES:
+        scan(root / f, f)
+    return reads
+
+
+def check_env(root: Path):
+    vios = []
+    docs = sorted((root / DOCS_DIR).glob("*.md")) \
+        if (root / DOCS_DIR).is_dir() else []
+    if not docs:
+        vios.append(f"env: {DOCS_DIR}/: no markdown docs found")
+        return vios
+    documented = {}  # var -> first doc file
+    for p in docs:
+        rel = str(p.relative_to(root))
+        for var in _DOC_TOKEN_RE.findall(p.read_text()):
+            if var not in _NOT_ENV_VARS:
+                documented.setdefault(var, rel)
+    reads = _env_read_sites(root)
+    for var, rel in sorted(reads.items()):
+        if var not in documented:
+            vios.append(
+                f"env: {rel}: reads {var}, which is documented nowhere "
+                f"under {DOCS_DIR}/ — every knob needs a docs row "
+                f"(docs/development.md explains where each family "
+                f"belongs)")
+    for var, rel in sorted(documented.items()):
+        if var not in reads:
+            vios.append(
+                f"env: {rel}: documents {var}, but no code reads it — "
+                f"delete the stale row (or restore the read site)")
+    return vios
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+PASSES = {
+    "capi": check_capi,
+    "slots": check_slots,
+    "events": check_events,
+    "env": check_env,
+}
+
+
+def run(root: Path, passes=None) -> list:
+    """All violations from the selected passes (default: all)."""
+    out = []
+    for name in (passes or PASSES):
+        out.extend(PASSES[name](root))
+    return out
+
+
+def main(argv=None) -> int:
+    default_root = Path(__file__).resolve().parents[2]
+    ap = argparse.ArgumentParser(
+        prog="hvt_lint",
+        description="cross-language contract linter (C API / stats-slot "
+                    "ABI / event kinds / frame flags / env docs)")
+    ap.add_argument("passes", nargs="*", choices=[[], *PASSES],
+                    help=f"subset of passes ({', '.join(PASSES)}); "
+                         f"default all")
+    ap.add_argument("--root", type=Path, default=default_root,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--emit-symbols", action="store_true",
+                    help="print the canonical extern-C symbol list "
+                         "(one per line) and exit — consumed by ci.sh's "
+                         "nm -D export check")
+    args = ap.parse_args(argv)
+    if args.emit_symbols:
+        try:
+            print("\n".join(c_api_symbols(args.root)))
+        except OSError as e:
+            print(f"hvt-lint: cannot read {C_API_CC}: {e}",
+                  file=sys.stderr)
+            return 2
+        return 0
+    vios = run(args.root, args.passes or None)
+    for v in vios:
+        print(f"hvt-lint: {v}")
+    names = ", ".join(args.passes or PASSES)
+    if vios:
+        print(f"hvt-lint: FAILED — {len(vios)} violation(s) "
+              f"[{names}]")
+        return 1
+    print(f"hvt-lint: OK [{names}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,279 @@
+"""Unit tests for the cross-language contract linter
+(horovod_tpu/tools/hvt_lint.py).
+
+Strategy: build a MINIMAL but fully consistent fixture tree (tiny
+c_api.cc / stats manifest / native.py / basics.py / events.h /
+timeline.py / wire.h / docs), assert the lint passes it clean, then
+seed one violation per test and assert the lint fails with a pointed
+message. A final test asserts the REAL tree passes every pass — that
+is the tier-1 contract gate itself.
+"""
+
+import os
+import textwrap
+from pathlib import Path
+
+from horovod_tpu.tools import hvt_lint
+
+REPO_ROOT = Path(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _write(root: Path, rel: str, text: str):
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(text))
+
+
+def make_clean_tree(root: Path):
+    """A consistent mini-repo: 2 C symbols, a 13-slot stats ABI
+    (2 scalars, 1 op, 1+2-slot histograms, 1 abort cause), 2 event
+    kinds, 2 frame flags, 1 documented env knob."""
+    _write(root, hvt_lint.C_API_CC, """\
+        #include "stats_slots.h"
+        constexpr int kStatsScalars = 2;
+        static_assert(13 == HVT_STATS_SLOT_COUNT, "slots are append-only");
+        extern "C" {
+        int hvt_init(int rank) { return rank; }
+        int hvt_poll(int h) { return h; }
+        }
+        """)
+    _write(root, hvt_lint.ENGINE_H, """\
+        constexpr int kStatsOps = 1;
+        constexpr int kLatBuckets = 0;
+        constexpr int kAbortCauses = 1;
+        """)
+    _write(root, hvt_lint.STATS_SLOTS_H, """\
+        #define HVT_STATS_SLOT_COUNT 13
+        #define HVT_STATS_SLOTS(X) \\
+          X(0, "a") \\
+          X(1, "b") \\
+          X(2, "exec_ns[allreduce]") \\
+          X(3, "exec_count[allreduce]") \\
+          X(4, "wire_tx_bytes[allreduce]") \\
+          X(5, "wire_tx_comp_bytes[allreduce]") \\
+          X(6, "cycle_hist.bucket[0]") \\
+          X(7, "cycle_hist.sum_ns") \\
+          X(8, "cycle_hist.count") \\
+          X(9, "wakeup_hist.bucket[0]") \\
+          X(10, "wakeup_hist.sum_ns") \\
+          X(11, "wakeup_hist.count") \\
+          X(12, "aborts[internal]")
+        """)
+    _write(root, hvt_lint.NATIVE_PY, """\
+        STATS_SCALARS = ("a", "b")
+        STATS_OPS = ("allreduce",)
+        STATS_LAT_BUCKETS = 0
+        ABORT_CAUSES = ("internal",)
+        EVENT_KINDS = ("ENQUEUED", "DONE")
+
+
+        def bind(lib):
+            lib.hvt_init(0)
+            return lib.hvt_poll(0)
+        """)
+    _write(root, hvt_lint.BASICS_PY, """\
+        import os
+
+        _KNOB = os.environ.get("HVT_FOO")
+
+
+        def poll_engine_stats(stats):
+            return [stats.get(k) for k in (
+                "a", "b", "exec_ns", "exec_count", "wire_tx_bytes",
+                "wire_tx_comp_bytes", "cycle_hist", "wakeup_hist",
+                "aborts")]
+        """)
+    _write(root, hvt_lint.EVENTS_H, """\
+        enum class EventKind : int32_t {
+          ENQUEUED = 0,
+          DONE = 1,
+        };
+        """)
+    _write(root, hvt_lint.TIMELINE_PY, """\
+        _ENQUEUED, _DONE = range(2)
+
+
+        def drain(kind):
+            if kind == _ENQUEUED:
+                return "enqueued"
+            if kind == _DONE:
+                return "done"
+            return None
+        """)
+    _write(root, hvt_lint.WIRE_H, """\
+        constexpr uint8_t kCtrlFlagShutdown = 0x01;
+        constexpr uint8_t kAbortFrameFlag = 0x80;
+        """)
+    _write(root, hvt_lint.ENGINE_CC, """\
+        #include "wire.h"
+        int use_flags() { return kCtrlFlagShutdown | kAbortFrameFlag; }
+        """)
+    _write(root, "docs/index.md", """\
+        # Mini docs
+
+        - `HVT_FOO`: the one knob of the fixture tree.
+        """)
+
+
+def test_fixture_tree_is_clean(tmp_path):
+    make_clean_tree(tmp_path)
+    assert hvt_lint.run(tmp_path) == []
+
+
+# ---------------------------------------------------------------- capi
+
+def test_unbound_c_symbol_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.C_API_CC
+    p.write_text(p.read_text().replace(
+        "int hvt_poll",
+        "int hvt_orphan(int x) { return x; }\nint hvt_poll"))
+    vios = hvt_lint.check_capi(tmp_path)
+    assert any("hvt_orphan" in v and "bound nowhere" in v for v in vios), vios
+
+
+def test_binding_unknown_symbol_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.NATIVE_PY
+    p.write_text(p.read_text() + "\n\ndef bad(lib):\n"
+                                 "    return lib.hvt_ghost()\n")
+    vios = hvt_lint.check_capi(tmp_path)
+    assert any("hvt_ghost" in v and "does not define" in v
+               for v in vios), vios
+
+
+def test_emit_symbols_lists_the_extern_c_surface(tmp_path):
+    make_clean_tree(tmp_path)
+    assert hvt_lint.c_api_symbols(tmp_path) == ["hvt_init", "hvt_poll"]
+
+
+# --------------------------------------------------------------- slots
+
+def test_reused_slot_index_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.STATS_SLOTS_H
+    p.write_text(p.read_text().replace('X(1, "b")', 'X(0, "b")'))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any("never be reused" in v for v in vios), vios
+
+
+def test_slot_count_drift_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.STATS_SLOTS_H
+    p.write_text(p.read_text().replace(
+        "#define HVT_STATS_SLOT_COUNT 13",
+        "#define HVT_STATS_SLOT_COUNT 14"))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any("HVT_STATS_SLOT_COUNT" in v for v in vios), vios
+
+
+def test_manifest_python_layout_mismatch_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.STATS_SLOTS_H
+    p.write_text(p.read_text().replace('X(1, "b")', 'X(1, "renamed")'))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any("does not match" in v and "layout" in v for v in vios), vios
+
+
+def test_unread_slot_group_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.BASICS_PY
+    p.write_text(p.read_text().replace('"aborts"', '"ignored"'))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any('never reads "aborts"' in v for v in vios), vios
+
+
+# -------------------------------------------------------------- events
+
+def test_undrained_event_kind_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.TIMELINE_PY
+    body = p.read_text().replace(
+        '    if kind == _DONE:\n        return "done"\n', "")
+    p.write_text(body)
+    vios = hvt_lint.check_events(tmp_path)
+    assert any("DONE" in v and "never referenced by the drainer" in v
+               for v in vios), vios
+
+
+def test_event_kind_tuple_drift_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.NATIVE_PY
+    p.write_text(p.read_text().replace(
+        'EVENT_KINDS = ("ENQUEUED", "DONE")',
+        'EVENT_KINDS = ("ENQUEUED",)'))
+    vios = hvt_lint.check_events(tmp_path)
+    assert any("EVENT_KINDS" in v for v in vios), vios
+
+
+def test_frame_flag_bit_collision_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    p = tmp_path / hvt_lint.WIRE_H
+    p.write_text(p.read_text()
+                 + "constexpr uint8_t kCtrlFlagJoin = 0x80;\n")
+    cc = tmp_path / hvt_lint.ENGINE_CC
+    cc.write_text(cc.read_text().replace(
+        "kCtrlFlagShutdown |", "kCtrlFlagShutdown | kCtrlFlagJoin |"))
+    vios = hvt_lint.check_events(tmp_path)
+    assert any("both claim bit 0x80" in v for v in vios), vios
+
+
+def test_flag_defined_outside_registry_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    cc = tmp_path / hvt_lint.ENGINE_CC
+    cc.write_text("constexpr uint8_t kAbortFrameFlag = 0x80;\n"
+                  + cc.read_text())
+    vios = hvt_lint.check_events(tmp_path)
+    assert any("re-defines kAbortFrameFlag" in v for v in vios), vios
+
+
+# ----------------------------------------------------------------- env
+
+def test_undocumented_env_read_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    _write(tmp_path, "horovod_tpu/runner/launch.py", """\
+        import os
+
+        SECRET = os.environ.get("HVT_SECRET")
+        """)
+    vios = hvt_lint.check_env(tmp_path)
+    assert any("HVT_SECRET" in v and "documented nowhere" in v
+               for v in vios), vios
+
+
+def test_stale_env_doc_row_fails(tmp_path):
+    make_clean_tree(tmp_path)
+    _write(tmp_path, "docs/ghost.md", "`HVT_GHOST` does nothing now.\n")
+    vios = hvt_lint.check_env(tmp_path)
+    assert any("HVT_GHOST" in v and "no code reads it" in v
+               for v in vios), vios
+
+
+# ---------------------------------------------------- the real tree
+
+def test_real_tree_passes_every_lint_pass():
+    """The tier-1 contract gate: the actual repository must be clean
+    under all four passes (this is what `ci.sh --lint` runs)."""
+    vios = hvt_lint.run(REPO_ROOT)
+    assert vios == [], "\n".join(vios)
+
+
+def test_real_tree_symbol_list_covers_the_bridge():
+    syms = hvt_lint.c_api_symbols(REPO_ROOT)
+    # spot-check the load-bearing names ci.sh's nm gate must see
+    for must in ("hvt_init", "hvt_submit", "hvt_wait", "hvt_engine_stats",
+                 "hvt_events_drain", "hvt_wait_timeout",
+                 "hvt_engine_broken", "hvt_wire_compression"):
+        assert must in syms
+    assert len(syms) >= 29
+
+
+def test_stats_slot_count_matches_python_bridge():
+    """The manifest's count equals what the ctypes decoder sizes its
+    buffer to — the same invariant the slots pass checks by text, here
+    pinned against the imported module."""
+    from horovod_tpu.engine import native
+
+    text = (REPO_ROOT / hvt_lint.STATS_SLOTS_H).read_text()
+    m = hvt_lint._SLOT_COUNT_RE.search(text)
+    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 75
