@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-7 capture: the serving-gang lane-isolation loadtest
+# (benchmarks/r07_serving_loadtest.json).
+#
+# Experiment: a 4-proc loopback gang split into two 2-rank replica
+# lanes. Replica 1 runs a fixed light closed loop (burst 2, window 4)
+# in BOTH phases; replica 0 runs the same load in `baseline` and a
+# full-window saturation loop (burst 4 = window) in `contended`. The
+# artifact's `isolation` block compares replica 1's p99 across phases —
+# the lane-isolation acceptance is ratio ≤ 1.25. Phases run
+# contended-FIRST so any engine/OS warmth advantage accrues to the
+# idle baseline (the conservative direction for the claim).
+#
+# Methodology notes for this 1-core host:
+#   - gap-ms 0: open-loop pacing gaps let the engine's coalescing
+#     waits dominate the idle phase and would make "idle" look SLOWER
+#     than "contended" for the wrong reason;
+#   - window 4: the measured latency includes window residency, and a
+#     deep window amplifies scheduler-noise tails (p99 swings of 3x
+#     were observed at window 8 with 4 procs on 1 core);
+#   - --warmup 64: first-touch costs stay out of both phases.
+cd "$(dirname "$0")/.." || exit 1
+set -euo pipefail
+
+make -C horovod_tpu/csrc -j
+
+timeout -k 30 600 env JAX_PLATFORMS=cpu \
+  python -m horovod_tpu.runner.launch -np 4 --master-port 29771 \
+  python -m horovod_tpu.serving.loadgen \
+    --replicas 2 --requests 800 --bytes 8192 --burst 2 --window 4 \
+    --admission-ms 250 --gap-ms 0 --sync-every 50 --warmup 64 \
+    --saturate-replica 0 --saturate-factor 2 \
+    --phases contended,baseline \
+    --output benchmarks/r07_serving_loadtest.json
+
+python -m horovod_tpu.serving.loadgen \
+  --check benchmarks/r07_serving_loadtest.json
+python - <<'EOF'
+import json
+d = json.load(open("benchmarks/r07_serving_loadtest.json"))
+iso = d["isolation"]
+print(f"lane isolation: replica {iso['observed_replica']} p99 "
+      f"{iso['idle_p99_ms']:.2f} ms idle vs "
+      f"{iso['contended_p99_ms']:.2f} ms contended "
+      f"(ratio {iso['ratio']:.2f}; acceptance ≤ 1.25)")
+assert iso["ratio"] <= 1.25, iso
+EOF

@@ -16,6 +16,9 @@
 #   ./ci.sh --sanitize # TSan + UBSan engine builds + the sanitizer
 #                      # gang suite (one command instead of the
 #                      # hand-assembled HVT_CORE_LIB/LD_PRELOAD dance)
+#   ./ci.sh --loadtest # build + a tiny loopback ReplicaGang replay
+#                      # (horovod_tpu.serving.loadgen --smoke) + the
+#                      # artifact schema check
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
@@ -34,9 +37,11 @@ cd "$(dirname "$0")"
 FAST=0
 CHAOS=0
 SANITIZE=0
+LOADTEST=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
+[[ "${1:-}" == "--loadtest" ]] && LOADTEST=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -82,8 +87,11 @@ fi
 # future PR can never silently skip this check.
 REQUIRED_SYMS="$(python -m horovod_tpu.tools.hvt_lint --emit-symbols)"
 [[ -n "$REQUIRED_SYMS" ]] || { echo "FATAL: --emit-symbols came back empty" >&2; exit 1; }
+# snapshot nm once: `nm | grep -q` under pipefail races SIGPIPE (grep -q
+# exits on first match, nm dies 141, the pipeline "fails" on a hit)
+NM_OUT="$(nm -D "$CORE_SO" 2>/dev/null || true)"
 for sym in $REQUIRED_SYMS; do
-  if ! nm -D "$CORE_SO" 2>/dev/null | grep -q " T $sym\$"; then
+  if ! grep -q " T $sym\$" <<<"$NM_OUT"; then
     echo "FATAL: $CORE_SO does not export $sym (stale build?)" >&2
     exit 1
   fi
@@ -94,6 +102,21 @@ if [[ "$CHAOS" == "1" ]]; then
   echo "=== [2/2] chaos / failure-containment suite ==="
   run_pytest tests/test_failure_containment.py -q
   echo "CI OK (chaos)"
+  exit 0
+fi
+
+if [[ "$LOADTEST" == "1" ]]; then
+  echo "=== [2/2] serving loadtest smoke (loopback ReplicaGang) ==="
+  # bounded like every pytest stage: a wedged lane must fail CI, not
+  # park it (see PYTEST_GUARD_SEC above)
+  ART=$(mktemp /tmp/hvt_loadtest_XXXX.json)
+  timeout -k 30 "${PYTEST_GUARD_SEC}" env JAX_PLATFORMS=cpu \
+    python -m horovod_tpu.runner.launch -np 4 --master-port 29631 \
+    python -m horovod_tpu.serving.loadgen --smoke --replicas 2 \
+    --window 8 --burst 2 --sync-every 8 --output "$ART"
+  python -m horovod_tpu.serving.loadgen --check "$ART"
+  rm -f "$ART"
+  echo "CI OK (loadtest)"
   exit 0
 fi
 

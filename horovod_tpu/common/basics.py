@@ -195,6 +195,11 @@ def shutdown():
         from horovod_tpu.engine import api as _engine_api
 
         _engine_api.shutdown_if_running()
+        # elastic rounds re-init through here: auto-name counters must
+        # restart from the same point on survivors and fresh workers
+        # alike, or their anonymous collectives never pair (see
+        # engine/api.reset_auto_names)
+        _engine_api.reset_auto_names()
         # after the engine: its teardown records the final DONE/abort
         # events, which the timeline's last drain must still capture
         from horovod_tpu.utils import timeline as _tl
@@ -476,6 +481,35 @@ def poll_engine_stats(registry=None):
     reg.gauge("hvt_wire_compression_mode",
               "configured wire codec (0 raw, 1 bf16); rank 0's value "
               "governs the gang").set(native.wire_compression())
+
+    # per-set lane telemetry (serving gangs): lane "0" is the global
+    # set, process-set lanes hash onto "1".."7" (collisions merge
+    # telemetry only — see csrc/engine.h LaneSlot)
+    reg.gauge("hvt_engine_lanes_active",
+              "distinct process-set lanes the engine has served since "
+              "init (1 = global-only traffic)").set(
+                  stats.get("lanes_active", 0))
+    lane_depth = reg.gauge(
+        "hvt_lane_depth",
+        "pending engine collectives per lane bucket (0 = global lane; "
+        "the serving autoscaler's backlog signal)", ("lane",))
+    lane_s = reg.counter(
+        "hvt_lane_exec_seconds_total",
+        "data-plane execution time per lane bucket", ("lane",))
+    lane_n = reg.counter(
+        "hvt_lane_exec_total",
+        "data-plane responses executed per lane bucket", ("lane",))
+    depth = stats.get("lane_depth") or ()
+    lane_ns = stats.get("lane_exec_ns") or ()
+    lane_cnt = stats.get("lane_exec_count") or ()
+    for i in range(native.STATS_LANE_SLOTS):
+        lane = str(i)
+        lane_depth.labels(lane=lane).set(
+            depth[i] if i < len(depth) else 0)
+        lane_s.labels(lane=lane).set_total(
+            (lane_ns[i] if i < len(lane_ns) else 0) / 1e9)
+        lane_n.labels(lane=lane).set_total(
+            lane_cnt[i] if i < len(lane_cnt) else 0)
 
     # failure containment: coordinated aborts by cause + the sticky
     # broken flag (alerts page on either; the cause label says whether

@@ -26,14 +26,19 @@ class ProcessSet:
         self.process_set_id = None  # assigned by add_process_set / init
 
     def included(self) -> bool:
-        """Is this process's slot range included in the set?"""
+        """Is this process's rank a member of the set?
+
+        Exact membership — the same check the engine applies on submit
+        (``engine/native.py`` raises for a non-member caller). The old
+        ``[rank, rank+local_size)`` slot-range heuristic disagreed with
+        it: a process whose *neighbors'* slots were in the set reported
+        ``included() == True`` and then had its submit rejected.
+        """
         if self.ranks is None:
             return True
         from horovod_tpu.common import basics
 
-        lo = basics.rank()
-        hi = lo + basics.local_size()
-        return any(lo <= r < hi for r in self.ranks)
+        return basics.rank() in self.ranks
 
     def size(self) -> int:
         from horovod_tpu.common import basics

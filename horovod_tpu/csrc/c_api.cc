@@ -203,13 +203,18 @@ int hvt_engine_flags() {
 //   68     wakeup-latency sum (ns)        69 wakeup-latency count
 //   70..74 aborts by cause (timeout, peer_lost, remote_abort,
 //          heartbeat, internal) — hvt_engine_aborts_total{cause}
+//   75     lanes_active (distinct process-set lanes seen since init)
+//   76..83 lane_depth per lane bucket (gauge; bucket 0 = global lane)
+//   84..91 lane_exec_ns per lane bucket
+//   92..99 lane_exec_count per lane bucket
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
 constexpr int kStatsScalars = 8;  // the slot-0..7 scalar block
 constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
 constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
-                                2 * kStatsHist + hvt::kAbortCauses;
+                                2 * kStatsHist + hvt::kAbortCauses +
+                                1 + 3 * hvt::kLaneSlots;
 static_assert(kStatsSlotCount == HVT_STATS_SLOT_COUNT,
               "hvt_engine_stats layout drifted from stats_slots.h — the "
               "slot ABI is append-only: add new slots to the end of the "
@@ -246,6 +251,13 @@ int hvt_engine_stats(long long* out, int max_n) {
   }
   for (int i = 0; i < hvt::kAbortCauses; ++i)
     v[base++] = s.aborts[i].load(std::memory_order_relaxed);
+  v[base++] = s.lanes_active.load(std::memory_order_relaxed);
+  for (int i = 0; i < hvt::kLaneSlots; ++i)
+    v[base++] = s.lane_depth[i].load(std::memory_order_relaxed);
+  for (int i = 0; i < hvt::kLaneSlots; ++i)
+    v[base++] = s.lane_exec_ns[i].load(std::memory_order_relaxed);
+  for (int i = 0; i < hvt::kLaneSlots; ++i)
+    v[base++] = s.lane_exec_count[i].load(std::memory_order_relaxed);
   for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
   return kStatsSlotCount;
 }

@@ -30,12 +30,17 @@ struct CachedParams {
   int32_t root_rank;
   double prescale, postscale;
   std::vector<int64_t> splits;
+  // process-set membership (empty = the global set). Cached responses
+  // are lane-scoped: a hit only fires when the announcing request names
+  // the same member list, and the fast path requires exactly the cached
+  // members (not the whole world) to have the position pending.
+  std::vector<int64_t> members;
 
   bool Matches(const Request& r) const {
     return op == r.op && reduce == r.reduce && dtype == r.dtype &&
            shape == r.shape && root_rank == r.root_rank &&
            prescale == r.prescale && postscale == r.postscale &&
-           splits == r.splits;
+           splits == r.splits && members == r.members;
   }
 };
 

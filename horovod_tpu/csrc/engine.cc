@@ -248,6 +248,8 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   hit_pending_.assign(size_, {});
   pending_evictions_.clear();
   announced_.clear();
+  lanes_seen_.clear();
+  fusion_buffers_.clear();
   shutdown_requested_ = false;
   fatal_ = false;
   broken_ = false;  // a fresh init starts healthy (elastic re-init path)
@@ -310,6 +312,8 @@ void Engine::Shutdown() {
   counts_.clear();
   groups_.clear();
   stall_warned_.clear();
+  lanes_seen_.clear();
+  fusion_buffers_.clear();
 }
 
 // --------------------------------------------------------------------------
@@ -677,6 +681,10 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
                    "' is already pending; names must be unique per cycle"));
         continue;
       }
+      if (lanes_seen_.insert(LaneId(e->members)).second)
+        stats_.lanes_active.store(
+            static_cast<int64_t>(lanes_seen_.size()),
+            std::memory_order_relaxed);
       pending_[e->name] = e;
     }
     submitted_.clear();
@@ -704,13 +712,17 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     r.group_id = e->group_id;
     r.group_size = e->group_size;
     r.members = e->members;
-    // Only ungrouped, global-set ALLREDUCE is cacheable: its execution
-    // params are fully rank-symmetric. allgather/alltoall rows vary per
-    // call and per rank; grouped tensors renegotiate as an atomic unit;
-    // process-set responses carry membership the cache does not key on.
+    // Only ungrouped ALLREDUCE is cacheable: its execution params are
+    // fully participant-symmetric. allgather/alltoall rows vary per
+    // call and per rank; grouped tensors renegotiate as an atomic unit.
+    // Process-set allreduces ARE cacheable since the per-set-lane
+    // rework: CachedParams carries the member list, the fast path
+    // requires exactly the cached members to announce the position, and
+    // every rank (members and non-members alike) inserts in response
+    // order so positions stay identical gang-wide. This is what lets a
+    // steady-state serving replica skip negotiation entirely.
     bool cacheable = cache_enabled_.load() &&
-                     e->op == OpType::ALLREDUCE && e->group_id < 0 &&
-                     e->members.empty();
+                     e->op == OpType::ALLREDUCE && e->group_id < 0;
     int32_t pos = cacheable ? cache_.Lookup(r) : ResponseCache::kMiss;
     if (pos >= 0 && !join_pending_) {
       stats_.cache_hits.fetch_add(1, std::memory_order_relaxed);
@@ -854,11 +866,24 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     ExecuteResponse(resp, pending_);
     if (tensor) {
       int op_i = static_cast<int>(resp.op);
+      int64_t exec_ns = static_cast<int64_t>((NowSec() - exec_t0) * 1e9);
       if (op_i >= 0 && op_i < kStatsOps) {
-        stats_.exec_ns[op_i].fetch_add(
-            static_cast<int64_t>((NowSec() - exec_t0) * 1e9),
-            std::memory_order_relaxed);
+        stats_.exec_ns[op_i].fetch_add(exec_ns,
+                                       std::memory_order_relaxed);
         stats_.exec_count[op_i].fetch_add(1, std::memory_order_relaxed);
+      }
+      // lane attribution: which process set this response served (the
+      // hvt_lane_exec_* metrics behind the serving-gang dashboards).
+      // Members only — a skipped response's ~0 ns entry would dilute
+      // the lane's mean latency on every non-member rank
+      bool mine = resp.members.empty();
+      for (auto mr : resp.members) mine = mine || mr == rank_;
+      if (mine) {
+        int lslot = LaneSlot(LaneId(resp.members));
+        stats_.lane_exec_ns[lslot].fetch_add(exec_ns,
+                                             std::memory_order_relaxed);
+        stats_.lane_exec_count[lslot].fetch_add(
+            1, std::memory_order_relaxed);
       }
       for (auto& n : resp.names)
         events_.Record(EventKind::EXEC_END, n,
@@ -893,6 +918,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
   cycle_bytes_ = 0;
 
   if (rank_ == 0) CheckStalls();
+  UpdateLaneDepths();
   UpdateDiag();
 
   if (resp_flags & kRespFlagShutdown) {
@@ -909,9 +935,35 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
   return true;
 }
 
+// Per-lane pending-depth gauges, refreshed once per cycle from the
+// engine-thread-only pending table (cheap: pending_ is small between
+// executions; the serving autoscaler reads these through
+// hvt_engine_stats → hvt_lane_depth{lane=...}).
+void Engine::UpdateLaneDepths() {
+  int64_t depth[kLaneSlots] = {};
+  for (auto& [name, e] : pending_) depth[LaneSlot(LaneId(e->members))]++;
+  for (int i = 0; i < kLaneSlots; ++i)
+    stats_.lane_depth[i].store(depth[i], std::memory_order_relaxed);
+}
+
 // --------------------------------------------------------------------------
 // coordinator (rank 0)
 // --------------------------------------------------------------------------
+
+std::string Engine::NegotiationKey(const std::string& name,
+                                   const std::vector<int64_t>& members) {
+  // different process sets may legitimately reuse a tensor name (each
+  // rank belongs to at most one of them for a given name — its local
+  // pending table dedups by name), so the key carries the member list
+  if (members.empty()) return name;
+  std::string k = name;
+  k += '\x01';
+  for (auto mr : members) {
+    k += std::to_string(mr);
+    k += ',';
+  }
+  return k;
+}
 
 // Fold rank `r`'s cached-hit announcement for `pos` into the slow-path
 // negotiation of the tensor cached there, as if the rank had announced a
@@ -942,9 +994,11 @@ void Engine::HitToArrival(int r, int64_t pos, double now_sec) {
   q.prescale = p->prescale;
   q.postscale = p->postscale;
   q.splits = p->splits;
-  // only ungrouped global-set allreduces are cacheable → the negotiation
-  // key is the bare name (no process-set suffix)
-  RegisterArrival(name, r, std::move(q), now_sec);
+  q.members = p->members;
+  // the negotiation key carries the cached entry's process set, so a
+  // folded hit lands in the same lane-scoped entry as plain requests
+  RegisterArrival(NegotiationKey(name, p->members), r, std::move(q),
+                  now_sec);
 }
 
 // Single home of the negotiation-arrival bookkeeping, shared by the
@@ -994,7 +1048,9 @@ std::vector<Response> Engine::Coordinate(
       // negotiation instead of parking it on the fast path it can
       // never complete
       const CachedParams* cp = cache_.ParamsAt(static_cast<int32_t>(pos));
-      if (cp && counts_.count(cache_.NameAt(static_cast<int32_t>(pos))))
+      if (cp && counts_.count(NegotiationKey(
+                    cache_.NameAt(static_cast<int32_t>(pos)),
+                    cp->members)))
         HitToArrival(r, pos, now);
       else
         hit_pending_[r].insert(pos);
@@ -1002,24 +1058,22 @@ std::vector<Response> Engine::Coordinate(
     for (auto pos : invalids)
       if (pos >= 0) pending_evictions_.push_back(pos);
     for (auto& q : reqs) {
-      // negotiation state is keyed by (name, process set): different
-      // sets may legitimately reuse a tensor name (each rank belongs to
-      // at most one of them for a given name — its local pending table
-      // dedups by name)
-      std::string ck = q.name;
-      if (!q.members.empty()) {
-        ck += '\x01';
-        for (auto mr : q.members) ck += std::to_string(mr) + ",";
-      }
+      // negotiation state is keyed by (name, process set) — see
+      // NegotiationKey
+      std::string ck = NegotiationKey(q.name, q.members);
       if (!RegisterArrival(ck, r, q, now)) continue;
       // miss-after-hit direction: other ranks may have announced this
       // tensor as a cached hit in an earlier frame (before an autotuner
       // cache flip, or with a since-diverged param set). Fold those hits
-      // into this fresh negotiation; param disagreements then surface as
-      // BuildResponse errors instead of a starved protocol.
-      if (q.members.empty()) {
+      // into this fresh negotiation — only when the cached entry belongs
+      // to the SAME lane (a different set's same-name entry resolves
+      // through kInvalid eviction instead); param disagreements then
+      // surface as BuildResponse errors instead of a starved protocol.
+      {
         int32_t cpos = cache_.PositionOf(q.name);
-        if (cpos >= 0)
+        const CachedParams* cp =
+            cpos >= 0 ? cache_.ParamsAt(cpos) : nullptr;
+        if (cp && cp->members == q.members)
           for (int r2 = 0; r2 < size_; ++r2)
             if (hit_pending_[r2].erase(cpos)) HitToArrival(r2, cpos, now);
       }
@@ -1183,16 +1237,35 @@ std::vector<Response> Engine::Coordinate(
     }
   }
 
-  // cache fast path: positions every rank has pending
+  // cache fast path: positions every PARTICIPANT has pending. The
+  // participant set is the cached entry's member list (the whole world
+  // for the global lane) — a serving replica's steady-state traffic
+  // completes here on the announcements of its own members alone,
+  // without waiting on (or disturbing) any other lane.
   if (active == size_) {
+    std::set<int64_t> candidates;
+    for (auto& hp : hit_pending_)
+      candidates.insert(hp.begin(), hp.end());
     std::vector<int64_t> ready;
-    if (!hit_pending_.empty()) {
-      for (auto pos : hit_pending_[0]) {
-        bool all = true;
-        for (int r = 1; r < size_; ++r)
-          all = all && hit_pending_[r].count(pos);
-        if (all) ready.push_back(pos);
+    for (auto pos : candidates) {
+      const CachedParams* p = cache_.ParamsAt(static_cast<int32_t>(pos));
+      if (!p) {
+        // evicted while announced: the eviction broadcast re-opened the
+        // name on every announcing rank, which re-announces a miss —
+        // drop the stale hit so it cannot linger forever
+        for (auto& hp : hit_pending_) hp.erase(pos);
+        continue;
       }
+      bool all = true;
+      if (p->members.empty()) {
+        for (int r = 0; r < size_; ++r)
+          all = all && hit_pending_[r].count(pos);
+      } else {
+        for (auto mr : p->members)
+          all = all && mr >= 0 && mr < size_ &&
+                hit_pending_[static_cast<size_t>(mr)].count(pos);
+      }
+      if (all) ready.push_back(pos);
     }
     for (auto pos : ready) {
       for (int r = 0; r < size_; ++r) hit_pending_[r].erase(pos);
@@ -1208,6 +1281,8 @@ std::vector<Response> Engine::Coordinate(
       resp.prescale = p->prescale;
       resp.postscale = p->postscale;
       resp.numels = {p->shape.num_elements()};
+      resp.shapes = {p->shape};  // local-only: see Response::shapes
+      resp.members = p->members;
       out.push_back(resp);
     }
   } else {
@@ -1380,6 +1455,7 @@ Response Engine::BuildResponse(const std::vector<Request>& reqs) {
   resp.prescale = a.prescale;
   resp.postscale = a.postscale;
   resp.numels = {a.shape.num_elements()};
+  resp.shapes = {a.shape};  // local-only: see Response::shapes
   // resp.members already assigned at the top (error targeting)
 
   // participant count + rank → position map (identity for the global set)
@@ -1487,6 +1563,8 @@ void Engine::FuseResponses(std::vector<Response>& responses) {
                                   r.names.end());
         fused.back().numels.insert(fused.back().numels.end(),
                                    r.numels.begin(), r.numels.end());
+        fused.back().shapes.insert(fused.back().shapes.end(),
+                                   r.shapes.begin(), r.shapes.end());
         stats_.responses_fused.fetch_add(1, std::memory_order_relaxed);
         continue;
       }
@@ -1746,6 +1824,42 @@ static int AdasumStartLevel(const Topology& topo, int rank) {
   return n > 0 ? n : 1;
 }
 
+// Exactly the condition under which the member-side execution path
+// inserts into the response cache — the non-member mirror below must
+// never diverge from it, or cache positions would drift across ranks.
+bool Engine::CacheableResponse(const Response& resp) const {
+  return resp.kind == Response::Kind::TENSOR &&
+         resp.op == OpType::ALLREDUCE &&
+         resp.reduce != ReduceKind::ADASUM && resp.group_id < 0 &&
+         cache_enabled_.load() && !join_pending_;
+}
+
+void Engine::CacheResponseAllRanks(const Response& resp) {
+  if (!CacheableResponse(resp)) return;
+  for (size_t i = 0; i < resp.names.size(); ++i) {
+    // True dims when the response carries them (always on rank 0 —
+    // its HitToArrival fold replays cached params as Requests, so a
+    // stand-in would trip BuildResponse's shape check); a flattened
+    // stand-in on workers, whose non-member copies are position
+    // ballast only — they never announce this (name, set) pair, and a
+    // different set's Lookup resolves through the members mismatch
+    // (kInvalid → eviction) regardless of shape.
+    TensorShape shape = i < resp.shapes.size()
+                            ? resp.shapes[i]
+                            : TensorShape{{resp.numels[i]}};
+    CachedParams p{resp.op,
+                   resp.reduce,
+                   resp.dtype,
+                   std::move(shape),
+                   resp.root,
+                   resp.prescale,
+                   resp.postscale,
+                   {},
+                   resp.members};
+    cache_.Insert(resp.names[i], p);
+  }
+}
+
 void Engine::ExecuteResponse(const Response& resp,
                              std::map<std::string, EntryPtr>& pending) {
   auto take = [&](const std::string& name) -> EntryPtr {
@@ -1829,7 +1943,13 @@ void Engine::ExecuteResponse(const Response& resp,
       grp.push_back(static_cast<int>(mr));
       mine = mine || mr == rank_;
     }
-    if (!mine) return;
+    if (!mine) {
+      // cache positions are assigned in response order on EVERY rank —
+      // a skipped cacheable response still claims its position here or
+      // the gang-wide eviction sync would evict the wrong names
+      CacheResponseAllRanks(resp);
+      return;
+    }
   }
   const int m = static_cast<int>(grp.size());
   const int my_pos = GroupIndexOf(grp, rank_);
@@ -1912,8 +2032,12 @@ void Engine::ExecuteResponse(const Response& resp,
       if (in_place) {
         work = entries[0]->input.data();
       } else {
-        fusion_buffer_.resize(static_cast<size_t>(total) * el);
-        work = fusion_buffer_.data();
+        // per-lane fusion scratch: each process set's buffer converges
+        // to its own working-set size instead of thrashing one shared
+        // allocation across tenants
+        auto& fusion_buffer = fusion_buffers_[LaneId(resp.members)];
+        fusion_buffer.resize(static_cast<size_t>(total) * el);
+        work = fusion_buffer.data();
         int64_t off = 0;
         for (size_t i = 0; i < resp.names.size(); ++i) {
           if (!entries[i]) entries[i] = take(resp.names[i]);
@@ -1955,13 +2079,15 @@ void Engine::ExecuteResponse(const Response& resp,
           else
             entries[i]->output.assign(work + off, work + off + bytes);
           // every rank inserts in the same order → identical caches;
-          // grouped tensors stay uncached (groups renegotiate as a unit)
+          // grouped tensors stay uncached (groups renegotiate as a
+          // unit). Set-scoped responses cache too (lane-keyed fast
+          // path); non-member ranks mirror the insert via
+          // CacheResponseAllRanks so positions never diverge.
           CachedParams p{resp.op,      resp.reduce,    resp.dtype,
                          entries[i]->shape, resp.root, resp.prescale,
-                         resp.postscale, entries[i]->splits};
-          if (cache_enabled_.load() && !join_pending_ &&
-              resp.group_id < 0 && resp.members.empty())
-            cache_.Insert(resp.names[i], p);
+                         resp.postscale, entries[i]->splits,
+                         resp.members};
+          if (CacheableResponse(resp)) cache_.Insert(resp.names[i], p);
           CompleteEntry(entries[i], Status::OK());
         }
         off += bytes;

@@ -68,6 +68,36 @@ namespace hvt {
 // counters unconditionally on.
 constexpr int kStatsOps = 7;  // OpType 0..6 (common.h)
 
+// --------------------------------------------------------------------------
+// per-set engine lanes
+// --------------------------------------------------------------------------
+// A "lane" is the engine-side identity of a process set: negotiation
+// state, the response cache, and the fusion buffer are all keyed by it,
+// so disjoint sub-gangs (e.g. serving replicas) never contend on one
+// shared buffer or renegotiate through one another's cache entries.
+// Lane 0 is the global set; any other lane is the FNV-1a hash of the
+// sorted member-rank list (the submit path sorts and dedups members, so
+// equal sets always hash equal).
+inline uint64_t LaneId(const std::vector<int64_t>& members) {
+  if (members.empty()) return 0;
+  uint64_t h = 1469598103934665603ull;  // FNV offset basis
+  for (int64_t m : members) {
+    for (int b = 0; b < 8; ++b) {
+      h ^= static_cast<uint64_t>(m >> (b * 8)) & 0xff;
+      h *= 1099511628211ull;  // FNV prime
+    }
+  }
+  return h ? h : 1;  // 0 is reserved for the global lane
+}
+
+// Fixed telemetry buckets for per-lane stats (the stats-slot ABI cannot
+// grow per live lane): bucket 0 is the global lane, set lanes hash onto
+// buckets 1..kLaneSlots-1. Collisions merge telemetry, never semantics.
+constexpr int kLaneSlots = 8;
+inline int LaneSlot(uint64_t lane) {
+  return lane == 0 ? 0 : 1 + static_cast<int>(lane % (kLaneSlots - 1));
+}
+
 // Abort causes for the coordinated-abort path — index into
 // EngineStats::aborts and the {cause} label of
 // hvt_engine_aborts_total. Wire ids (part of the stats-slot ABI).
@@ -151,6 +181,13 @@ struct EngineStats {
   // coordinated aborts by cause (hvt_engine_aborts_total{cause}); at
   // most one increment per engine run — the broken state is sticky
   std::atomic<int64_t> aborts[kAbortCauses]{};
+  // per-set lane telemetry (hvt_lane_*): distinct lanes seen since
+  // init, pending-entry depth per lane bucket (a gauge, overwritten
+  // each cycle), and data-plane execution time/count per lane bucket
+  std::atomic<int64_t> lanes_active{0};
+  std::atomic<int64_t> lane_depth[kLaneSlots]{};
+  std::atomic<int64_t> lane_exec_ns[kLaneSlots]{};
+  std::atomic<int64_t> lane_exec_count[kLaneSlots]{};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -167,6 +204,12 @@ struct EngineStats {
       wire_tx_comp_bytes[i] = 0;
     }
     for (auto& a : aborts) a = 0;
+    lanes_active = 0;
+    for (int i = 0; i < kLaneSlots; ++i) {
+      lane_depth[i] = 0;
+      lane_exec_ns[i] = 0;
+      lane_exec_count[i] = 0;
+    }
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -312,6 +355,19 @@ class Engine {
   std::vector<Response> Coordinate(
       const std::vector<std::vector<uint8_t>>& frames);
   Response BuildResponse(const std::vector<Request>& reqs);
+  // lane-scoped negotiation key: tensor name + the process-set member
+  // list (bare name for the global set) — the single spelling shared by
+  // the request loop and the cache-hit fold so the two can never diverge
+  static std::string NegotiationKey(const std::string& name,
+                                    const std::vector<int64_t>& members);
+  // cache bookkeeping for a cacheable response this rank does NOT
+  // participate in: positions are assigned in response order on every
+  // rank, so non-members must insert too or the position↔name maps
+  // would diverge and the eviction broadcast would evict the wrong names
+  void CacheResponseAllRanks(const Response& resp);
+  bool CacheableResponse(const Response& resp) const;
+  // refresh the per-lane pending-depth gauges (engine thread, per cycle)
+  void UpdateLaneDepths();
   void FuseResponses(std::vector<Response>& responses);
   void CheckStalls();
   void UpdateDiag() EXCLUDES(diag_mu_, queue_mu_);
@@ -389,6 +445,7 @@ class Engine {
   // engine-thread-only state
   std::map<std::string, EntryPtr> pending_;  // ordered for determinism
   std::set<std::string> announced_;  // names already sent to coordinator
+  std::set<uint64_t> lanes_seen_;    // distinct lanes since init
   ResponseCache cache_{1024};
   bool join_pending_ = false;
   EntryPtr join_entry_;
@@ -425,7 +482,11 @@ class Engine {
   Mutex diag_mu_;
   DiagState diag_ GUARDED_BY(diag_mu_);  // see DiagState docs above
 
-  std::vector<uint8_t> fusion_buffer_;
+  // fusion scratch, one buffer per lane: a replica set's small serving
+  // payloads never force a resize of the global lane's (large) training
+  // buffer and vice versa — each lane's buffer converges to its own
+  // working-set size
+  std::map<uint64_t, std::vector<uint8_t>> fusion_buffers_;
 };
 
 }  // namespace hvt

@@ -21,7 +21,7 @@
 // `hist.count`.
 #pragma once
 
-#define HVT_STATS_SLOT_COUNT 75
+#define HVT_STATS_SLOT_COUNT 100
 
 // X-macro: HVT_STATS_SLOT(index, "name")
 #define HVT_STATS_SLOTS(X)                  \
@@ -99,4 +99,29 @@
   X(71, "aborts[peer_lost]")                \
   X(72, "aborts[remote_abort]")             \
   X(73, "aborts[heartbeat]")                \
-  X(74, "aborts[internal]")
+  X(74, "aborts[internal]")                 \
+  X(75, "lanes_active")                     \
+  X(76, "lane_depth[0]")                    \
+  X(77, "lane_depth[1]")                    \
+  X(78, "lane_depth[2]")                    \
+  X(79, "lane_depth[3]")                    \
+  X(80, "lane_depth[4]")                    \
+  X(81, "lane_depth[5]")                    \
+  X(82, "lane_depth[6]")                    \
+  X(83, "lane_depth[7]")                    \
+  X(84, "lane_exec_ns[0]")                  \
+  X(85, "lane_exec_ns[1]")                  \
+  X(86, "lane_exec_ns[2]")                  \
+  X(87, "lane_exec_ns[3]")                  \
+  X(88, "lane_exec_ns[4]")                  \
+  X(89, "lane_exec_ns[5]")                  \
+  X(90, "lane_exec_ns[6]")                  \
+  X(91, "lane_exec_ns[7]")                  \
+  X(92, "lane_exec_count[0]")               \
+  X(93, "lane_exec_count[1]")               \
+  X(94, "lane_exec_count[2]")               \
+  X(95, "lane_exec_count[3]")               \
+  X(96, "lane_exec_count[4]")               \
+  X(97, "lane_exec_count[5]")               \
+  X(98, "lane_exec_count[6]")               \
+  X(99, "lane_exec_count[7]")

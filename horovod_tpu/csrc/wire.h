@@ -79,6 +79,15 @@ struct Response {
   // by rank 0 so all participants compress/decompress identically;
   // 0 = raw bytes
   uint8_t wire = 0;
+  // NOT on the wire: full per-name dims, populated by the coordinator's
+  // BuildResponse / cache fast path for ITS OWN local execution.
+  // Rank 0's response-cache copies must hold the true shapes — its
+  // HitToArrival fold replays them as Requests, where a flattened
+  // stand-in would fail BuildResponse's shape consistency check and
+  // error out an innocent lane. Workers decode responses without this
+  // field and fall back to flattened stand-ins, which is safe: only
+  // the coordinator ever folds cache hits.
+  std::vector<TensorShape> shapes;
 };
 
 class Writer {

@@ -82,6 +82,22 @@ def _auto_name(op, name):
     return f"hvt.{op}.{_name_seq}"
 
 
+def reset_auto_names():
+    """Zero the auto-name and fusion-group counters.
+
+    Called from ``hvt.shutdown()`` so an elastic shutdown+re-init round
+    starts every rank's counters from the same point. Without this, a
+    SURVIVOR's counter stays wherever its last round left it while a
+    respawned worker starts from zero — their auto-named collectives
+    then never pair and the recovered gang stalls until the op deadline
+    (observed live as `hvt.allreduce.7` on the survivor vs
+    `hvt.allreduce.1` on the newcomer in the /debugz negotiation table).
+    """
+    global _name_seq, _group_seq
+    _name_seq = 0
+    _group_seq = 0
+
+
 def _nprocs() -> int:
     from horovod_tpu.engine import native
 
@@ -203,9 +219,67 @@ def allreduce(tensor, op, name=None, prescale_factor=1.0,
     return _ConvertingHandle(h, lambda r: _from_numpy(r, kind))
 
 
+class _WaiterPool:
+    """Shared pool of long-lived waiters that resolve combined handles
+    off-thread.
+
+    One grouped call used to spawn (and retire) a fresh daemon thread;
+    at serving request rates that thread churn dominated the dispatch
+    path. The pool instead grows a reused thread set with the number of
+    OUTSTANDING jobs (queued + running, capped at ``max_threads``) —
+    thread count is O(peak concurrency), not O(calls), and a job never
+    queues behind a blocked wait while the pool is under its cap, so
+    one stalled lane's groups cannot freeze another lane's completions.
+
+    Jobs only ever wait on engine handles, which the engine thread
+    completes independently (it error-completes everything on abort), so
+    a blocked waiter always unblocks and queued jobs always progress
+    even at the cap. Combined handles are never nested inside combined
+    handles, so jobs cannot deadlock waiting on each other.
+    """
+
+    def __init__(self, max_threads: int = 32):
+        import queue
+
+        self._jobs = queue.SimpleQueue()
+        self._max_threads = max_threads
+        self._threads = []
+        self._outstanding = 0
+        self._lock = threading.Lock()
+
+    def thread_count(self) -> int:
+        return len(self._threads)
+
+    def submit(self, fn):
+        with self._lock:
+            self._outstanding += 1
+            if self._outstanding > len(self._threads) and \
+                    len(self._threads) < self._max_threads:
+                t = threading.Thread(target=self._drain, daemon=True,
+                                     name="hvt-waiter")
+                t.start()
+                self._threads.append(t)
+        self._jobs.put(fn)
+
+    def _drain(self):
+        while True:
+            fn = self._jobs.get()
+            try:
+                fn()
+            except Exception:  # pragma: no cover — jobs catch their own
+                pass
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+
+
+_waiters = _WaiterPool()
+
+
 def _combine_handles(handles) -> Handle:
-    """One handle resolving to the list of all results; waits off-thread so
-    the submitting thread keeps overlapping communication with compute."""
+    """One handle resolving to the list of all results; waits on the
+    shared pool so the submitting thread keeps overlapping communication
+    with compute."""
     h = Handle()
 
     def _gather():
@@ -217,7 +291,7 @@ def _combine_handles(handles) -> Handle:
     if all(x.done() for x in handles):
         _gather()
     else:
-        threading.Thread(target=_gather, daemon=True).start()
+        _waiters.submit(_gather)
     return h
 
 

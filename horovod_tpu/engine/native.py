@@ -168,6 +168,9 @@ STATS_OPS = ("allreduce", "allgather", "broadcast", "alltoall",
 # upper bounds 1 µs * 4^i — the same bounds as
 # metrics.DEFAULT_LATENCY_BUCKETS — plus one +Inf slot
 STATS_LAT_BUCKETS = 14
+# per-set lane telemetry buckets (csrc/engine.h kLaneSlots): bucket 0 is
+# the global lane, process-set lanes hash onto buckets 1..7
+STATS_LANE_SLOTS = 8
 
 
 def engine_stats() -> dict:
@@ -204,6 +207,12 @@ def engine_stats() -> dict:
         hbase += hist
     out["aborts"] = dict(
         zip(ABORT_CAUSES, vals[hbase:hbase + len(ABORT_CAUSES)]))
+    lbase = hbase + len(ABORT_CAUSES)
+    out["lanes_active"] = vals[lbase]
+    lbase += 1
+    for key in ("lane_depth", "lane_exec_ns", "lane_exec_count"):
+        out[key] = vals[lbase:lbase + STATS_LANE_SLOTS]
+        lbase += STATS_LANE_SLOTS
     return out
 
 
@@ -251,7 +260,8 @@ ABORT_CAUSES = ("timeout", "peer_lost", "remote_abort", "heartbeat",
 # append-only ABI record and tools/hvt_lint.py cross-checks both sides
 # (plus the slot names) on every `ci.sh --lint`.
 STATS_SLOT_COUNT = (len(STATS_SCALARS) + 4 * len(STATS_OPS)
-                    + 2 * (STATS_LAT_BUCKETS + 1 + 2) + len(ABORT_CAUSES))
+                    + 2 * (STATS_LAT_BUCKETS + 1 + 2) + len(ABORT_CAUSES)
+                    + 1 + 3 * STATS_LANE_SLOTS)
 
 
 def events_supported() -> bool:

@@ -88,7 +88,11 @@ class RendezvousServer:
         round's."""
         # timeline/debugz survive re-rendezvous: shards from workers
         # torn down in round N must still be mergeable at job end
-        self._store.clear(keep_scopes=("workers", "timeline", "debugz"))
+        # serving joins debugz as a kept scope: worker-pushed stats
+        # streams must survive round resets or the autoscaler would go
+        # blind at exactly the rendezvous it caused
+        self._store.clear(keep_scopes=("workers", "timeline", "debugz",
+                                       "serving"))
         self._round += 1
         self._slots = {
             f"{s.hostname}/{s.local_rank}": {
@@ -227,7 +231,7 @@ class RendezvousServer:
             def do_DELETE(self):
                 if self.path.strip("/") == "rendezvous":
                     store.clear(keep_scopes=("workers", "timeline",
-                                             "debugz"))
+                                             "debugz", "serving"))
                     self._send(200)
                 else:
                     self._send(404)

@@ -318,10 +318,19 @@ def _run_elastic(args) -> int:
     driver = ElasticDriver(rendezvous, discovery, settings,
                            create_worker_fn=create_worker,
                            on_stop=terminate_children)
+    # HVT_AUTOSCALE=1: metrics-driven policy loop — scale out on
+    # sustained worker backlog, shed/blacklist on failure reports
+    # (runner/elastic/autoscaler.py)
+    from horovod_tpu.runner.elastic.autoscaler import \
+        maybe_start_autoscaler
+    autoscaler = maybe_start_autoscaler(driver, rendezvous,
+                                        verbose=bool(args.verbose))
     try:
         driver.start(args.num_proc)
         driver.wait()
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         terminate_children()
         if args.timeline:
             # elastic world size varies per round; merge whatever shards

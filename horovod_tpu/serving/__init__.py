@@ -1,0 +1,24 @@
+"""Multi-tenant serving gangs (ROADMAP item 4).
+
+Turns a gang of engine ranks into N independent inference **replicas**
+(one process set — one engine lane — each) plus a cross-replica sync
+set, with admission control and load shedding at the request layer:
+
+- :class:`ReplicaGang` — partitions the world, round-robins requests
+  onto this rank's replica lane, enforces a bounded in-flight window
+  (`Handle.wait(timeout=)` admission deadlines, deterministic
+  shed-on-backlog), and pushes per-rank serving stats to the elastic
+  rendezvous KV for the autoscaler.
+- :mod:`horovod_tpu.serving.loadgen` — replays mixed open-loop traffic
+  against a ReplicaGang and records p50/p99/throughput to a JSON
+  artifact (`python -m horovod_tpu.serving.loadgen` under `hvtrun`).
+
+The engine side (per-set negotiation lanes, lane-keyed response cache
+and fusion buffers, `hvt_lane_*` telemetry) lives in ``csrc/engine.cc``;
+the scaling policy loop lives in ``runner/elastic/autoscaler.py``.
+See ``docs/inference.md`` for the end-to-end walkthrough.
+"""
+
+from horovod_tpu.serving.replica_gang import ReplicaGang, ReplicaStats
+
+__all__ = ["ReplicaGang", "ReplicaStats"]

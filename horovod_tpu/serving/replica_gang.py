@@ -1,0 +1,266 @@
+"""ReplicaGang — the replica manager of the serving subsystem.
+
+Partitions the engine world into ``num_replicas`` contiguous process
+sets (one per inference replica) plus a cross-replica **sync set** (the
+first rank of every replica), and serves requests onto this rank's
+replica lane:
+
+- every admitted request becomes one allreduce on the replica's process
+  set, named by a per-replica sequence number so members pair without
+  coordination (SPMD program order);
+- admission is a bounded in-flight window: when the window is full an
+  incoming request is **shed** instead of submitted. The shed decision
+  is a pure function of the aligned submit/reap call history (never of
+  local timing), so replica members always agree on which requests
+  entered the collective stream — a timing-based decision would let one
+  member shed what its peers submitted and wedge the lane;
+- reaping waits on the oldest handle with the **admission deadline**
+  (``Handle.wait(timeout=)``); a deadline miss is recorded (the SLO
+  signal) and the wait then completes unbounded — the collective was
+  already submitted by every member and WILL finish, so the handle must
+  be drained to keep the window accounting aligned;
+- when an elastic rendezvous is configured (``HVT_RENDEZVOUS_ADDR``),
+  :meth:`push_stats` PUTs the per-rank serving snapshot to
+  ``/kv/serving/<rank>`` — the backlog/latency signal the autoscaler
+  (``runner/elastic/autoscaler.py``) scales on.
+
+Knobs (overridable per instance): ``HVT_SERVING_ADMISSION_MS`` —
+admission deadline per request (default 1000); ``HVT_SERVING_MAX_BACKLOG``
+— in-flight window per replica member (default 32).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from horovod_tpu.common.exceptions import HorovodTimeoutError
+from horovod_tpu.common.process_sets import ProcessSet, add_process_set
+
+
+def partition_replicas(world_size: int, num_replicas: int):
+    """Contiguous rank partition: replica i gets ranks
+    ``[i*base + min(i, rem), ...)`` — sizes differ by at most one.
+    Returns a list of rank lists."""
+    if num_replicas < 1:
+        raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+    if num_replicas > world_size:
+        raise ValueError(
+            f"cannot split {world_size} ranks into {num_replicas} "
+            f"replicas (every replica needs at least one rank)")
+    base, rem = divmod(world_size, num_replicas)
+    out, start = [], 0
+    for i in range(num_replicas):
+        n = base + (1 if i < rem else 0)
+        out.append(list(range(start, start + n)))
+        start += n
+    return out
+
+
+class ReplicaStats:
+    """Per-rank serving counters + a bounded latency reservoir
+    (Vitter's algorithm R: once full, each new observation replaces a
+    uniform-random slot with probability max_samples/seen, so the
+    percentiles keep tracking a uniform sample of the WHOLE stream —
+    they never freeze on early-life latencies)."""
+
+    def __init__(self, max_samples: int = 65536):
+        import random
+
+        self.admitted = 0
+        self.shed = 0
+        self.completed = 0
+        self.deadline_miss = 0
+        self.latencies_ms = []
+        self._max_samples = max_samples
+        self._seen = 0
+        self._rng = random.Random(0)  # stats-local; never gang-visible
+        self.started_sec = time.monotonic()
+
+    def observe(self, latency_ms: float, met_deadline: bool):
+        self.completed += 1
+        if not met_deadline:
+            self.deadline_miss += 1
+        self._seen += 1
+        if len(self.latencies_ms) < self._max_samples:
+            self.latencies_ms.append(latency_ms)
+        else:
+            j = self._rng.randrange(self._seen)
+            if j < self._max_samples:
+                self.latencies_ms[j] = latency_ms
+
+    def percentile(self, q: float) -> float:
+        if not self.latencies_ms:
+            return 0.0
+        return float(np.percentile(np.asarray(self.latencies_ms), q))
+
+    def snapshot(self) -> dict:
+        elapsed = max(time.monotonic() - self.started_sec, 1e-9)
+        return {
+            "admitted": self.admitted,
+            "shed": self.shed,
+            "completed": self.completed,
+            "deadline_miss": self.deadline_miss,
+            "p50_ms": round(self.percentile(50), 4),
+            "p99_ms": round(self.percentile(99), 4),
+            "throughput_rps": round(self.completed / elapsed, 3),
+        }
+
+
+class ReplicaGang:
+    """Partition the world into replica lanes and serve requests onto
+    this rank's lane. See the module docstring for the semantics."""
+
+    def __init__(self, num_replicas: int, admission_timeout: float = None,
+                 max_backlog: int = None, name: str = "serve"):
+        from horovod_tpu.common import basics
+
+        self._rank = basics.rank()
+        self._world = basics.size()
+        self.num_replicas = num_replicas
+        self.name = name
+        if admission_timeout is None:
+            admission_timeout = float(
+                os.environ.get("HVT_SERVING_ADMISSION_MS", "1000")) / 1e3
+        if max_backlog is None:
+            max_backlog = int(
+                os.environ.get("HVT_SERVING_MAX_BACKLOG", "32"))
+        self.admission_timeout = admission_timeout
+        self.max_backlog = max_backlog
+
+        ranks = partition_replicas(self._world, num_replicas)
+        self.replicas = [add_process_set(ProcessSet(r)) for r in ranks]
+        # cross-replica sync lane: the first rank of every replica (the
+        # replica "leaders"); with one replica it degenerates to that
+        # replica itself. Parameter refreshes / cache invalidations flow
+        # here without touching the serving lanes.
+        leaders = sorted(r[0] for r in ranks)
+        self.sync_set = (self.replicas[0] if num_replicas == 1
+                         else add_process_set(ProcessSet(leaders)))
+        self.replica_id = next(
+            i for i, r in enumerate(ranks) if self._rank in r)
+        self.my_replica = self.replicas[self.replica_id]
+
+        self._inflight = []  # [(seq, handle, submit_t)], oldest first
+        self._seq = 0
+        self._sync_seq = 0
+        self.stats = ReplicaStats()
+
+    # ------------------------------------------------------------ serving
+
+    def backlog(self) -> int:
+        return len(self._inflight)
+
+    def submit_request(self, tensor, op=None):
+        """Admit one request onto this rank's replica lane.
+
+        Returns the async handle, or ``None`` when the in-flight window
+        is full and the request was shed. Both outcomes are pure
+        functions of the aligned call history, so every member of the
+        replica takes the same branch for the same request index.
+        """
+        from horovod_tpu.ops.collective_ops import Sum, allreduce_async
+
+        if len(self._inflight) >= self.max_backlog:
+            self.stats.shed += 1
+            return None
+        seq = self._seq
+        self._seq += 1
+        # Cycle request names over 2x the window: slot seq-2W was reaped
+        # (hence released from the engine's pending table) before this
+        # submit could be admitted, so the name is free — and a REUSED
+        # name with identical params is a response-cache hit on the
+        # replica's lane, which is what lets steady-state serving skip
+        # negotiation entirely (the per-set-lane engine rework).
+        slot = seq % (2 * self.max_backlog)
+        h = allreduce_async(
+            tensor, op=op or Sum,
+            name=f"{self.name}.r{self.replica_id}.{slot}",
+            process_set=self.my_replica)
+        self._inflight.append((seq, h, time.monotonic()))
+        self.stats.admitted += 1
+        return h
+
+    def reap(self):
+        """Wait out the oldest in-flight request against its admission
+        deadline; record its latency and whether it met the SLO.
+        Returns the request's result, or ``None`` with an empty window.
+
+        The deadline runs from ADMISSION (submit time), not from this
+        call: a request that sat in the window past its budget is a
+        miss even when the wait itself returns instantly. The deadline
+        is an SLO, not a cancellation — every member already submitted
+        the collective, so it WILL complete and must be drained
+        unbounded to keep the window aligned."""
+        if not self._inflight:
+            return None
+        seq, h, t0 = self._inflight.pop(0)
+        met = True
+        budget = self.admission_timeout - (time.monotonic() - t0)
+        try:
+            if budget <= 0:
+                met = False
+                out = h.wait()
+            else:
+                out = h.wait(timeout=budget)
+        except HorovodTimeoutError:
+            met = False
+            out = h.wait()
+        latency_ms = (time.monotonic() - t0) * 1e3
+        if latency_ms > self.admission_timeout * 1e3:
+            met = False
+        self.stats.observe(latency_ms, met)
+        return out
+
+    def drain(self):
+        """Reap every outstanding request (end-of-stream flush)."""
+        while self._inflight:
+            self.reap()
+
+    def sync(self, tensor, op=None):
+        """Cross-replica sync over the leader set (parameter refresh /
+        eviction broadcast analog). Only leaders participate; other
+        ranks return the input unchanged."""
+        from horovod_tpu.ops.collective_ops import Average, allreduce
+
+        if not self.sync_set.included():
+            return tensor
+        self._sync_seq += 1
+        return allreduce(tensor, op=op or Average,
+                         name=f"{self.name}.sync.{self._sync_seq}",
+                         process_set=self.sync_set)
+
+    # ---------------------------------------------------------- telemetry
+
+    def snapshot(self) -> dict:
+        s = self.stats.snapshot()
+        s.update(rank=self._rank, replica=self.replica_id,
+                 inflight=len(self._inflight),
+                 max_backlog=self.max_backlog,
+                 admission_ms=self.admission_timeout * 1e3,
+                 # wall-clock stamp — informational, and it guarantees
+                 # every push CHANGES the payload, which is how the
+                 # autoscaler's change-detection staleness filter tells
+                 # a live (even idle) rank from a shed one
+                 ts=time.time())
+        return s
+
+    def push_stats(self, addr: str = None, timeout: float = 2.0) -> bool:
+        """Best-effort PUT of this rank's serving snapshot to the
+        rendezvous KV (``/kv/serving/<rank>``) — the autoscaler's
+        backlog/latency signal. No-op outside an elastic launch."""
+        addr = addr or os.environ.get("HVT_RENDEZVOUS_ADDR")
+        if not addr:
+            return False
+        from horovod_tpu.runner.http_client import put_bytes
+
+        try:
+            put_bytes(addr, f"/kv/serving/{self._rank}",
+                      json.dumps(self.snapshot()).encode(),
+                      timeout=timeout, retries=0)
+            return True
+        except OSError:
+            return False

@@ -85,6 +85,12 @@ ENV_SCAN_FILES = ("bench.py",)
 SLOT_OP_GROUPS = ("exec_ns", "exec_count", "wire_tx_bytes",
                   "wire_tx_comp_bytes")
 SLOT_HISTS = ("cycle_hist", "wakeup_hist")
+# Per-set lane telemetry appended after the abort causes: a
+# "lanes_active" scalar, then these groups with STATS_LANE_SLOTS
+# (native.py) == kLaneSlots (engine.h) entries each. Optional — a tree
+# without lane slots (the fixture mini-trees) simply omits the
+# constants on BOTH sides.
+SLOT_LANE_GROUPS = ("lane_depth", "lane_exec_ns", "lane_exec_count")
 
 
 def _read(root: Path, rel: str, vios: list, pass_name: str):
@@ -241,7 +247,8 @@ def check_slots(root: Path):
     # Python layout parity: rebuild the expected slot list from the
     # constants the ctypes decoder actually uses.
     consts = _py_literals(native, {"STATS_SCALARS", "STATS_OPS",
-                                   "STATS_LAT_BUCKETS", "ABORT_CAUSES"})
+                                   "STATS_LAT_BUCKETS", "ABORT_CAUSES",
+                                   "STATS_LANE_SLOTS"})
     missing = [k for k in ("STATS_SCALARS", "STATS_OPS",
                            "STATS_LAT_BUCKETS", "ABORT_CAUSES")
                if k not in consts]
@@ -249,6 +256,7 @@ def check_slots(root: Path):
         vios.append(f"slots: {NATIVE_PY}: layout constants "
                     f"{missing} not found as literal assignments")
         return vios
+    lane_slots = int(consts.get("STATS_LANE_SLOTS", 0) or 0)
     expected = list(consts["STATS_SCALARS"])
     for grp in SLOT_OP_GROUPS:
         expected += [f"{grp}[{op}]" for op in consts["STATS_OPS"]]
@@ -257,6 +265,10 @@ def check_slots(root: Path):
                      for i in range(consts["STATS_LAT_BUCKETS"] + 1)]
         expected += [f"{h}.sum_ns", f"{h}.count"]
     expected += [f"aborts[{c}]" for c in consts["ABORT_CAUSES"]]
+    if lane_slots:
+        expected += ["lanes_active"]
+        for grp in SLOT_LANE_GROUPS:
+            expected += [f"{grp}[{i}]" for i in range(lane_slots)]
     if names != expected:
         diffs = [i for i, (a, b) in enumerate(zip(names, expected))
                  if a != b]
@@ -274,13 +286,21 @@ def check_slots(root: Path):
     lat = _c_int_const(engine_h, "kLatBuckets")
     causes = _c_int_const(engine_h, "kAbortCauses")
     scalars = _c_int_const(c_api, "kStatsScalars")
+    c_lanes = _c_int_const(engine_h, "kLaneSlots") or 0
+    if c_lanes != lane_slots:
+        vios.append(
+            f"slots: {ENGINE_H} kLaneSlots={c_lanes} but {NATIVE_PY} "
+            f"STATS_LANE_SLOTS={lane_slots} — the lane-telemetry blocks "
+            f"would decode shifted")
     if None in (ops, lat, causes, scalars):
         vios.append(
             f"slots: could not parse kStatsOps/kLatBuckets/kAbortCauses "
             f"({ENGINE_H}) and kStatsScalars ({C_API_CC})")
     else:
         c_count = (scalars + len(SLOT_OP_GROUPS) * ops
-                   + len(SLOT_HISTS) * (lat + 1 + 2) + causes)
+                   + len(SLOT_HISTS) * (lat + 1 + 2) + causes
+                   + (1 + len(SLOT_LANE_GROUPS) * c_lanes
+                      if c_lanes else 0))
         if declared is not None and c_count != declared:
             vios.append(
                 f"slots: {C_API_CC}: C++ layout emits {c_count} slots "
@@ -304,6 +324,8 @@ def check_slots(root: Path):
     # silently thrown away).
     claimed = list(consts["STATS_SCALARS"]) + list(SLOT_OP_GROUPS) + \
         list(SLOT_HISTS) + ["aborts"]
+    if lane_slots:
+        claimed += ["lanes_active"] + list(SLOT_LANE_GROUPS)
     for key in claimed:
         if f'"{key}"' not in basics:
             vios.append(

@@ -66,3 +66,20 @@ def test_global_process_set():
     assert hvt.global_process_set.process_set_id == 0
     assert hvt.global_process_set.included()
     assert hvt.global_process_set.size() == 8
+
+
+def test_auto_name_counter_resets_for_elastic_rounds():
+    """Survivors of an elastic round re-init through shutdown(); the
+    auto-name counter must restart with them or their anonymous
+    collectives can never pair with a respawned worker's (observed live
+    as `hvt.allreduce.7` vs `hvt.allreduce.1` stalling a recovered
+    gang)."""
+    from horovod_tpu.engine import api
+
+    before = api._name_seq
+    assert api._auto_name("allreduce", None) == \
+        f"hvt.allreduce.{before + 1}"
+    api._group_seq += 1
+    api.reset_auto_names()
+    assert api._name_seq == 0 and api._group_seq == 0
+    assert api._auto_name("allreduce", None) == "hvt.allreduce.1"

@@ -175,6 +175,52 @@ def test_manifest_python_layout_mismatch_fails(tmp_path):
     assert any("does not match" in v and "layout" in v for v in vios), vios
 
 
+def _add_lane_slots(root: Path, n=2):
+    """Extend the clean fixture with an n-bucket lane block (the PR-6
+    per-set lane telemetry appendix): engine.h kLaneSlots, native.py
+    STATS_LANE_SLOTS, manifest rows, and the bridge reads."""
+    eh = root / hvt_lint.ENGINE_H
+    eh.write_text(eh.read_text() + f"constexpr int kLaneSlots = {n};\n")
+    np_ = root / hvt_lint.NATIVE_PY
+    np_.write_text(f"STATS_LANE_SLOTS = {n}\n" + np_.read_text())
+    rows = ['  X(13, "lanes_active")']
+    idx = 14
+    for grp in hvt_lint.SLOT_LANE_GROUPS:
+        for i in range(n):
+            rows.append(f'  X({idx}, "{grp}[{i}]")')
+            idx += 1
+    sl = root / hvt_lint.STATS_SLOTS_H
+    sl.write_text(sl.read_text()
+                  .replace("#define HVT_STATS_SLOT_COUNT 13",
+                           f"#define HVT_STATS_SLOT_COUNT {idx}")
+                  .rstrip("\n") + " \\\n" + " \\\n".join(rows) + "\n")
+    ca = root / hvt_lint.C_API_CC
+    ca.write_text(ca.read_text().replace(
+        "static_assert(13 ==", f"static_assert({idx} =="))
+    bp = root / hvt_lint.BASICS_PY
+    bp.write_text(bp.read_text().replace(
+        '"aborts")', '"aborts", "lanes_active", "lane_depth", '
+                     '"lane_exec_ns", "lane_exec_count")'))
+
+
+def test_lane_slot_fixture_is_clean(tmp_path):
+    make_clean_tree(tmp_path)
+    _add_lane_slots(tmp_path)
+    assert hvt_lint.check_slots(tmp_path) == []
+
+
+def test_lane_slot_count_mismatch_fails(tmp_path):
+    """engine.h kLaneSlots drifting from native.py STATS_LANE_SLOTS
+    would decode the lane blocks shifted — the lint must catch it."""
+    make_clean_tree(tmp_path)
+    _add_lane_slots(tmp_path)
+    p = tmp_path / hvt_lint.NATIVE_PY
+    p.write_text(p.read_text().replace("STATS_LANE_SLOTS = 2",
+                                       "STATS_LANE_SLOTS = 3"))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any("kLaneSlots" in v for v in vios), vios
+
+
 def test_unread_slot_group_fails(tmp_path):
     make_clean_tree(tmp_path)
     p = tmp_path / hvt_lint.BASICS_PY
@@ -276,4 +322,4 @@ def test_stats_slot_count_matches_python_bridge():
 
     text = (REPO_ROOT / hvt_lint.STATS_SLOTS_H).read_text()
     m = hvt_lint._SLOT_COUNT_RE.search(text)
-    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 75
+    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 100

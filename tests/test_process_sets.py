@@ -1,0 +1,66 @@
+"""ProcessSet semantics units (ISSUE 6 satellite): ``included()`` is
+EXACT rank membership, agreeing with the engine's submit-side check.
+
+The old ``[rank, rank + local_size)`` slot-range heuristic reported
+``included() == True`` for processes whose *neighbors'* ranks were in
+the set — and the engine (``engine/native.py``) then rejected their
+submit. Both paths are pinned here; the gang-level agreement (member
+submits succeed, non-member submits raise) is pinned in
+``tests/test_serving.py::test_concurrent_disjoint_sets_4proc``.
+"""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.process_sets import ProcessSet
+
+
+def test_included_is_exact_membership(monkeypatch):
+    monkeypatch.setattr(basics, "rank", lambda: 1)
+    monkeypatch.setattr(basics, "local_size", lambda: 4)
+    assert ProcessSet([0, 1]).included()
+    assert ProcessSet([1, 3]).included()
+    assert ProcessSet(None).included()
+    # the slot-range heuristic claimed all of these (1 <= r < 5):
+    assert not ProcessSet([2, 3]).included()
+    assert not ProcessSet([4]).included()
+    assert not ProcessSet([2, 4, 9]).included()
+
+
+def test_included_false_outside_any_range(monkeypatch):
+    monkeypatch.setattr(basics, "rank", lambda: 6)
+    monkeypatch.setattr(basics, "local_size", lambda: 1)
+    assert not ProcessSet([0, 1]).included()
+    assert ProcessSet([5, 6]).included()
+
+
+def test_included_agrees_with_engine_submit_membership(monkeypatch):
+    """included() must predict the engine's submit acceptance exactly:
+    a rank for which included() is False gets a ValueError from
+    native.submit, never a silent mispairing."""
+    from horovod_tpu.engine import native
+
+    monkeypatch.setattr(native, "engine_running", lambda: True)
+    monkeypatch.setattr(native, "engine_size", lambda: 4)
+    monkeypatch.setattr(native, "engine_rank", lambda: 3)
+    monkeypatch.setattr(basics, "rank", lambda: 3)
+    monkeypatch.setattr(basics, "local_size", lambda: 2)
+
+    ps = ProcessSet([0, 1])
+    assert not ps.included()
+    with pytest.raises(ValueError, match="not in process set"):
+        native.submit("allreduce", np.ones(4, np.float32), "numpy",
+                      name="x", process_set=ps)
+
+    # a member's included() is True and the same submit-side gate passes
+    member = ProcessSet([1, 3])
+    assert member.included()
+
+
+def test_rank_in_set_and_size(monkeypatch):
+    monkeypatch.setattr(basics, "rank", lambda: 2)
+    ps = ProcessSet([0, 2, 5])
+    assert ps.size() == 3
+    assert ps.rank_in_set(2) == 1
+    assert ps.included()
