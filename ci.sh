@@ -19,6 +19,12 @@
 #   ./ci.sh --loadtest # build + a tiny loopback ReplicaGang replay
 #                      # (horovod_tpu.serving.loadgen --smoke) + the
 #                      # artifact schema check
+#   ./ci.sh --perfgate # build + perf-regression gate: loopback sweep +
+#                      # flight-recorded gang, analyzed and diffed
+#                      # against benchmarks/perf_baseline.json (fails
+#                      # on >2x p50 regressions; band overridable via
+#                      # HVT_PERFGATE_MAX_RATIO)
+#   ./ci.sh --perfgate-rebaseline  # refresh the committed baseline
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
@@ -38,10 +44,14 @@ FAST=0
 CHAOS=0
 SANITIZE=0
 LOADTEST=0
+PERFGATE=0
+REBASELINE=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
 [[ "${1:-}" == "--loadtest" ]] && LOADTEST=1
+[[ "${1:-}" == "--perfgate" ]] && PERFGATE=1
+[[ "${1:-}" == "--perfgate-rebaseline" ]] && REBASELINE=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -102,6 +112,29 @@ if [[ "$CHAOS" == "1" ]]; then
   echo "=== [2/2] chaos / failure-containment suite ==="
   run_pytest tests/test_failure_containment.py -q
   echo "CI OK (chaos)"
+  exit 0
+fi
+
+if [[ "$PERFGATE" == "1" || "$REBASELINE" == "1" ]]; then
+  echo "=== [2/2] perf-regression gate ==="
+  if [[ "$REBASELINE" == "1" ]]; then
+    timeout -k 30 "$PYTEST_GUARD_SEC" python benchmarks/perf_gate.py \
+      --rebaseline
+    echo "CI OK (perfgate baseline refreshed — commit benchmarks/perf_baseline.json)"
+    exit 0
+  fi
+  # fixed path, kept after the run: on a FAILED gate this is exactly
+  # the report the developer needs to inspect (a mktemp name would
+  # leak per failure and scroll out of view)
+  ART=/tmp/hvt_perfgate_report.json
+  timeout -k 30 "$PYTEST_GUARD_SEC" python benchmarks/perf_gate.py \
+    --out "$ART"
+  # ratio-based bands (default 2x on p50s, HVT_PERFGATE_MAX_RATIO to
+  # override) — generous enough for a shared box, tight enough that a
+  # real data/control-plane regression cannot land green
+  python -m horovod_tpu.tools.hvt_analyze --diff \
+    benchmarks/perf_baseline.json "$ART"
+  echo "CI OK (perfgate; report kept at $ART)"
   exit 0
 fi
 

@@ -437,6 +437,21 @@ def poll_engine_stats(registry=None):
     bridge("hvt_engine_stalls_total",
            "stall-inspector warnings (some ranks missing a tensor)",
            "stall_events")
+    bridge("hvt_ctrl_tx_bytes_total",
+           "control-plane frame bytes sent on the rank-0 star "
+           "(negotiation cost; includes frame length prefixes)",
+           "ctrl_tx_bytes")
+    bridge("hvt_ctrl_rx_bytes_total",
+           "control-plane frame bytes received on the rank-0 star",
+           "ctrl_rx_bytes")
+    # flight-recorder ring overflow: events overwritten before any
+    # drainer pulled them — nonzero means the timeline/analyzer view has
+    # silent gaps (drain more often or record less)
+    reg.counter(
+        "hvt_events_dropped_total",
+        "flight-recorder events overwritten in the ring before being "
+        "drained (silent event loss)").labels().set_total(
+            native.events_dropped())
 
     exec_s = reg.counter("hvt_engine_exec_seconds_total",
                          "data-plane execution time by collective op",

@@ -207,14 +207,21 @@ int hvt_engine_flags() {
 //   76..83 lane_depth per lane bucket (gauge; bucket 0 = global lane)
 //   84..91 lane_exec_ns per lane bucket
 //   92..99 lane_exec_count per lane bucket
+//   100    ctrl_tx_bytes (control-star frame bytes sent, incl. prefixes)
+//   101    ctrl_rx_bytes (control-star frame bytes received)
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
 constexpr int kStatsScalars = 8;  // the slot-0..7 scalar block
+// scalar slots APPENDED after the structured groups (native.py
+// STATS_TAIL_SCALARS — the append-only escape hatch for new plain
+// counters)
+constexpr int kStatsTailScalars = 2;
 constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
 constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
                                 2 * kStatsHist + hvt::kAbortCauses +
-                                1 + 3 * hvt::kLaneSlots;
+                                1 + 3 * hvt::kLaneSlots +
+                                kStatsTailScalars;
 static_assert(kStatsSlotCount == HVT_STATS_SLOT_COUNT,
               "hvt_engine_stats layout drifted from stats_slots.h — the "
               "slot ABI is append-only: add new slots to the end of the "
@@ -258,6 +265,8 @@ int hvt_engine_stats(long long* out, int max_n) {
     v[base++] = s.lane_exec_ns[i].load(std::memory_order_relaxed);
   for (int i = 0; i < hvt::kLaneSlots; ++i)
     v[base++] = s.lane_exec_count[i].load(std::memory_order_relaxed);
+  v[base++] = s.ctrl_tx_bytes.load(std::memory_order_relaxed);
+  v[base++] = s.ctrl_rx_bytes.load(std::memory_order_relaxed);
   for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
   return kStatsSlotCount;
 }

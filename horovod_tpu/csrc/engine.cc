@@ -239,6 +239,9 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   // scrape threads may poll hvt_engine_stats while Shutdown tears the
   // DataPlane down
   data_->BindTxCounters(stats_.wire_tx_bytes, stats_.wire_tx_comp_bytes);
+  // wire-phase spans land in the flight-recorder ring, which (like the
+  // stats block) is engine-owned and outlives data_
+  data_->BindEvents(&events_);
   cache_enabled_ = true;
   prefer_flat_ = false;
   tuned_cache_enabled_ = true;
@@ -326,7 +329,8 @@ int32_t Engine::Submit(EntryPtr entry) {
   entry->submit_sec = NowSec();
   events_.Record(EventKind::ENQUEUED, entry->name,
                  static_cast<int32_t>(entry->op), rank_,
-                 static_cast<int64_t>(entry->input.size()));
+                 static_cast<int64_t>(entry->input.size()),
+                 LaneSlot(LaneId(entry->members)));
   int32_t h;
   {
     MutexLock lk(handles_mu_);
@@ -431,7 +435,8 @@ void Engine::Release(int32_t handle) {
 
 void Engine::CompleteEntry(const EntryPtr& e, const Status& s) {
   events_.Record(EventKind::DONE, e->name, static_cast<int32_t>(e->op),
-                 static_cast<int32_t>(s.type), 0);
+                 static_cast<int32_t>(s.type), 0,
+                 LaneSlot(LaneId(e->members)));
   {
     MutexLock lk(handles_mu_);
     for (size_t i = 0; i < inflight_.size(); ++i)
@@ -746,8 +751,22 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
   w.i64vec(hit_positions);
   w.i64vec(invalid_positions);
   EncodeRequestList(w, misses);
+  // negotiation payload carried this cycle (vs a bare keepalive frame):
+  // gates the CTRL_BYTES flight-recorder event below so idle heartbeat
+  // cycles don't flood the ring. Rank 0 also flags cycles where a
+  // REMOTE rank's frame carried payload (a straggling negotiation this
+  // rank isn't part of is still control-plane cost to attribute).
+  bool did_negotiate = !hit_positions.empty() ||
+                       !invalid_positions.empty() || !misses.empty();
+  // bytes of a payload-free worker frame: u8 flags + two empty i64vecs
+  // + an empty request list (a 4-byte length each)
+  constexpr size_t kKeepaliveFrameBytes = 1 + 3 * 4;
 
-  // 3. exchange with the coordinator
+  // 3. exchange with the coordinator. ctl_tx/ctl_rx count this cycle's
+  // control-star frame bytes (payload + 8-byte length prefix per frame)
+  // — the per-cycle control-plane cost the critical-path analyzer
+  // attributes (stats slots accumulate; CTRL_BYTES events carry deltas).
+  int64_t ctl_tx = 0, ctl_rx = 0;
   std::vector<Response> responses;
   std::vector<int64_t> evictions;
   uint8_t resp_flags = 0;
@@ -782,6 +801,9 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
       }
       if (IsAbortFrame(frames[r]))
         throw RemoteAbortError(ParseAbortFrame(frames[r]));
+      ctl_rx += static_cast<int64_t>(frames[r].size()) + 8;
+      did_negotiate = did_negotiate ||
+                      frames[r].size() > kKeepaliveFrameBytes;
     }
     responses = Coordinate(frames);
     bool all_down = true;
@@ -802,11 +824,13 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     out.i64vec(pending_evictions_);
     EncodeResponseList(out, responses);
     for (int r = 1; r < size_; ++r) workers_[r].SendFrame(out.buf);
+    ctl_tx += (static_cast<int64_t>(out.buf.size()) + 8) * (size_ - 1);
     cache_enabled_ = tuned_cache_enabled_;
     prefer_flat_ = tuned_prefer_flat_;
     evictions = std::move(pending_evictions_);
     pending_evictions_.clear();
   } else {
+    ctl_tx += static_cast<int64_t>(w.buf.size()) + 8;
     control_.SendFrame(w.buf);
     bool idle = pending_.empty() && !join_pending_;
     int64_t ctl_ms = ControlTimeoutMs(idle);
@@ -836,6 +860,16 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     prefer_flat_ = (tuned & 2) != 0;
     evictions = rd.i64vec();
     responses = DecodeResponseList(rd);
+    ctl_rx += static_cast<int64_t>(frame.size()) + 8;
+  }
+  if (ctl_tx || ctl_rx) {
+    stats_.ctrl_tx_bytes.fetch_add(ctl_tx, std::memory_order_relaxed);
+    stats_.ctrl_rx_bytes.fetch_add(ctl_rx, std::memory_order_relaxed);
+    // per-cycle attribution event — only for cycles that did real work
+    // (see EventKind::CTRL_BYTES on why idle keepalives are excluded)
+    if (did_negotiate || !responses.empty())
+      events_.Record(EventKind::CTRL_BYTES, "", -1,
+                     static_cast<int32_t>(ctl_tx), ctl_rx);
   }
 
   // 4. apply evictions (cache must stay identical on every rank)
@@ -853,13 +887,16 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     if (trace)
       for (auto& n : resp.names)
         timeline_.ExecuteStart(n, OpName(resp.op));
+    int32_t resp_lane = LaneSlot(LaneId(resp.members));
     if (tensor) {
       int32_t op_w = static_cast<int32_t>(resp.op);
       int64_t fused_n = static_cast<int64_t>(resp.names.size());
       for (auto& n : resp.names) {
         if (fused_n > 1)
-          events_.Record(EventKind::FUSED, n, op_w, rank_, fused_n);
-        events_.Record(EventKind::EXEC_BEGIN, n, op_w, rank_, 0);
+          events_.Record(EventKind::FUSED, n, op_w, rank_, fused_n,
+                         resp_lane);
+        events_.Record(EventKind::EXEC_BEGIN, n, op_w, rank_, 0,
+                       resp_lane);
       }
     }
     double exec_t0 = tensor ? NowSec() : 0;
@@ -887,7 +924,8 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
       }
       for (auto& n : resp.names)
         events_.Record(EventKind::EXEC_END, n,
-                       static_cast<int32_t>(resp.op), rank_, 0);
+                       static_cast<int32_t>(resp.op), rank_, 0,
+                       resp_lane);
     }
     if (trace)
       for (auto& n : resp.names) timeline_.ExecuteEnd(n);
@@ -1015,11 +1053,12 @@ bool Engine::RegisterArrival(const std::string& key, int r, Request q,
     if (tc.count == 0) timeline_.NegotiateStart(q.name, OpName(q.op));
     timeline_.NegotiateRankReady(q.name, r);
   }
+  int32_t lane = LaneSlot(LaneId(q.members));
   if (tc.count == 0)
     events_.Record(EventKind::NEGOTIATE_BEGIN, q.name,
-                   static_cast<int32_t>(q.op), r, 0);
+                   static_cast<int32_t>(q.op), r, 0, lane);
   events_.Record(EventKind::RANK_READY, q.name,
-                 static_cast<int32_t>(q.op), r, 0);
+                 static_cast<int32_t>(q.op), r, 0, lane);
   tc.requests.push_back(std::move(q));
   tc.count++;
   return true;
@@ -1328,7 +1367,8 @@ std::vector<Response> Engine::Coordinate(
     auto& tc = counts_[name];
     if (timeline_.active()) timeline_.NegotiateEnd(tc.requests[0].name);
     events_.Record(EventKind::NEGOTIATE_END, tc.requests[0].name,
-                   static_cast<int32_t>(tc.requests[0].op), tc.count, 0);
+                   static_cast<int32_t>(tc.requests[0].op), tc.count, 0,
+                   LaneSlot(LaneId(tc.requests[0].members)));
     Response resp = BuildResponse(tc.requests);
     int32_t gid = tc.requests[0].group_id;
     int32_t gsize = tc.requests[0].group_size;
@@ -1614,7 +1654,8 @@ void Engine::CheckStalls() {
       events_.Record(
           EventKind::STALL, tc.requests[0].name,
           static_cast<int32_t>(tc.requests[0].op),
-          static_cast<int32_t>(now - tc.first_seen_sec), missing_mask);
+          static_cast<int32_t>(now - tc.first_seen_sec), missing_mask,
+          LaneSlot(LaneId(tc.requests[0].members)));
       stall_warned_[name] = true;
     }
   }
@@ -1639,9 +1680,9 @@ void Engine::UpdateDiag() {
     d.queue_depth = static_cast<int>(submitted_.size());
   }
   for (auto& [name, e] : pending_)
-    d.pending.emplace_back(name, e->submit_sec > 0
-                                     ? now - e->submit_sec
-                                     : 0.0);
+    d.pending.push_back(DiagPending{
+        name, e->submit_sec > 0 ? now - e->submit_sec : 0.0,
+        LaneSlot(LaneId(e->members))});
   if (rank_ == 0) {
     for (auto& [key, tc] : counts_) {
       if (tc.requests.empty()) continue;
@@ -1725,9 +1766,10 @@ std::string Engine::DiagnosticsJson() {
   for (size_t i = 0; i < d.pending.size(); ++i) {
     if (i) out += ',';
     out += "{\"tensor\":\"";
-    JsonAppendEscaped(out, d.pending[i].first);
-    snprintf(num, sizeof(num), "%.3f", d.pending[i].second);
-    out += std::string("\",\"age_sec\":") + num + "}";
+    JsonAppendEscaped(out, d.pending[i].name);
+    snprintf(num, sizeof(num), "%.3f", d.pending[i].age_sec);
+    out += std::string("\",\"age_sec\":") + num;
+    out += ",\"lane\":" + std::to_string(d.pending[i].lane) + "}";
   }
   out += "],\"negotiations\":[";
   // stalls = negotiations past the warn threshold; emitted as a separate
@@ -1958,8 +2000,12 @@ void Engine::ExecuteResponse(const Response& resp,
   data_ops_++;  // one per TENSOR response = one data-plane collective
   MaybeInjectFault();  // HVT_FAULT_INJECT chaos hook (no-op when unset)
   // attribute this response's wire bytes to its OpType (engine thread
-  // is the only data-plane user, so a plain member set suffices)
-  if (data_) data_->set_stat_op(static_cast<int>(resp.op));
+  // is the only data-plane user, so a plain member set suffices), and
+  // stamp the tensor identity the duplex pump's WIRE spans carry
+  if (data_) {
+    data_->set_stat_op(static_cast<int>(resp.op));
+    data_->set_wire_ctx(resp.names[0], LaneSlot(LaneId(resp.members)));
+  }
   stats_.tensors_coordinated.fetch_add(
       static_cast<int64_t>(resp.names.size()), std::memory_order_relaxed);
   for (int64_t n : resp.numels) {

@@ -188,6 +188,13 @@ struct EngineStats {
   std::atomic<int64_t> lane_depth[kLaneSlots]{};
   std::atomic<int64_t> lane_exec_ns[kLaneSlots]{};
   std::atomic<int64_t> lane_exec_count[kLaneSlots]{};
+  // control-plane frame bytes through the rank-0 star (payload + the
+  // 8-byte length prefixes), accumulated every cycle including idle
+  // heartbeats — the negotiation-cost denominator of the critical-path
+  // analysis (CTRL_BYTES flight-recorder events carry the per-cycle
+  // deltas for cycles that did work)
+  std::atomic<int64_t> ctrl_tx_bytes{0};
+  std::atomic<int64_t> ctrl_rx_bytes{0};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -210,6 +217,8 @@ struct EngineStats {
       lane_exec_ns[i] = 0;
       lane_exec_count[i] = 0;
     }
+    ctrl_tx_bytes = 0;
+    ctrl_rx_bytes = 0;
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -236,11 +245,19 @@ struct DiagNegotiation {
   std::vector<int> missing;
 };
 
+struct DiagPending {
+  std::string name;
+  double age_sec = 0;
+  int lane = 0;  // LaneSlot of the entry's process set (0 = global) —
+                 // lets stall diagnosis on a serving gang name WHICH
+                 // replica's lane is wedged
+};
+
 struct DiagState {
   bool valid = false;
   int64_t cycles = 0;
   int queue_depth = 0;           // undrained client submissions
-  std::vector<std::pair<std::string, double>> pending;  // name, age sec
+  std::vector<DiagPending> pending;
   std::vector<DiagNegotiation> negotiations;  // rank 0 only
   double stall_warn_sec = 60.0;
   double updated_sec = 0;

@@ -50,6 +50,17 @@ enum class EventKind : int32_t {
   ABORT = 11,           // engine entered the sticky broken state;
                         // arg = abort cause (kAbortCauseNames index),
                         // name = truncated reason
+  CTRL_BYTES = 12,      // control-plane frame bytes this cycle (incl.
+                        // the 8-byte length prefixes): arg = sent,
+                        // arg2 = received. Recorded only on cycles that
+                        // carried negotiation payload or executed
+                        // responses — idle heartbeat cycles accumulate
+                        // into the ctrl_tx/rx_bytes stats slots instead
+                        // of flooding the ring.
+  WIRE_BEGIN = 13,      // TCP data-plane duplex pump span begin (one per
+                        // ring step / pairwise exchange): arg2 = bytes
+                        // this pump will move (tx + rx), lane = LaneSlot
+  WIRE_END = 14,        // matching end; arg2 = bytes moved
 };
 
 // POD view of one event — mirrored field-for-field by the ctypes
@@ -63,7 +74,10 @@ struct EventView {
   int32_t kind;
   int32_t op;      // OpType wire id, -1 when not applicable
   int32_t arg;
-  int32_t pad;
+  int32_t lane;    // LaneSlot of the process set the event belongs to
+                   // (0 = global lane; was padding before the lane
+                   // field existed, so old .so's report 0 — the same
+                   // value, since they predate per-set lanes)
   char name[64];   // tensor name, NUL-terminated, truncated to fit
 };
 static_assert(sizeof(EventView) == 96, "EventView is part of the C ABI");
@@ -73,7 +87,7 @@ class EventRing {
   static constexpr uint64_t kCapacity = 8192;  // power of two
 
   void Record(EventKind kind, const std::string& name, int32_t op,
-              int32_t arg, int64_t arg2) {
+              int32_t arg, int64_t arg2, int32_t lane = 0) {
     uint64_t idx = head_.fetch_add(1, std::memory_order_relaxed);
     Slot& s = slots_[idx & (kCapacity - 1)];
     // invalidate while writing so a concurrent reader can't accept a
@@ -84,7 +98,7 @@ class EventRing {
     s.view.kind = static_cast<int32_t>(kind);
     s.view.op = op;
     s.view.arg = arg;
-    s.view.pad = 0;
+    s.view.lane = lane;
     size_t n = name.size() < sizeof(s.view.name) - 1
                    ? name.size()
                    : sizeof(s.view.name) - 1;

@@ -309,6 +309,14 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
   // of parking the engine thread in poll forever
   const int64_t timeout_ms = OpTimeoutMs();
   int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
+  // wire-phase span: one per pump (= per ring step), so the timeline and
+  // hvt_analyze can attribute execution time to the wire vs the reduce.
+  // A pump that throws leaves the span unclosed — an aborted transfer is
+  // exactly what an open WIRE span in a trace means.
+  const int64_t wire_bytes = static_cast<int64_t>(send_n + recv_n);
+  if (events_ && wire_bytes > 0)
+    events_->Record(EventKind::WIRE_BEGIN, wire_name_, stat_op_, 0,
+                    wire_bytes, wire_lane_);
   while (sent < send_n || rcvd < recv_n) {
     struct pollfd fds[2];
     // a COMPLETED direction is masked with fd = -1 (poll ignores
@@ -355,6 +363,9 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
     flush_chunks();
   }
   flush_chunks();
+  if (events_ && wire_bytes > 0)
+    events_->Record(EventKind::WIRE_END, wire_name_, stat_op_, 0,
+                    wire_bytes, wire_lane_);
   CountTx(send_n, compressed);
 }
 

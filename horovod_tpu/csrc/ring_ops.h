@@ -31,9 +31,11 @@
 #include <atomic>
 #include <cstdint>
 #include <functional>
+#include <string>
 #include <vector>
 
 #include "common.h"
+#include "events.h"
 #include "net.h"
 
 namespace hvt {
@@ -137,6 +139,20 @@ class DataPlane {
     stat_op_ = (op >= 0 && op < kWireOps) ? op : 0;
   }
 
+  // ---- wire-phase flight-recorder spans --------------------------------
+  // The engine binds its EventRing (which outlives this object, like the
+  // tx counters) and stamps the executing response's identity before
+  // dispatch; the duplex pump then records WIRE_BEGIN/WIRE_END spans so
+  // the timeline/analyzer can split execution into wire vs reduce time.
+  // Spans cover the pipelined pump (the default path); the blocking
+  // HVT_RING_PIPELINE=0 parity baseline and the shm backend are not
+  // spanned. Fused units attribute their spans to the first member name.
+  void BindEvents(EventRing* ring) { events_ = ring; }
+  void set_wire_ctx(const std::string& name, int lane) {
+    wire_name_ = name;
+    wire_lane_ = lane;
+  }
+
  private:
   Sock& peer(int r) { return peers_[static_cast<size_t>(r)]; }
   void CountTx(size_t n, bool compressed) {
@@ -169,6 +185,9 @@ class DataPlane {
   int stat_op_ = 0;             // engine-thread-only (set_stat_op)
   std::atomic<int64_t>* tx_sink_ = nullptr;   // [kWireOps], caller-owned
   std::atomic<int64_t>* txc_sink_ = nullptr;  // [kWireOps], caller-owned
+  EventRing* events_ = nullptr;               // caller-owned (engine)
+  std::string wire_name_;       // engine-thread-only (set_wire_ctx)
+  int wire_lane_ = 0;
   std::vector<uint8_t> scratch_;
   std::vector<uint8_t> wire_send_, wire_recv_;  // compressed ping-pong
 };

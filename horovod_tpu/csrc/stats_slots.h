@@ -21,7 +21,7 @@
 // `hist.count`.
 #pragma once
 
-#define HVT_STATS_SLOT_COUNT 100
+#define HVT_STATS_SLOT_COUNT 102
 
 // X-macro: HVT_STATS_SLOT(index, "name")
 #define HVT_STATS_SLOTS(X)                  \
@@ -124,4 +124,6 @@
   X(96, "lane_exec_count[4]")               \
   X(97, "lane_exec_count[5]")               \
   X(98, "lane_exec_count[6]")               \
-  X(99, "lane_exec_count[7]")
+  X(99, "lane_exec_count[7]")               \
+  X(100, "ctrl_tx_bytes")                   \
+  X(101, "ctrl_rx_bytes")

@@ -171,6 +171,11 @@ STATS_LAT_BUCKETS = 14
 # per-set lane telemetry buckets (csrc/engine.h kLaneSlots): bucket 0 is
 # the global lane, process-set lanes hash onto buckets 1..7
 STATS_LANE_SLOTS = 8
+# scalar slots appended AFTER the structured groups (c_api.cc
+# kStatsTailScalars) — the append-only escape hatch for new plain
+# counters: control-star frame bytes sent/received (incl. the 8-byte
+# length prefixes), every cycle including idle heartbeats
+STATS_TAIL_SCALARS = ("ctrl_tx_bytes", "ctrl_rx_bytes")
 
 
 def engine_stats() -> dict:
@@ -213,6 +218,9 @@ def engine_stats() -> dict:
     for key in ("lane_depth", "lane_exec_ns", "lane_exec_count"):
         out[key] = vals[lbase:lbase + STATS_LANE_SLOTS]
         lbase += STATS_LANE_SLOTS
+    for key in STATS_TAIL_SCALARS:
+        out[key] = vals[lbase]
+        lbase += 1
     return out
 
 
@@ -239,7 +247,7 @@ class EngineEvent(ctypes.Structure):
                 ("kind", ctypes.c_int),
                 ("op", ctypes.c_int),
                 ("arg", ctypes.c_int),
-                ("pad", ctypes.c_int),
+                ("lane", ctypes.c_int),
                 ("name", ctypes.c_char * 64)]
 
 
@@ -248,7 +256,8 @@ assert ctypes.sizeof(EngineEvent) == 96, "EngineEvent ABI drift"
 # index == wire id (csrc/events.h EventKind)
 EVENT_KINDS = ("ENQUEUED", "NEGOTIATE_BEGIN", "NEGOTIATE_END",
                "RANK_READY", "FUSED", "EXEC_BEGIN", "EXEC_END", "DONE",
-               "CYCLE", "STALL", "WAKEUP", "ABORT")
+               "CYCLE", "STALL", "WAKEUP", "ABORT", "CTRL_BYTES",
+               "WIRE_BEGIN", "WIRE_END")
 
 # index == wire id (csrc/engine.h AbortCause) — the {cause} label of
 # hvt_engine_aborts_total and slots 70..74 of hvt_engine_stats
@@ -261,7 +270,8 @@ ABORT_CAUSES = ("timeout", "peer_lost", "remote_abort", "heartbeat",
 # (plus the slot names) on every `ci.sh --lint`.
 STATS_SLOT_COUNT = (len(STATS_SCALARS) + 4 * len(STATS_OPS)
                     + 2 * (STATS_LAT_BUCKETS + 1 + 2) + len(ABORT_CAUSES)
-                    + 1 + 3 * STATS_LANE_SLOTS)
+                    + 1 + 3 * STATS_LANE_SLOTS
+                    + len(STATS_TAIL_SCALARS))
 
 
 def events_supported() -> bool:
@@ -273,7 +283,8 @@ def events_supported() -> bool:
 def drain_events(max_events: int = 4096) -> list:
     """Drain the engine's event ring, oldest first, as dicts with
     ``kind``/``kind_name``/``op_name``/``ts_us`` (epoch µs)/``name``/
-    ``arg``/``arg2``. Safe whether or not the engine is initialized."""
+    ``arg``/``arg2``/``lane``. Safe whether or not the engine is
+    initialized."""
     if not events_supported():
         return []
     buf = (EngineEvent * max_events)()
@@ -294,6 +305,7 @@ def drain_events(max_events: int = 4096) -> list:
             "name": e.name.decode(errors="replace"),
             "arg": int(e.arg),
             "arg2": int(e.arg2),
+            "lane": int(e.lane),
         })
     return out
 

@@ -91,6 +91,11 @@ SLOT_HISTS = ("cycle_hist", "wakeup_hist")
 # without lane slots (the fixture mini-trees) simply omits the
 # constants on BOTH sides.
 SLOT_LANE_GROUPS = ("lane_depth", "lane_exec_ns", "lane_exec_count")
+# Plain scalar slots appended LAST (after the lane block): native.py
+# names them in STATS_TAIL_SCALARS and c_api.cc sizes them with
+# kStatsTailScalars — the append-only escape hatch for new counters
+# that fit no structured group. Optional on the same both-sides terms
+# as the lane block.
 
 
 def _read(root: Path, rel: str, vios: list, pass_name: str):
@@ -248,7 +253,8 @@ def check_slots(root: Path):
     # constants the ctypes decoder actually uses.
     consts = _py_literals(native, {"STATS_SCALARS", "STATS_OPS",
                                    "STATS_LAT_BUCKETS", "ABORT_CAUSES",
-                                   "STATS_LANE_SLOTS"})
+                                   "STATS_LANE_SLOTS",
+                                   "STATS_TAIL_SCALARS"})
     missing = [k for k in ("STATS_SCALARS", "STATS_OPS",
                            "STATS_LAT_BUCKETS", "ABORT_CAUSES")
                if k not in consts]
@@ -257,6 +263,7 @@ def check_slots(root: Path):
                     f"{missing} not found as literal assignments")
         return vios
     lane_slots = int(consts.get("STATS_LANE_SLOTS", 0) or 0)
+    tail = list(consts.get("STATS_TAIL_SCALARS", ()) or ())
     expected = list(consts["STATS_SCALARS"])
     for grp in SLOT_OP_GROUPS:
         expected += [f"{grp}[{op}]" for op in consts["STATS_OPS"]]
@@ -269,6 +276,7 @@ def check_slots(root: Path):
         expected += ["lanes_active"]
         for grp in SLOT_LANE_GROUPS:
             expected += [f"{grp}[{i}]" for i in range(lane_slots)]
+    expected += tail
     if names != expected:
         diffs = [i for i, (a, b) in enumerate(zip(names, expected))
                  if a != b]
@@ -287,11 +295,17 @@ def check_slots(root: Path):
     causes = _c_int_const(engine_h, "kAbortCauses")
     scalars = _c_int_const(c_api, "kStatsScalars")
     c_lanes = _c_int_const(engine_h, "kLaneSlots") or 0
+    c_tail = _c_int_const(c_api, "kStatsTailScalars") or 0
     if c_lanes != lane_slots:
         vios.append(
             f"slots: {ENGINE_H} kLaneSlots={c_lanes} but {NATIVE_PY} "
             f"STATS_LANE_SLOTS={lane_slots} — the lane-telemetry blocks "
             f"would decode shifted")
+    if c_tail != len(tail):
+        vios.append(
+            f"slots: {C_API_CC} kStatsTailScalars={c_tail} but "
+            f"{NATIVE_PY} STATS_TAIL_SCALARS has {len(tail)} entries — "
+            f"the trailing scalar block would decode shifted")
     if None in (ops, lat, causes, scalars):
         vios.append(
             f"slots: could not parse kStatsOps/kLatBuckets/kAbortCauses "
@@ -300,7 +314,7 @@ def check_slots(root: Path):
         c_count = (scalars + len(SLOT_OP_GROUPS) * ops
                    + len(SLOT_HISTS) * (lat + 1 + 2) + causes
                    + (1 + len(SLOT_LANE_GROUPS) * c_lanes
-                      if c_lanes else 0))
+                      if c_lanes else 0) + c_tail)
         if declared is not None and c_count != declared:
             vios.append(
                 f"slots: {C_API_CC}: C++ layout emits {c_count} slots "
@@ -326,6 +340,7 @@ def check_slots(root: Path):
         list(SLOT_HISTS) + ["aborts"]
     if lane_slots:
         claimed += ["lanes_active"] + list(SLOT_LANE_GROUPS)
+    claimed += tail
     for key in claimed:
         if f'"{key}"' not in basics:
             vios.append(

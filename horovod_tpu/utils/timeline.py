@@ -56,7 +56,8 @@ _clock_offset_us = 0.0
 
 # kind wire ids — must match csrc/events.h EventKind / native.EVENT_KINDS
 _ENQUEUED, _NEG_B, _NEG_E, _RANK_READY, _FUSED, _EXEC_B, _EXEC_E, \
-    _DONE, _CYCLE, _STALL, _WAKEUP, _ABORT = range(12)
+    _DONE, _CYCLE, _STALL, _WAKEUP, _ABORT, _CTRL_BYTES, _WIRE_B, \
+    _WIRE_E = range(15)
 
 _ENGINE_DRAIN_SEC = 0.05
 
@@ -222,6 +223,15 @@ class _TimelineState:
                         name=f"WAKEUP({ev['arg']} subs, "
                              f"{ev['arg2']} µs)", ts=ts)
                 continue
+            if kind == _CTRL_BYTES:
+                # cycle-lane instant: control-star frame bytes this
+                # cycle (arg = sent, arg2 = received) — hvt_analyze
+                # reads these for the per-cycle negotiation cost
+                if self.mark_cycles:
+                    self.cycle_mark(
+                        name=f"CTRL({ev['arg']} B tx, "
+                             f"{ev['arg2']} B rx)", ts=ts)
+                continue
             if kind == _ABORT:
                 # always recorded (mark_cycles or not): an abort is the
                 # headline event of any trace that contains one. The
@@ -243,14 +253,24 @@ class _TimelineState:
             out = {"pid": self.pid, "tid": tid, "ts": ts}
             if kind == _NEG_B:
                 out.update(ph="B", name=f"NEGOTIATE_{op}")
-            elif kind == _NEG_E or kind == _EXEC_E:
+            elif kind == _NEG_E or kind == _EXEC_E or kind == _WIRE_E:
                 out.update(ph="E")
             elif kind == _EXEC_B:
-                out.update(ph="B", name=op)
+                # lane rides along so hvt_analyze can attribute exec
+                # time per process-set lane (0 = global)
+                out.update(ph="B", name=op,
+                           args={"lane": ev.get("lane", 0)})
+            elif kind == _WIRE_B:
+                # nested span inside the exec span: the TCP duplex
+                # pump's wire phase (arg2 = bytes this pump moves)
+                out.update(ph="B", name=f"WIRE_{op}",
+                           args={"lane": ev.get("lane", 0),
+                                 "bytes": ev["arg2"]})
             elif kind == _RANK_READY:
                 out.update(ph="i", name=f"RANK_READY_{ev['arg']}", s="t")
             elif kind == _ENQUEUED:
-                out.update(ph="i", name="ENQUEUED", s="t")
+                out.update(ph="i", name="ENQUEUED", s="t",
+                           args={"lane": ev.get("lane", 0)})
             elif kind == _FUSED:
                 out.update(ph="i", name=f"FUSED_x{ev['arg2']}", s="t")
             elif kind == _DONE:

@@ -1,0 +1,275 @@
+"""Unit tests for the critical-path analyzer
+(``horovod_tpu/tools/hvt_analyze.py``).
+
+Synthetic chrome-trace shards with known phase durations pin the
+breakdown math exactly; truncation-damaged shards pin the crash-safe
+parse path (documented flight-recorder behavior); the ``--diff`` tests
+pin the perf-gate verdict on a seeded 2x-slower report. The real
+2-proc flight-recorded gang test lives in ``test_flight_recorder.py``
+(the module that already owns the slow gang fixtures).
+"""
+
+import json
+
+import pytest
+
+from horovod_tpu.tools import hvt_analyze as A
+
+
+def _meta(pid, tid, name):
+    return {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": name}}
+
+
+def _engine_lane_events(pid, tid, enq, neg=None, readies=(),
+                        exec_span=None, wires=(), done=None, lane=0):
+    """One tensor instance on one engine lane, in engine emit order."""
+    evs = [{"ph": "i", "pid": pid, "tid": tid, "ts": enq,
+            "name": "ENQUEUED", "s": "t", "args": {"lane": lane}}]
+    if neg:
+        evs.append({"ph": "B", "pid": pid, "tid": tid, "ts": neg[0],
+                    "name": "NEGOTIATE_ALLREDUCE"})
+        for ts, r in readies:
+            evs.append({"ph": "i", "pid": pid, "tid": tid, "ts": ts,
+                        "name": f"RANK_READY_{r}", "s": "t"})
+        evs.append({"ph": "E", "pid": pid, "tid": tid, "ts": neg[1]})
+    if exec_span:
+        evs.append({"ph": "B", "pid": pid, "tid": tid,
+                    "ts": exec_span[0], "name": "ALLREDUCE",
+                    "args": {"lane": lane}})
+        for wb, we in wires:
+            evs.append({"ph": "B", "pid": pid, "tid": tid, "ts": wb,
+                        "name": "WIRE_ALLREDUCE",
+                        "args": {"lane": lane, "bytes": 1024}})
+            evs.append({"ph": "E", "pid": pid, "tid": tid, "ts": we})
+        if done is not None:
+            # engine ordering: DONE (CompleteEntry inside the response
+            # execution) lands BEFORE the EXEC_END event — the analyzer
+            # must not finalize the instance at DONE
+            evs.append({"ph": "i", "pid": pid, "tid": tid, "ts": done,
+                        "name": "DONE", "s": "t"})
+        evs.append({"ph": "E", "pid": pid, "tid": tid,
+                    "ts": exec_span[1]})
+    elif done is not None:
+        evs.append({"ph": "i", "pid": pid, "tid": tid, "ts": done,
+                    "name": "DONE", "s": "t"})
+    return evs
+
+
+def _synthetic_trace():
+    """2 ranks, tensor t0: rank 1 is always the 400 µs straggler."""
+    evs = [_meta(0, 0, "t0 (engine)"), _meta(1, 0, "t0 (engine)"),
+           _meta(0, 9, "CYCLE")]
+    # two instances on each rank with identical known phases
+    for k, base in enumerate((0, 10_000)):
+        evs += _engine_lane_events(
+            0, 0, enq=base,
+            neg=(base + 100, base + 500),
+            readies=((base + 100, 0), (base + 500, 1)),
+            exec_span=(base + 600, base + 1600),
+            wires=((base + 650, base + 1050),),
+            done=base + 1590)
+        evs += _engine_lane_events(
+            1, 0, enq=base + 50,
+            exec_span=(base + 620, base + 1620),
+            wires=((base + 660, base + 1060),),
+            done=base + 1610)
+        evs.append({"ph": "i", "pid": 0, "tid": 9, "ts": base + 590,
+                    "name": "ENGINE_CYCLE(1 responses)", "s": "p"})
+        evs.append({"ph": "i", "pid": 0, "tid": 9, "ts": base + 590,
+                    "name": "CTRL(150 B tx, 80 B rx)", "s": "p"})
+    return evs
+
+
+def test_phase_breakdown_exact():
+    rep = A.analyze(_synthetic_trace())
+    assert rep["ranks"] == [0, 1]
+    assert rep["instances"] == 4
+    ph = rep["phases"]
+    # rank 0: queue 600, rank 1: 570 → p50 picks one of them
+    assert ph["queue"]["p50"] in (570, 600)
+    assert ph["negotiate"] == {"count": 2, "p50": 400, "p90": 400,
+                               "p99": 400, "mean": 400.0, "max": 400}
+    assert ph["wire"]["p50"] == 400 and ph["wire"]["count"] == 4
+    assert ph["exec"]["p50"] == 1000
+    assert ph["reduce"]["p50"] == 600  # exec 1000 − wire 400
+    assert ph["e2e"]["count"] == 4
+    assert rep["per_tensor"]["t0"]["exec"]["count"] == 4
+    # metrics block mirrors the p50s for --diff
+    assert rep["metrics"]["exec_us_p50"] == 1000
+    assert rep["metrics"]["wire_us_p50"] == 400
+
+
+def test_straggler_ranking():
+    rep = A.analyze(_synthetic_trace())
+    assert rep["negotiations_scored"] == 2
+    top = rep["stragglers"][0]
+    assert top["rank"] == 1
+    assert top["times_last"] == 2 and top["share"] == 1.0
+    assert top["mean_margin_us"] == 400.0
+
+
+def test_lane_percentiles_and_cycles():
+    rep = A.analyze(_synthetic_trace())
+    assert rep["lanes"]["0"]["count"] == 4
+    assert rep["lanes"]["0"]["p50"] == 1000
+    assert rep["cycles"]["count"] == 2
+    assert rep["cycles"]["mean_responses"] == 1.0
+    assert rep["cycles"]["ctrl_tx_bytes"] == 300
+    assert rep["cycles"]["ctrl_rx_bytes"] == 160
+
+
+def test_overlap_efficiency_serial_vs_inflight():
+    # serial instances → 0 overlap on both ranks
+    rep = A.analyze(_synthetic_trace())
+    assert rep["overlap_efficiency"]["0"] == 0.0
+    # two tensors in flight simultaneously → exec fully covered by the
+    # other's enq→done window
+    evs = [_meta(0, 0, "a (engine)"), _meta(0, 1, "b (engine)")]
+    evs += _engine_lane_events(0, 0, enq=0, exec_span=(100, 200),
+                               done=190)
+    evs += _engine_lane_events(0, 1, enq=10, exec_span=(220, 320),
+                               done=310)
+    rep2 = A.analyze(evs)
+    # a's exec (100-200) is inside b's window (10-310) and vice versa
+    # for b's exec (220-320) vs a's window (0-190): only a overlaps
+    assert rep2["overlap_efficiency"]["0"] == 0.5
+
+
+def test_truncated_shards_analyze(tmp_path):
+    """Crash-damaged shards (no closing bracket, torn tail) go through
+    the documented truncation-tolerant parse and still produce a
+    report from the intact prefix."""
+    evs = _synthetic_trace()
+    text = "[\n" + ",\n".join(json.dumps(e) for e in evs) + ",\n"
+    torn = text + '{"ph": "B", "pid": 0, "ti'
+    p = tmp_path / "shard0.json"
+    p.write_text(torn)
+    rep = A.analyze_paths([str(p)])
+    assert rep["instances"] == 4
+    assert rep["phases"]["exec"]["p50"] == 1000
+
+
+def test_unterminated_spans_are_dropped():
+    """A shard cut mid-execution (open exec span, no DONE) must not
+    fabricate durations."""
+    evs = [_meta(0, 0, "t0 (engine)"),
+           {"ph": "i", "pid": 0, "tid": 0, "ts": 0, "name": "ENQUEUED",
+            "s": "t", "args": {"lane": 0}},
+           {"ph": "B", "pid": 0, "tid": 0, "ts": 100,
+            "name": "ALLREDUCE", "args": {"lane": 0}}]
+    rep = A.analyze(evs)
+    assert "exec" not in rep["phases"]
+    assert "e2e" not in rep["phases"]
+
+
+def test_merge_of_raw_shards(tmp_path):
+    evs = _synthetic_trace()
+    s0 = [e for e in evs if e.get("pid") == 0]
+    s1 = [e for e in evs if e.get("pid") == 1]
+    p0, p1 = tmp_path / "r0.json", tmp_path / "r1.json"
+    p0.write_text(json.dumps(s0))
+    p1.write_text(json.dumps(s1))
+    rep = A.analyze_paths([str(p0), str(p1)])
+    assert rep["ranks"] == [0, 1]
+    assert rep["instances"] == 4
+
+
+# ------------------------------------------------------------------ diff
+
+def _report(**metrics):
+    return {"schema": "x", "metrics": metrics}
+
+
+def test_diff_seeded_2x_regression_fails(tmp_path, capsys):
+    """The perf-gate acceptance pin: a synthetic report 2x slower than
+    baseline must fail the diff; within-band drift must not."""
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_report(exec_us_p50=1000.0,
+                                       sweep_16MB_p50_ms=30.0)))
+    slow = tmp_path / "slow.json"
+    slow.write_text(json.dumps(_report(exec_us_p50=2500.0,
+                                       sweep_16MB_p50_ms=31.0)))
+    rc = A.run_diff(str(base), str(slow), max_ratio=2.0,
+                    min_base_us=200.0)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION exec_us_p50" in out
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps(_report(exec_us_p50=1500.0,
+                                     sweep_16MB_p50_ms=45.0)))
+    assert A.run_diff(str(base), str(ok), max_ratio=2.0,
+                      min_base_us=200.0) == 0
+
+
+def test_diff_floor_skips_noise_metrics():
+    regs, _, skipped, _ = A.diff_metrics(
+        {"tiny_us_p50": 50.0}, {"tiny_us_p50": 500.0},
+        max_ratio=2.0, min_base_us=200.0)
+    assert regs == []
+    assert skipped and skipped[0][0] == "tiny_us_p50"
+
+
+def test_diff_only_p50_keys_gate():
+    regs, _, _, _ = A.diff_metrics(
+        {"exec_us_p99": 1000.0}, {"exec_us_p99": 9000.0},
+        max_ratio=2.0, min_base_us=200.0)
+    assert regs == []
+
+
+def test_diff_ms_keys_normalized_for_floor():
+    # 0.1 ms baseline = 100 µs < 200 µs floor → skipped
+    regs, _, skipped, _ = A.diff_metrics(
+        {"x_p50_ms": 0.1}, {"x_p50_ms": 1.0},
+        max_ratio=2.0, min_base_us=200.0)
+    assert regs == [] and skipped
+    # 1 ms baseline gates
+    regs, _, _, _ = A.diff_metrics(
+        {"x_p50_ms": 1.0}, {"x_p50_ms": 3.0},
+        max_ratio=2.0, min_base_us=200.0)
+    assert regs and regs[0][0] == "x_p50_ms"
+
+
+def test_diff_missing_gated_metric_fails(tmp_path, capsys):
+    """A regression severe enough to delete a whole phase from the
+    current report (e.g. wire spans no longer recorded) must FAIL the
+    gate, not pass by shrinking the key intersection."""
+    regs, _, _, missing = A.diff_metrics(
+        {"gang_wire_us_p50": 610.0, "gang_exec_us_p50": 695.0},
+        {"gang_exec_us_p50": 700.0},
+        max_ratio=2.0, min_base_us=200.0)
+    assert regs == [] and missing == ["gang_wire_us_p50"]
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_report(gang_wire_us_p50=610.0,
+                                       gang_exec_us_p50=695.0)))
+    cur = tmp_path / "cur.json"
+    cur.write_text(json.dumps(_report(gang_exec_us_p50=700.0)))
+    assert A.run_diff(str(base), str(cur), 2.0, 200.0) == 1
+    assert "MISSING    gang_wire_us_p50" in capsys.readouterr().out
+    # below-floor baselines may vanish without failing (they never gated)
+    regs, _, _, missing = A.diff_metrics(
+        {"tiny_us_p50": 50.0}, {}, max_ratio=2.0, min_base_us=200.0)
+    assert regs == [] and missing == []
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_report_and_diff_roundtrip(tmp_path, capsys):
+    trace = tmp_path / "trace.json"
+    trace.write_text(json.dumps(_synthetic_trace()))
+    rep_path = tmp_path / "report.json"
+    assert A.main([str(trace), "-o", str(rep_path), "--quiet"]) == 0
+    rep = json.loads(rep_path.read_text())
+    assert rep["schema"] == A.SCHEMA
+    # self-diff is always clean
+    assert A.main(["--diff", str(rep_path), str(rep_path)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_usage_errors(tmp_path):
+    with pytest.raises(SystemExit):
+        A.main([])  # no traces, no --diff
+    trace = tmp_path / "t.json"
+    trace.write_text("[]")
+    with pytest.raises(SystemExit):
+        A.main(["--diff", "a", "b", str(trace)])  # diff + traces

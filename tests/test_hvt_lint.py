@@ -221,6 +221,45 @@ def test_lane_slot_count_mismatch_fails(tmp_path):
     assert any("kLaneSlots" in v for v in vios), vios
 
 
+def _add_tail_scalars(root: Path):
+    """Extend the clean fixture with a 1-slot trailing scalar block (the
+    PR-7 ctrl-bytes appendix shape): c_api.cc kStatsTailScalars,
+    native.py STATS_TAIL_SCALARS, a manifest row, and the bridge read."""
+    ca = root / hvt_lint.C_API_CC
+    ca.write_text(ca.read_text()
+                  .replace("constexpr int kStatsScalars = 2;",
+                           "constexpr int kStatsScalars = 2;\n"
+                           "constexpr int kStatsTailScalars = 1;")
+                  .replace("static_assert(13 ==", "static_assert(14 =="))
+    np_ = root / hvt_lint.NATIVE_PY
+    np_.write_text('STATS_TAIL_SCALARS = ("tail_z",)\n' + np_.read_text())
+    sl = root / hvt_lint.STATS_SLOTS_H
+    sl.write_text(sl.read_text()
+                  .replace("#define HVT_STATS_SLOT_COUNT 13",
+                           "#define HVT_STATS_SLOT_COUNT 14")
+                  .rstrip("\n") + ' \\\n  X(13, "tail_z")\n')
+    bp = root / hvt_lint.BASICS_PY
+    bp.write_text(bp.read_text().replace('"aborts")', '"aborts", "tail_z")'))
+
+
+def test_tail_scalar_fixture_is_clean(tmp_path):
+    make_clean_tree(tmp_path)
+    _add_tail_scalars(tmp_path)
+    assert hvt_lint.check_slots(tmp_path) == []
+
+
+def test_tail_scalar_count_mismatch_fails(tmp_path):
+    """c_api.cc kStatsTailScalars drifting from native.py
+    STATS_TAIL_SCALARS would decode the trailing block shifted."""
+    make_clean_tree(tmp_path)
+    _add_tail_scalars(tmp_path)
+    p = tmp_path / hvt_lint.C_API_CC
+    p.write_text(p.read_text().replace("kStatsTailScalars = 1",
+                                       "kStatsTailScalars = 2"))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any("kStatsTailScalars" in v for v in vios), vios
+
+
 def test_unread_slot_group_fails(tmp_path):
     make_clean_tree(tmp_path)
     p = tmp_path / hvt_lint.BASICS_PY
@@ -322,4 +361,4 @@ def test_stats_slot_count_matches_python_bridge():
 
     text = (REPO_ROOT / hvt_lint.STATS_SLOTS_H).read_text()
     m = hvt_lint._SLOT_COUNT_RE.search(text)
-    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 100
+    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 102
