@@ -25,6 +25,9 @@
 #                      # on >2x p50 regressions; band overridable via
 #                      # HVT_PERFGATE_MAX_RATIO)
 #   ./ci.sh --perfgate-rebaseline  # refresh the committed baseline
+#   ./ci.sh --scale    # build + the simulated-gang control-plane
+#                      # harness at a small rank count (star vs tree
+#                      # over loopback) + the artifact schema check
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
@@ -46,12 +49,14 @@ SANITIZE=0
 LOADTEST=0
 PERFGATE=0
 REBASELINE=0
+SCALE=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
 [[ "${1:-}" == "--loadtest" ]] && LOADTEST=1
 [[ "${1:-}" == "--perfgate" ]] && PERFGATE=1
 [[ "${1:-}" == "--perfgate-rebaseline" ]] && REBASELINE=1
+[[ "${1:-}" == "--scale" ]] && SCALE=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -135,6 +140,24 @@ if [[ "$PERFGATE" == "1" || "$REBASELINE" == "1" ]]; then
   python -m horovod_tpu.tools.hvt_analyze --diff \
     benchmarks/perf_baseline.json "$ART"
   echo "CI OK (perfgate; report kept at $ART)"
+  exit 0
+fi
+
+if [[ "$SCALE" == "1" ]]; then
+  echo "=== [2/2] control-plane scaling smoke (simulated gangs) ==="
+  # star-vs-tree pair at a small rank count over loopback; byte metrics
+  # are workload-determined, so the smoke is stable on a loaded box.
+  # The committed artifact (benchmarks/r08_controlplane_scaling.json)
+  # comes from the full --capture matrix — see BENCH_NOTES r9.
+  ART=$(mktemp /tmp/hvt_ctrlscale_XXXX.json)
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    python benchmarks/ctrl_plane_scaling.py --smoke --out "$ART"
+  python benchmarks/ctrl_plane_scaling.py --check "$ART"
+  # the committed artifact must stay schema-valid too
+  python benchmarks/ctrl_plane_scaling.py --check \
+    benchmarks/r08_controlplane_scaling.json
+  rm -f "$ART"
+  echo "CI OK (scale)"
   exit 0
 fi
 

@@ -438,12 +438,25 @@ def poll_engine_stats(registry=None):
            "stall-inspector warnings (some ranks missing a tensor)",
            "stall_events")
     bridge("hvt_ctrl_tx_bytes_total",
-           "control-plane frame bytes sent on the rank-0 star "
-           "(negotiation cost; includes frame length prefixes)",
+           "control-plane frame bytes sent by this rank (star and "
+           "tree links; negotiation cost, includes frame length prefixes)",
            "ctrl_tx_bytes")
     bridge("hvt_ctrl_rx_bytes_total",
-           "control-plane frame bytes received on the rank-0 star",
+           "control-plane frame bytes received by this rank (star and "
+           "tree links)",
            "ctrl_rx_bytes")
+    bridge("hvt_ctrl_bypass_cycles_total",
+           "cycles served by the steady-state control-plane bypass "
+           "(positions-form responses rebuilt from the cache)",
+           "ctrl_bypass_cycles")
+    # direct control-plane peers this rank serves — a gauge: star
+    # rank 0 reports world-1, tree rank 0 one per host with a leader
+    # (the host count; one less when rank 0 has a host to itself)
+    reg.gauge(
+        "hvt_ctrl_peers",
+        "direct control-plane peers this rank exchanges frames with "
+        "per cycle (HVT_CTRL_TOPOLOGY)").labels().set(
+            stats.get("ctrl_peers", 0))
     # flight-recorder ring overflow: events overwritten before any
     # drainer pulled them — nonzero means the timeline/analyzer view has
     # silent gaps (drain more often or record less)

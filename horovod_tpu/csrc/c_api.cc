@@ -207,8 +207,14 @@ int hvt_engine_flags() {
 //   76..83 lane_depth per lane bucket (gauge; bucket 0 = global lane)
 //   84..91 lane_exec_ns per lane bucket
 //   92..99 lane_exec_count per lane bucket
-//   100    ctrl_tx_bytes (control-star frame bytes sent, incl. prefixes)
-//   101    ctrl_rx_bytes (control-star frame bytes received)
+//   100    ctrl_tx_bytes (control-plane frame bytes sent, incl. prefixes)
+//   101    ctrl_rx_bytes (control-plane frame bytes received)
+//   102    ctrl_peers (direct control-plane peers this rank serves —
+//          star rank 0: world-1; tree rank 0: one per host with a
+//          leader, i.e. the host count, minus one when rank 0 has a
+//          host to itself)
+//   103    ctrl_bypass_cycles (cycles served by the steady-state
+//          positions-form bypass instead of full response payloads)
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
@@ -216,7 +222,7 @@ constexpr int kStatsScalars = 8;  // the slot-0..7 scalar block
 // scalar slots APPENDED after the structured groups (native.py
 // STATS_TAIL_SCALARS — the append-only escape hatch for new plain
 // counters)
-constexpr int kStatsTailScalars = 2;
+constexpr int kStatsTailScalars = 4;
 constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
 constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
                                 2 * kStatsHist + hvt::kAbortCauses +
@@ -267,6 +273,8 @@ int hvt_engine_stats(long long* out, int max_n) {
     v[base++] = s.lane_exec_count[i].load(std::memory_order_relaxed);
   v[base++] = s.ctrl_tx_bytes.load(std::memory_order_relaxed);
   v[base++] = s.ctrl_rx_bytes.load(std::memory_order_relaxed);
+  v[base++] = s.ctrl_peers.load(std::memory_order_relaxed);
+  v[base++] = s.ctrl_bypass_cycles.load(std::memory_order_relaxed);
   for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
   return kStatsSlotCount;
 }

@@ -70,6 +70,30 @@ class ResponseCache {
     auto it = index_.find(name);
     return it == index_.end() ? kMiss : it->second.position;
   }
+  // Build the execution Response for a cached position — the single
+  // spelling shared by the coordinator's all-members-hit fast path and
+  // by workers rebuilding a positions-form response frame
+  // (kRespFlagPositions): both sides MUST produce byte-identical
+  // responses from the same (identical-by-construction) cache, or the
+  // steady-state bypass would diverge the gang. Returns false when the
+  // position is not live.
+  bool ResponseAt(int32_t position, Response* out) const {
+    const CachedParams* p = ParamsAt(position);
+    if (!p) return false;
+    out->kind = Response::Kind::TENSOR;
+    out->op = p->op;
+    out->names = {NameAt(position)};
+    out->dtype = p->dtype;
+    out->reduce = p->reduce;
+    out->root = p->root_rank;
+    out->prescale = p->prescale;
+    out->postscale = p->postscale;
+    out->numels = {p->shape.num_elements()};
+    out->shapes = {p->shape};  // local-only: see Response::shapes
+    out->members = p->members;
+    return true;
+  }
+
   // Evict by position; returns the evicted name ("" if not present).
   std::string EvictPosition(int32_t position) {
     auto it = by_position_.find(position);

@@ -106,6 +106,17 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   size_ = size;
   cycle_ms_ = cycle_ms > 0 ? cycle_ms : 2;
   event_driven_ = EnvInt("HVT_EVENT_DRIVEN", 1) != 0;
+  // Control-plane shape: HVT_CTRL_TOPOLOGY=tree elects one leader per
+  // host to aggregate its members' announcements (must agree across
+  // the gang — the launcher propagates it); star is the default and
+  // the parity baseline. HVT_CTRL_BYPASS=0 disables the steady-state
+  // bitmask/positions encodings (full frames everywhere).
+  tree_mode_ = false;
+  if (const char* ct = getenv("HVT_CTRL_TOPOLOGY"); ct && *ct)
+    tree_mode_ = std::string(ct) == "tree";
+  ctrl_bypass_ = EnvInt("HVT_CTRL_BYPASS", 1) != 0;
+  ctrl_role_ = rank_ == 0 ? CtrlRole::ROOT : CtrlRole::MEMBER;
+  ctrl_children_.clear();
   // Wire codec for fp32 allreduce payloads. Every rank parses the env
   // for introspection, but only rank 0's value matters: it stamps the
   // codec into each Response, so the gang always agrees even when the
@@ -204,6 +215,14 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
         peers[who] = std::move(s);
       }
       data_ = std::make_unique<DataPlane>(rank_, size_, std::move(peers));
+
+      // control-plane roles + tree links (uses the star for the port
+      // exchange, so it must run while every control socket is fresh)
+      if (tree_mode_) {
+        SetupTreeControl(endpoints, topo_hosts);
+      } else if (rank_ == 0) {
+        for (int r = 1; r < size_; ++r) ctrl_children_.push_back(r);
+      }
     } else {
       data_ = std::make_unique<DataPlane>(0, 1, std::vector<Sock>{});
     }
@@ -235,6 +254,13 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   resp_seq_ = 0;
   stats_.Reset();  // fresh telemetry per (re-)init — an elastic restart
                    // starts a new scrape epoch on every rank
+  // direct control-plane peers this rank serves: children (+ the parent
+  // link for non-root ranks) — the fan-in number the tree exists to cap
+  stats_.ctrl_peers.store(
+      size_ > 1 ? static_cast<int64_t>(ctrl_children_.size()) +
+                      (ctrl_role_ == CtrlRole::ROOT ? 0 : 1)
+                : 0,
+      std::memory_order_relaxed);
   // wire telemetry lands in the stats block, which outlives data_ —
   // scrape threads may poll hvt_engine_stats while Shutdown tears the
   // DataPlane down
@@ -273,6 +299,13 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   HVT_LOG(INFO, rank_) << "engine up: size " << size_ << ", cycle "
                        << cycle_ms_ << " ms, fusion "
                        << (fusion_threshold_ >> 20) << " MB"
+                       << (tree_mode_ && size_ > 1
+                               ? std::string(", ctrl tree (") +
+                                     CtrlRoleName(ctrl_role_) + ", " +
+                                     std::to_string(
+                                         ctrl_children_.size()) +
+                                     " children)"
+                               : "")
                        << (autotune_.active() ? ", autotune on" : "")
                        << (hier_on
                                ? ", hierarchical allreduce ("
@@ -295,6 +328,9 @@ void Engine::Shutdown() {
   if (thread_.joinable()) thread_.join();
   workers_.clear();
   control_.Close();
+  tree_parent_.Close();
+  tree_child_socks_.clear();
+  ctrl_children_.clear();
   backends_.clear();  // before data_: backends hold raw DataPlane*
   data_.reset();
   data_listener_.Close();
@@ -505,25 +541,33 @@ void Engine::EnterBroken(int cause, const std::string& why) {
                         << AbortCauseName(cause) << "): " << why
                         << " — completing all pending collectives with "
                         << "errors; submits fail fast until re-init";
-  // Fan the ABORT out over the control star (best effort — peers may
-  // already be gone). Rank 0 tells every worker; a worker tells rank 0,
-  // which re-broadcasts when it aborts in turn. Either way each
-  // survivor reads the frame in place of its next expected control
-  // message and aborts within one cycle instead of its own deadline.
+  // Fan the ABORT out over the control topology (best effort — peers
+  // may already be gone). Rank 0 tells every worker; a worker tells its
+  // upstream (rank 0, and its leader in tree mode), and a leader also
+  // relays down to its members — so each survivor reads the frame in
+  // place of its next expected control message (tree members also poll
+  // their parked star socket once per cycle) and aborts within one
+  // cycle. The one slower path: a tree member already BLOCKED on a
+  // wedged-but-alive leader converges at its own control deadline
+  // (heartbeat/op timeout) — still bounded, one deadline not N.
   auto frame = BuildAbortFrame(rank_, why);
-  if (rank_ == 0) {
-    for (int r = 1; r < size_; ++r) {
-      if (!workers_[static_cast<size_t>(r)].valid()) continue;
-      try {
-        workers_[static_cast<size_t>(r)].SendFrame(frame, 1000);
-      } catch (const std::exception&) {
-      }
-    }
-  } else if (control_.valid()) {
+  auto try_send = [&](const Sock& s) {
+    if (!s.valid()) return;
     try {
-      control_.SendFrame(frame, 1000);
+      s.SendFrame(frame, 1000);
     } catch (const std::exception&) {
     }
+  };
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r)
+      try_send(workers_[static_cast<size_t>(r)]);
+  } else {
+    try_send(control_);
+    try_send(tree_parent_);
+  }
+  for (auto& [child, sock] : tree_child_socks_) {
+    (void)child;
+    try_send(sock);
   }
   // Close the data mesh: peers blocked mid-collective on a socket to
   // this rank wake with PeerLostError immediately (FIN from Close), so
@@ -553,6 +597,11 @@ void Engine::MaybeInjectFault() {
         if (data_) data_->Abort();
         control_.Close();
         for (auto& s : workers_) s.Close();
+        tree_parent_.Close();
+        for (auto& [child, s] : tree_child_socks_) {
+          (void)child;
+          s.Close();
+        }
       }
       break;
     case FaultKind::DELAY_MS:
@@ -575,6 +624,95 @@ int64_t Engine::ControlTimeoutMs(bool idle) const {
   // between frames.
   if (idle && heartbeat_ms_ > 0) return heartbeat_ms_;
   return OpTimeoutMs();
+}
+
+// --------------------------------------------------------------------------
+// hierarchical control plane (HVT_CTRL_TOPOLOGY=tree)
+// --------------------------------------------------------------------------
+
+// Derive the per-host leader election from the rendezvous topology and
+// build the member↔leader links. The leader of a host is its lowest
+// rank EXCLUDING rank 0: the root stays a pure coordinator, so its
+// per-cycle fan-in is exactly the host count — even the ranks
+// co-located with rank 0 reach it through their own leader. Leaders
+// reuse their existing control-star socket as the parent link; only
+// member→leader connections are new, with the leader ports exchanged
+// over the star (the same rendezvous channel the data mesh used).
+void Engine::SetupTreeControl(
+    const std::vector<std::string>& endpoints,
+    const std::vector<std::string>& topo_hosts) {
+  std::map<std::string, std::vector<int>> by_host;
+  for (int r = 0; r < size_; ++r)
+    by_host[topo_hosts[static_cast<size_t>(r)]].push_back(r);
+  int my_leader = -1;
+  std::vector<int> my_members;
+  std::vector<int> leaders;
+  for (auto& [host, ranks] : by_host) {
+    int leader = -1;
+    for (int r : ranks)
+      if (r != 0) {
+        leader = r;
+        break;
+      }
+    if (leader >= 0) leaders.push_back(leader);
+    if (host == topo_hosts[static_cast<size_t>(rank_)]) {
+      my_leader = leader;
+      for (int r : ranks)
+        if (r != 0 && r != leader) my_members.push_back(r);
+    }
+  }
+  std::sort(leaders.begin(), leaders.end());
+  if (rank_ == 0) {
+    ctrl_role_ = CtrlRole::ROOT;
+    ctrl_children_ = leaders;
+  } else if (rank_ == my_leader) {
+    ctrl_role_ = CtrlRole::LEADER;
+    ctrl_children_ = my_members;
+  } else {
+    ctrl_role_ = CtrlRole::MEMBER;
+    ctrl_children_.clear();
+  }
+
+  // leader control ports travel over the star: gather at rank 0, then
+  // broadcast the full rank→port table
+  Listener ctrl_listener;
+  bool listening = ctrl_role_ == CtrlRole::LEADER && !my_members.empty();
+  if (listening) ctrl_listener.Listen(0);
+  std::vector<int32_t> ctrl_ports(size_, 0);
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r) {
+      auto frame = workers_[static_cast<size_t>(r)].RecvFrame();
+      Reader rd(frame);  // Reader holds a reference — keep frame alive
+      ctrl_ports[static_cast<size_t>(r)] = rd.i32();
+    }
+    Writer w;
+    for (auto p : ctrl_ports) w.i32(p);
+    for (int r = 1; r < size_; ++r)
+      workers_[static_cast<size_t>(r)].SendFrame(w.buf);
+  } else {
+    Writer w;
+    w.i32(listening ? static_cast<int32_t>(ctrl_listener.port()) : 0);
+    control_.SendFrame(w.buf);
+    auto frame = control_.RecvFrame();
+    Reader rd(frame);  // see above
+    for (auto& p : ctrl_ports) p = rd.i32();
+  }
+
+  if (ctrl_role_ == CtrlRole::MEMBER) {
+    const std::string& ep = endpoints[static_cast<size_t>(my_leader)];
+    std::string host = ep.substr(0, ep.rfind(':'));
+    tree_parent_ = Sock::Connect(
+        host, ctrl_ports[static_cast<size_t>(my_leader)]);
+    int32_t me = rank_;
+    tree_parent_.SendAll(&me, 4);
+  } else if (listening) {
+    for (size_t k = 0; k < my_members.size(); ++k) {
+      Sock s = ctrl_listener.Accept();
+      int32_t who = -1;
+      s.RecvAll(&who, 4);
+      tree_child_socks_[who] = std::move(s);
+    }
+  }
 }
 
 // --------------------------------------------------------------------------
@@ -746,129 +884,219 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     announced_.insert(name);
   }
 
-  Writer w;
-  w.u8(flags);
-  w.i64vec(hit_positions);
-  w.i64vec(invalid_positions);
-  EncodeRequestList(w, misses);
+  Announce mine;
+  mine.rank = rank_;
+  mine.flags = flags;
+  mine.hits = std::move(hit_positions);
+  mine.invalids = std::move(invalid_positions);
+  mine.reqs = std::move(misses);
   // negotiation payload carried this cycle (vs a bare keepalive frame):
   // gates the CTRL_BYTES flight-recorder event below so idle heartbeat
-  // cycles don't flood the ring. Rank 0 also flags cycles where a
-  // REMOTE rank's frame carried payload (a straggling negotiation this
-  // rank isn't part of is still control-plane cost to attribute).
-  bool did_negotiate = !hit_positions.empty() ||
-                       !invalid_positions.empty() || !misses.empty();
-  // bytes of a payload-free worker frame: u8 flags + two empty i64vecs
-  // + an empty request list (a 4-byte length each)
-  constexpr size_t kKeepaliveFrameBytes = 1 + 3 * 4;
+  // cycles don't flood the ring. Coordinating ranks also flag cycles
+  // where a REMOTE announce carried payload (a straggling negotiation
+  // this rank isn't part of is still control-plane cost to attribute).
+  bool did_negotiate = !mine.hits.empty() || !mine.invalids.empty() ||
+                       !mine.reqs.empty();
+  auto payload = [](const Announce& a) {
+    return !a.hits.empty() || !a.invalids.empty() || !a.reqs.empty();
+  };
+  // deadline-bounded control recv: heartbeat pace when idle, op
+  // deadline when work is outstanding — classified per peer
+  auto recv_ctrl = [&](const Sock& s, int64_t ctl_ms, bool idle,
+                       const std::string& who) {
+    try {
+      auto frame = s.RecvFrame(ctl_ms);
+      // every control frame starts with a flags byte; a zero-length
+      // frame is protocol corruption and must become a containment
+      // abort, not an out-of-bounds Reader access at the decode site
+      if (frame.empty())
+        throw PeerLostError("empty control frame from " + who);
+      return frame;
+    } catch (const OpTimeoutError&) {
+      if (idle && heartbeat_ms_ > 0 && ctl_ms == heartbeat_ms_)
+        throw HeartbeatLostError(
+            "no heartbeat from " + who + " for " +
+            std::to_string(heartbeat_ms_) + " ms (HVT_HEARTBEAT_MS)");
+      throw OpTimeoutError("no control frame from " + who + " within " +
+                           std::to_string(ctl_ms) +
+                           " ms (HVT_OP_TIMEOUT_MS)");
+    } catch (const PeerLostError&) {
+      throw PeerLostError("control connection to " + who + " lost");
+    }
+  };
 
-  // 3. exchange with the coordinator. ctl_tx/ctl_rx count this cycle's
-  // control-star frame bytes (payload + 8-byte length prefix per frame)
-  // — the per-cycle control-plane cost the critical-path analyzer
-  // attributes (stats slots accumulate; CTRL_BYTES events carry deltas).
+  // 3. exchange over the control topology. ctl_tx/ctl_rx count this
+  // cycle's control frame bytes on THIS rank's sockets (payload + the
+  // 8-byte length prefix per frame) — each byte is counted exactly once
+  // gang-wide, at the rank that moved it, so tree-mode aggregates are
+  // never double-counted at the members they batch.
   int64_t ctl_tx = 0, ctl_rx = 0;
   std::vector<Response> responses;
   std::vector<int64_t> evictions;
   uint8_t resp_flags = 0;
   if (size_ == 1) {
-    std::vector<std::vector<uint8_t>> frames;
-    frames.push_back(std::move(w.buf));
-    responses = Coordinate(frames);
+    // initializer_list elements are const, so {std::move(mine)} would
+    // silently deep-copy — push_back keeps the move a move
+    std::vector<Announce> anns;
+    anns.push_back(std::move(mine));
+    responses = Coordinate(anns);
+    StampWireCodec(responses, wire_mode_);
     resp_flags = rank_shutdown_[0] ? kRespFlagShutdown : 0;
-  } else if (rank_ == 0) {
-    std::vector<std::vector<uint8_t>> frames(size_);
-    frames[0] = std::move(w.buf);
-    // deadline-bounded worker frames: heartbeat pace when idle, op
-    // deadline when negotiations/entries are outstanding. Any frame may
-    // be an ABORT from a failing worker (checked before parsing).
+  } else if (ctrl_role_ == CtrlRole::ROOT) {
+    // root: one frame per child — every rank in star mode, one LEADER
+    // per host in tree mode (each frame covering its whole subtree).
+    // Any frame may be an ABORT from a failing peer (checked first).
+    std::vector<Announce> anns;
+    anns.reserve(static_cast<size_t>(size_));
+    anns.push_back(std::move(mine));
     bool idle = pending_.empty() && !join_pending_ && counts_.empty();
     int64_t ctl_ms = ControlTimeoutMs(idle);
-    for (int r = 1; r < size_; ++r) {
-      try {
-        frames[r] = workers_[r].RecvFrame(ctl_ms);
-      } catch (const OpTimeoutError&) {
-        if (idle && heartbeat_ms_ > 0 && ctl_ms == heartbeat_ms_)
-          throw HeartbeatLostError(
-              "no heartbeat from rank " + std::to_string(r) + " for " +
-              std::to_string(heartbeat_ms_) + " ms (HVT_HEARTBEAT_MS)");
-        throw OpTimeoutError("no control frame from rank " +
-                             std::to_string(r) + " within " +
-                             std::to_string(ctl_ms) +
-                             " ms (HVT_OP_TIMEOUT_MS)");
-      } catch (const PeerLostError&) {
-        throw PeerLostError("control connection to rank " +
-                            std::to_string(r) + " lost");
+    for (int child : ctrl_children_) {
+      auto frame = recv_ctrl(workers_[static_cast<size_t>(child)],
+                             ctl_ms, idle,
+                             "rank " + std::to_string(child));
+      if (IsAbortFrame(frame))
+        throw RemoteAbortError(ParseAbortFrame(frame));
+      ctl_rx += static_cast<int64_t>(frame.size()) + kFramePrefixBytes;
+      Reader rd(frame);
+      if (frame[0] & kCtrlFlagAggregate) {
+        rd.u8();
+        for (auto& a : DecodeAggregateFrame(rd)) {
+          did_negotiate = did_negotiate || payload(a);
+          anns.push_back(std::move(a));
+        }
+      } else {
+        Announce a = DecodeAnnounceFrame(rd, child);
+        did_negotiate = did_negotiate || payload(a);
+        anns.push_back(std::move(a));
       }
-      if (IsAbortFrame(frames[r]))
-        throw RemoteAbortError(ParseAbortFrame(frames[r]));
-      ctl_rx += static_cast<int64_t>(frames[r].size()) + 8;
-      did_negotiate = did_negotiate ||
-                      frames[r].size() > kKeepaliveFrameBytes;
     }
-    responses = Coordinate(frames);
+    responses = Coordinate(anns);
+    StampWireCodec(responses, wire_mode_);
     bool all_down = true;
     for (bool b : rank_shutdown_)
       all_down = all_down && b;
     resp_flags = all_down ? kRespFlagShutdown : 0;
-    // evictions gathered by Coordinate into pending_evictions_
-    Writer out;
-    out.u8(resp_flags);
-    // broadcast the (possibly autotuned) cycle time and cache/backend
+    // evictions gathered by Coordinate into pending_evictions_.
+    // Broadcast the (possibly autotuned) cycle time and cache/backend
     // flags — the analog of Controller::SynchronizeParameters
-    // (controller.cc:39-53). The flags apply on every rank at THIS frame
-    // boundary (rank 0 below, workers on receipt), so the next cycle's
-    // cache lookups and this cycle's backend picks stay rank-identical.
+    // (controller.cc:39-53). The flags apply on every rank at THIS
+    // frame boundary (rank 0 below, workers on receipt), so the next
+    // cycle's cache lookups and this cycle's backend picks stay
+    // rank-identical. Steady-state bypass: when every response this
+    // cycle came off the cache fast path, broadcast the POSITIONS and
+    // let each rank rebuild the responses from its own (identical)
+    // cache — response bytes then stop scaling with per-name payload.
+    bool bypass = ctrl_bypass_ && coordinate_pure_fastpath_;
+    Writer out;
+    out.u8(bypass
+               ? static_cast<uint8_t>(resp_flags | kRespFlagPositions)
+               : resp_flags);
     out.i32(static_cast<int32_t>(cycle_ms_));
     out.u8(static_cast<uint8_t>((tuned_cache_enabled_ ? 1 : 0) |
                                 (tuned_prefer_flat_ ? 2 : 0)));
     out.i64vec(pending_evictions_);
-    EncodeResponseList(out, responses);
-    for (int r = 1; r < size_; ++r) workers_[r].SendFrame(out.buf);
-    ctl_tx += (static_cast<int64_t>(out.buf.size()) + 8) * (size_ - 1);
+    if (bypass) {
+      out.u8(wire_mode_);
+      // workers re-run FuseResponses on the rebuilt list, so the
+      // (possibly autotuned) fusion threshold must ride along or the
+      // fused units could diverge across ranks
+      out.i64(fusion_threshold_);
+      out.i64vec(fastpath_positions_);
+      stats_.ctrl_bypass_cycles.fetch_add(1, std::memory_order_relaxed);
+    } else {
+      EncodeResponseList(out, responses);
+    }
+    for (int child : ctrl_children_)
+      workers_[static_cast<size_t>(child)].SendFrame(out.buf);
+    ctl_tx += (static_cast<int64_t>(out.buf.size()) +
+               kFramePrefixBytes) *
+              static_cast<int64_t>(ctrl_children_.size());
     cache_enabled_ = tuned_cache_enabled_;
     prefer_flat_ = tuned_prefer_flat_;
     evictions = std::move(pending_evictions_);
     pending_evictions_.clear();
-  } else {
-    ctl_tx += static_cast<int64_t>(w.buf.size()) + 8;
-    control_.SendFrame(w.buf);
+  } else if (ctrl_role_ == CtrlRole::LEADER) {
+    // leader: gather the host's member announcements, batch them (plus
+    // our own) into ONE deduplicated cross-host frame, and fan the
+    // root's (identical-for-everyone) response frame back down.
     bool idle = pending_.empty() && !join_pending_;
     int64_t ctl_ms = ControlTimeoutMs(idle);
-    std::vector<uint8_t> frame;
-    try {
-      frame = control_.RecvFrame(ctl_ms);
-    } catch (const OpTimeoutError&) {
-      if (idle && heartbeat_ms_ > 0 && ctl_ms == heartbeat_ms_)
-        throw HeartbeatLostError(
-            "no heartbeat from rank 0 (coordinator) for " +
-            std::to_string(heartbeat_ms_) + " ms (HVT_HEARTBEAT_MS)");
-      throw OpTimeoutError("no response from rank 0 (coordinator) "
-                           "within " + std::to_string(ctl_ms) +
-                           " ms (HVT_OP_TIMEOUT_MS)");
-    } catch (const PeerLostError&) {
-      throw PeerLostError("control connection to rank 0 (coordinator) "
-                          "lost");
+    std::vector<Announce> anns;
+    bool subtree_payload = did_negotiate;
+    for (int child : ctrl_children_) {
+      auto frame = recv_ctrl(tree_child_socks_[child], ctl_ms, idle,
+                             "member rank " + std::to_string(child));
+      if (IsAbortFrame(frame))
+        throw RemoteAbortError(ParseAbortFrame(frame));
+      ctl_rx += static_cast<int64_t>(frame.size()) + kFramePrefixBytes;
+      Reader rd(frame);
+      Announce a = DecodeAnnounceFrame(rd, child);
+      subtree_payload = subtree_payload || payload(a);
+      anns.push_back(std::move(a));
     }
+    anns.push_back(std::move(mine));
+    Writer agg;
+    EncodeAggregateFrame(agg, anns);
+    ctl_tx += static_cast<int64_t>(agg.buf.size()) + kFramePrefixBytes;
+    control_.SendFrame(agg.buf);
+    // a busy subtree keeps the response wait on the op deadline even
+    // when this leader itself has nothing outstanding
+    bool up_idle = idle && !subtree_payload;
+    auto frame = recv_ctrl(control_, ControlTimeoutMs(up_idle), up_idle,
+                           "rank 0 (coordinator)");
     if (IsAbortFrame(frame))
       throw RemoteAbortError(ParseAbortFrame(frame));
-    Reader rd(frame);
-    resp_flags = rd.u8();
-    int tuned_cycle = rd.i32();
-    if (tuned_cycle > 0) cycle_ms_ = tuned_cycle;
-    uint8_t tuned = rd.u8();
-    cache_enabled_ = (tuned & 1) != 0;
-    prefer_flat_ = (tuned & 2) != 0;
-    evictions = rd.i64vec();
-    responses = DecodeResponseList(rd);
-    ctl_rx += static_cast<int64_t>(frame.size()) + 8;
+    ctl_rx += static_cast<int64_t>(frame.size()) + kFramePrefixBytes;
+    for (int child : ctrl_children_)
+      tree_child_socks_[child].SendFrame(frame);
+    ctl_tx += (static_cast<int64_t>(frame.size()) + kFramePrefixBytes) *
+              static_cast<int64_t>(ctrl_children_.size());
+    did_negotiate = subtree_payload;
+    DecodeResponseFrame(frame, responses, evictions, resp_flags);
+  } else {
+    // member: one announce up (a bitmask vote when the cycle is pure
+    // cache hits), one response frame down. The upstream peer is the
+    // host leader in tree mode, rank 0 in star mode.
+    const Sock& up = tree_mode_ ? tree_parent_ : control_;
+    const std::string peer =
+        tree_mode_ ? "the host leader" : "rank 0 (coordinator)";
+    // Tree members park their star socket after init; the only frame
+    // rank 0 ever sends on it afterwards is an ABORT. Poll it
+    // nonblocking each cycle so a root abort reaches this member even
+    // when its leader is wedged (stalled, not dead — a dead leader's
+    // FIN surfaces through tree_parent_ immediately). A member already
+    // blocked waiting on a wedged leader converges at its own control
+    // deadline instead.
+    if (tree_mode_ && control_.valid()) {
+      struct pollfd pd {control_.fd(), POLLIN, 0};
+      if (::poll(&pd, 1, 0) > 0) {
+        auto f = control_.RecvFrame(1000);
+        if (IsAbortFrame(f))
+          throw RemoteAbortError(ParseAbortFrame(f));
+      }
+    }
+    Writer w;
+    EncodeAnnounceFrame(w, mine, ctrl_bypass_);
+    ctl_tx += static_cast<int64_t>(w.buf.size()) + kFramePrefixBytes;
+    up.SendFrame(w.buf);
+    bool idle = pending_.empty() && !join_pending_;
+    auto frame = recv_ctrl(up, ControlTimeoutMs(idle), idle, peer);
+    if (IsAbortFrame(frame))
+      throw RemoteAbortError(ParseAbortFrame(frame));
+    ctl_rx += static_cast<int64_t>(frame.size()) + kFramePrefixBytes;
+    DecodeResponseFrame(frame, responses, evictions, resp_flags);
   }
   if (ctl_tx || ctl_rx) {
     stats_.ctrl_tx_bytes.fetch_add(ctl_tx, std::memory_order_relaxed);
     stats_.ctrl_rx_bytes.fetch_add(ctl_rx, std::memory_order_relaxed);
     // per-cycle attribution event — only for cycles that did real work
-    // (see EventKind::CTRL_BYTES on why idle keepalives are excluded)
+    // (see EventKind::CTRL_BYTES on why idle keepalives are excluded);
+    // op carries this rank's CtrlRole so hvt_analyze can attribute the
+    // tree's leader hop separately from root/member traffic
     if (did_negotiate || !responses.empty())
-      events_.Record(EventKind::CTRL_BYTES, "", -1,
+      events_.Record(EventKind::CTRL_BYTES, "",
+                     static_cast<int32_t>(ctrl_role_),
                      static_cast<int32_t>(ctl_tx), ctl_rx);
   }
 
@@ -1064,22 +1292,42 @@ bool Engine::RegisterArrival(const std::string& key, int r, Request q,
   return true;
 }
 
+// The coordinator core consumes per-rank Announce structs — the SAME
+// structs whether they arrived as star frames, bitmask votes, or
+// tree-mode leader aggregates — so every control topology negotiates
+// through identical logic and produces identical response streams.
 std::vector<Response> Engine::Coordinate(
-    const std::vector<std::vector<uint8_t>>& frames) {
+    const std::vector<Announce>& anns) {
   std::vector<Response> out;
   double now = NowSec();
+  fastpath_positions_.clear();
+  coordinate_pure_fastpath_ = false;
 
-  for (int r = 0; r < static_cast<int>(frames.size()); ++r) {
-    Reader rd(frames[r]);
-    uint8_t flags = rd.u8();
+  // Iterate in RANK order regardless of arrival order: tree-mode
+  // aggregates deliver announces in subtree order, and order-sensitive
+  // bookkeeping (last_join_rank_ when two ranks join in one cycle, the
+  // first-announcer request a negotiation entry is keyed from) must
+  // match the star baseline exactly or the two topologies would
+  // diverge on identical workloads.
+  std::vector<const Announce*> by_rank(anns.size());
+  for (size_t i = 0; i < anns.size(); ++i) by_rank[i] = &anns[i];
+  std::sort(by_rank.begin(), by_rank.end(),
+            [](const Announce* a, const Announce* b) {
+              return a->rank < b->rank;
+            });
+  for (const Announce* ann_p : by_rank) {
+    const Announce& ann = *ann_p;
+    int r = ann.rank;
+    if (r < 0 || r >= size_) continue;  // corrupt aggregate entry
+    uint8_t flags = ann.flags;
     rank_shutdown_[r] = rank_shutdown_[r] || (flags & kCtrlFlagShutdown);
     bool joined = (flags & kCtrlFlagJoin) != 0;
     if (joined && !rank_joined_[r])
       last_join_rank_ = r;  // join order is observed here, cycle by cycle
     rank_joined_[r] = joined;
-    auto hits = rd.i64vec();
-    auto invalids = rd.i64vec();
-    auto reqs = DecodeRequestList(rd);
+    const auto& hits = ann.hits;
+    const auto& invalids = ann.invalids;
+    const auto& reqs = ann.reqs;
     for (auto pos : hits) {
       // mixed hit/miss reconciliation, hit-after-miss direction: the
       // tensor cached at `pos` is already in slow-path negotiation
@@ -1281,6 +1529,7 @@ std::vector<Response> Engine::Coordinate(
   // for the global lane) — a serving replica's steady-state traffic
   // completes here on the announcements of its own members alone,
   // without waiting on (or disturbing) any other lane.
+  const size_t pre_fastpath = out.size();
   if (active == size_) {
     std::set<int64_t> candidates;
     for (auto& hp : hit_pending_)
@@ -1308,21 +1557,13 @@ std::vector<Response> Engine::Coordinate(
     }
     for (auto pos : ready) {
       for (int r = 0; r < size_; ++r) hit_pending_[r].erase(pos);
-      const CachedParams* p = cache_.ParamsAt(static_cast<int32_t>(pos));
-      if (!p) continue;
+      // single spelling shared with the worker-side positions rebuild
+      // (ResponseCache::ResponseAt) — the steady-state bypass depends
+      // on both sides producing byte-identical responses
       Response resp;
-      resp.kind = Response::Kind::TENSOR;
-      resp.op = p->op;
-      resp.names = {cache_.NameAt(static_cast<int32_t>(pos))};
-      resp.dtype = p->dtype;
-      resp.reduce = p->reduce;
-      resp.root = p->root_rank;
-      resp.prescale = p->prescale;
-      resp.postscale = p->postscale;
-      resp.numels = {p->shape.num_elements()};
-      resp.shapes = {p->shape};  // local-only: see Response::shapes
-      resp.members = p->members;
-      out.push_back(resp);
+      if (!cache_.ResponseAt(static_cast<int32_t>(pos), &resp)) continue;
+      fastpath_positions_.push_back(pos);
+      out.push_back(std::move(resp));
     }
   } else {
     // Some rank joined: it will never announce its remaining tensors,
@@ -1422,17 +1663,83 @@ std::vector<Response> Engine::Coordinate(
       groups_.erase(gid);  // deregister on completion (operations.cc:622)
   }
 
+  // Bypass eligibility: the cycle produced ONLY fast-path responses
+  // (no errors, join, barrier, group releases, or slow-path builds) —
+  // evaluated pre-fusion, since workers re-fuse the rebuilt list with
+  // the same deterministic pass.
+  coordinate_pure_fastpath_ =
+      !fastpath_positions_.empty() && pre_fastpath == 0 &&
+      out.size() == fastpath_positions_.size();
   FuseResponses(out);
-  // Stamp the negotiated wire codec (HVT_WIRE_COMPRESSION on rank 0) on
-  // every eligible TENSOR response — cache fast-path and slow-path alike
-  // — so all participants compress/decompress identically. Only fp32
-  // non-Adasum allreduces compress (bf16 halves their DCN bytes).
-  if (wire_mode_ == static_cast<uint8_t>(WireCodec::BF16))
-    for (auto& r : out)
-      if (r.kind == Response::Kind::TENSOR &&
-          r.op == OpType::ALLREDUCE && r.dtype == DataType::FLOAT32 &&
-          r.reduce != ReduceKind::ADASUM)
-        r.wire = static_cast<uint8_t>(WireCodec::BF16);
+  return out;
+}
+
+// Stamp the negotiated wire codec (HVT_WIRE_COMPRESSION on rank 0) on
+// every eligible TENSOR response — cache fast-path and slow-path alike
+// — so all participants compress/decompress identically. Only fp32
+// non-Adasum allreduces compress (bf16 halves their DCN bytes). Called
+// by the coordinator after Coordinate and by every rank rebuilding a
+// positions-form response (the broadcast carries rank 0's wire mode, so
+// the stamp rule evaluates identically gang-wide).
+void Engine::StampWireCodec(std::vector<Response>& responses,
+                            uint8_t wire_mode) {
+  if (wire_mode != static_cast<uint8_t>(WireCodec::BF16)) return;
+  for (auto& r : responses)
+    if (r.kind == Response::Kind::TENSOR && r.op == OpType::ALLREDUCE &&
+        r.dtype == DataType::FLOAT32 && r.reduce != ReduceKind::ADASUM)
+      r.wire = static_cast<uint8_t>(WireCodec::BF16);
+}
+
+// Worker-side decode of a rank-0→worker response frame — the full form
+// (EncodeResponseList) or the steady-state positions form
+// (kRespFlagPositions), which rebuilds the coordinator's response list
+// from this rank's own cache. Shared by star workers, tree members,
+// and tree leaders, and applies the frame-synchronized cycle/cache/
+// backend parameters as a side effect.
+void Engine::DecodeResponseFrame(const std::vector<uint8_t>& frame,
+                                 std::vector<Response>& responses,
+                                 std::vector<int64_t>& evictions,
+                                 uint8_t& resp_flags) {
+  Reader rd(frame);
+  uint8_t first = rd.u8();
+  resp_flags = static_cast<uint8_t>(first & ~kRespFlagPositions);
+  int tuned_cycle = rd.i32();
+  if (tuned_cycle > 0) cycle_ms_ = tuned_cycle;
+  uint8_t tuned = rd.u8();
+  cache_enabled_ = (tuned & 1) != 0;
+  prefer_flat_ = (tuned & 2) != 0;
+  evictions = rd.i64vec();
+  if (first & kRespFlagPositions) {
+    uint8_t wire_mode = rd.u8();
+    // adopt the coordinator's fusion threshold before re-fusing the
+    // rebuilt list — local fusion must never diverge from rank 0's
+    fusion_threshold_ = rd.i64();
+    responses = ResponsesFromPositions(rd.i64vec(), wire_mode);
+    stats_.ctrl_bypass_cycles.fetch_add(1, std::memory_order_relaxed);
+  } else {
+    responses = DecodeResponseList(rd);
+  }
+}
+
+std::vector<Response> Engine::ResponsesFromPositions(
+    const std::vector<int64_t>& positions, uint8_t wire_mode) {
+  std::vector<Response> out;
+  out.reserve(positions.size());
+  for (auto pos : positions) {
+    Response r;
+    if (!cache_.ResponseAt(static_cast<int32_t>(pos), &r))
+      // caches are identical on every rank by construction; a missing
+      // position means the sync invariant broke — fail loudly (the
+      // engine maps this to a coordinated abort) instead of silently
+      // skipping a collective the rest of the gang will run
+      throw std::runtime_error(
+          "hvt: positions-form response names cache position " +
+          std::to_string(pos) +
+          " which is not present locally (response-cache divergence)");
+    out.push_back(std::move(r));
+  }
+  FuseResponses(out);
+  StampWireCodec(out, wire_mode);
   return out;
 }
 

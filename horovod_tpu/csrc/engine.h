@@ -98,6 +98,37 @@ inline int LaneSlot(uint64_t lane) {
   return lane == 0 ? 0 : 1 + static_cast<int>(lane % (kLaneSlots - 1));
 }
 
+// --------------------------------------------------------------------------
+// control-plane topology
+// --------------------------------------------------------------------------
+// HVT_CTRL_TOPOLOGY selects how negotiation traffic reaches rank 0:
+//   star (default): every rank exchanges frames with rank 0 directly —
+//     the parity baseline, O(world) sockets on the coordinator.
+//   tree: one LEADER per host aggregates its co-located MEMBERS'
+//     announcements into a single batched cross-host frame and fans the
+//     (identical) response back down, so the rank-0 hot loop serves
+//     O(hosts) sockets. Rank 0 is the pure ROOT: even on its own host
+//     the members attach to a separate leader (the lowest non-zero
+//     rank), capping the root's fan-in at one peer per host that has a
+//     leader (= the host count; one less when rank 0 sits on a host of
+//     its own, which then needs no leader).
+// Role wire ids are stamped into CTRL_BYTES events (EventView.op) and
+// mirrored by hvt_analyze.CTRL_ROLES — a cross-language contract
+// checked by tools/hvt_lint.py.
+enum class CtrlRole : int32_t {
+  ROOT = 0,    // rank 0: terminates every negotiation
+  LEADER = 1,  // aggregates one host's members (tree mode only)
+  MEMBER = 2,  // talks to its leader (tree) or to rank 0 (star)
+};
+inline const char* CtrlRoleName(CtrlRole r) {
+  switch (r) {
+    case CtrlRole::ROOT: return "root";
+    case CtrlRole::LEADER: return "leader";
+    case CtrlRole::MEMBER: return "member";
+  }
+  return "?";
+}
+
 // Abort causes for the coordinated-abort path — index into
 // EngineStats::aborts and the {cause} label of
 // hvt_engine_aborts_total. Wire ids (part of the stats-slot ABI).
@@ -195,6 +226,13 @@ struct EngineStats {
   // deltas for cycles that did work)
   std::atomic<int64_t> ctrl_tx_bytes{0};
   std::atomic<int64_t> ctrl_rx_bytes{0};
+  // direct control-plane peers this rank serves (gauge, set at Init):
+  // the scaling story in one number — star rank 0 reports world-1,
+  // tree rank 0 reports the host count
+  std::atomic<int64_t> ctrl_peers{0};
+  // cycles that rode the steady-state bypass (position-form response
+  // rebuilt from the cache instead of full per-name payloads)
+  std::atomic<int64_t> ctrl_bypass_cycles{0};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -219,6 +257,8 @@ struct EngineStats {
     }
     ctrl_tx_bytes = 0;
     ctrl_rx_bytes = 0;
+    ctrl_peers = 0;
+    ctrl_bypass_cycles = 0;
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -369,9 +409,30 @@ class Engine {
     double first_seen_sec = 0;
     int count = 0;
   };
-  std::vector<Response> Coordinate(
-      const std::vector<std::vector<uint8_t>>& frames);
+  std::vector<Response> Coordinate(const std::vector<Announce>& anns);
   Response BuildResponse(const std::vector<Request>& reqs);
+  // Hierarchical control plane (HVT_CTRL_TOPOLOGY=tree): derive roles
+  // from the rendezvous topology and build the leader/member links —
+  // leaders listen, members dial, ports travel over the existing star.
+  void SetupTreeControl(const std::vector<std::string>& endpoints,
+                        const std::vector<std::string>& topo_hosts);
+  // Decode a rank-0→worker response frame (full or positions form)
+  // into responses + evictions + resp_flags, applying the synchronized
+  // cycle/cache/backend parameters — shared by star workers, tree
+  // members, and tree leaders.
+  void DecodeResponseFrame(const std::vector<uint8_t>& frame,
+                           std::vector<Response>& responses,
+                           std::vector<int64_t>& evictions,
+                           uint8_t& resp_flags);
+  // Steady-state bypass: rebuild the coordinator's response list from
+  // broadcast cache positions (caches are identical on every rank) and
+  // re-apply fusion + the wire-codec stamp deterministically.
+  std::vector<Response> ResponsesFromPositions(
+      const std::vector<int64_t>& positions, uint8_t wire_mode);
+  // Stamp the negotiated wire codec on every eligible response (rank 0
+  // after Coordinate; workers after a positions-form rebuild).
+  static void StampWireCodec(std::vector<Response>& responses,
+                             uint8_t wire_mode);
   // lane-scoped negotiation key: tensor name + the process-set member
   // list (bare name for the global set) — the single spelling shared by
   // the request loop and the cache-hit fold so the two can never diverge
@@ -399,6 +460,14 @@ class Engine {
   // control plane
   Sock control_;                 // workers: connection to rank 0
   std::vector<Sock> workers_;    // rank 0: connections from workers
+  // hierarchical control plane (HVT_CTRL_TOPOLOGY=tree)
+  bool tree_mode_ = false;
+  bool ctrl_bypass_ = true;      // HVT_CTRL_BYPASS (0 → always full
+                                 // frames; parity/debug baseline)
+  CtrlRole ctrl_role_ = CtrlRole::ROOT;
+  std::vector<int> ctrl_children_;        // root: leaders; leader: members
+  std::map<int, Sock> tree_child_socks_;  // leader: member connections
+  Sock tree_parent_;                      // member: connection to leader
   std::unique_ptr<DataPlane> data_;
   Listener data_listener_;
   // ordered backend list (reference operations.cc:142-249); built at Init
@@ -486,6 +555,12 @@ class Engine {
   std::vector<bool> rank_shutdown_;
   std::vector<std::set<int64_t>> hit_pending_;  // per rank, cache positions
   std::vector<int64_t> pending_evictions_;
+  // steady-state bypass bookkeeping (filled by Coordinate): the cache
+  // positions emitted by the all-members-hit fast path this cycle, and
+  // whether they were the ONLY responses — the eligibility condition
+  // for broadcasting positions instead of full responses
+  std::vector<int64_t> fastpath_positions_;
+  bool coordinate_pure_fastpath_ = false;
   int last_join_rank_ = -1;
   std::atomic<int64_t> fusion_threshold_{64 << 20};  // see cycle_ms_ note
   double stall_warn_sec_ = 60.0;

@@ -52,11 +52,14 @@ enum class EventKind : int32_t {
                         // name = truncated reason
   CTRL_BYTES = 12,      // control-plane frame bytes this cycle (incl.
                         // the 8-byte length prefixes): arg = sent,
-                        // arg2 = received. Recorded only on cycles that
-                        // carried negotiation payload or executed
-                        // responses — idle heartbeat cycles accumulate
-                        // into the ctrl_tx/rx_bytes stats slots instead
-                        // of flooding the ring.
+                        // arg2 = received, op = the recording rank's
+                        // CtrlRole (engine.h; root/leader/member — the
+                        // tree's leader hop attributes separately).
+                        // Recorded only on cycles that carried
+                        // negotiation payload or executed responses —
+                        // idle heartbeat cycles accumulate into the
+                        // ctrl_tx/rx_bytes stats slots instead of
+                        // flooding the ring.
   WIRE_BEGIN = 13,      // TCP data-plane duplex pump span begin (one per
                         // ring step / pairwise exchange): arg2 = bytes
                         // this pump will move (tx + rx), lane = LaneSlot

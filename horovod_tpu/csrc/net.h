@@ -42,6 +42,10 @@ namespace hvt {
 // (hvt_engine_aborts_total{cause}) and the containment path can react
 // differently to a dead peer vs a stalled one. Both inherit
 // runtime_error, so legacy catch sites keep working.
+// Every control/data frame travels with a u64 length prefix; byte
+// accounting (hvt_ctrl_*_bytes_total, CTRL_BYTES events) includes it.
+constexpr int64_t kFramePrefixBytes = 8;
+
 struct PeerLostError : std::runtime_error {
   explicit PeerLostError(const std::string& w) : std::runtime_error(w) {}
 };

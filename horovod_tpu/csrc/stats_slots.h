@@ -21,7 +21,7 @@
 // `hist.count`.
 #pragma once
 
-#define HVT_STATS_SLOT_COUNT 102
+#define HVT_STATS_SLOT_COUNT 104
 
 // X-macro: HVT_STATS_SLOT(index, "name")
 #define HVT_STATS_SLOTS(X)                  \
@@ -126,4 +126,6 @@
   X(98, "lane_exec_count[6]")               \
   X(99, "lane_exec_count[7]")               \
   X(100, "ctrl_tx_bytes")                   \
-  X(101, "ctrl_rx_bytes")
+  X(101, "ctrl_rx_bytes")                   \
+  X(102, "ctrl_peers")                      \
+  X(103, "ctrl_bypass_cycles")

@@ -5,7 +5,11 @@
 // (reference message.h: Request:50, Response:152).
 #pragma once
 
+#include <algorithm>
 #include <cstdint>
+#include <map>
+#include <set>
+#include <stdexcept>
 #include <string>
 #include <vector>
 
@@ -26,7 +30,21 @@ namespace hvt {
 // --------------------------------------------------------------------------
 constexpr uint8_t kCtrlFlagShutdown = 0x01;  // rank requests shutdown
 constexpr uint8_t kCtrlFlagJoin = 0x02;      // rank has joined
+constexpr uint8_t kCtrlFlagBitmask = 0x04;   // steady-state bypass: the
+                                             // announce is a cache-
+                                             // position bitmask vote,
+                                             // not per-name payloads
+constexpr uint8_t kCtrlFlagAggregate = 0x08; // hierarchical control
+                                             // plane: one leader frame
+                                             // batching a whole host's
+                                             // announcements
 constexpr uint8_t kRespFlagShutdown = 0x01;  // whole gang shut down
+constexpr uint8_t kRespFlagPositions = 0x02; // steady-state bypass: the
+                                             // response carries cache
+                                             // POSITIONS; every rank
+                                             // rebuilds the responses
+                                             // from its own (identical)
+                                             // cache
 constexpr uint8_t kAbortFrameFlag = 0x80;    // frame is an ABORT
                                              // (origin rank + reason)
 
@@ -113,29 +131,56 @@ class Writer {
   }
 };
 
+// Bounds-checked decoder. Control frames cross trust boundaries (a
+// corrupt or truncated peer frame must land on the engine's
+// containment-abort path, never on an out-of-bounds read), so every
+// read validates against the remaining buffer and throws — the engine
+// thread maps the exception to EnterBroken like any other protocol
+// failure. NOTE: Reader holds a REFERENCE; never construct one from a
+// temporary (`Reader rd(sock.RecvFrame())` dangles).
+struct TruncatedFrameError : std::runtime_error {
+  TruncatedFrameError()
+      : std::runtime_error("hvt: truncated/corrupt control frame") {}
+};
+
 class Reader {
  public:
   explicit Reader(const std::vector<uint8_t>& b) : buf_(b) {}
-  uint8_t u8() { return buf_[pos_++]; }
+  uint8_t u8() { need(1); return buf_[pos_++]; }
   int32_t i32() { int32_t v; copy(&v, 4); return v; }
   int64_t i64() { int64_t v; copy(&v, 8); return v; }
   double f64() { double v; copy(&v, 8); return v; }
   std::string str() {
-    int32_t n = i32();
+    size_t n = count(1);
     std::string s(reinterpret_cast<const char*>(buf_.data() + pos_), n);
     pos_ += n;
     return s;
   }
   std::vector<int64_t> i64vec() {
-    int32_t n = i32();
+    size_t n = count(8);
     std::vector<int64_t> v(n);
     for (auto& x : v) x = i64();
     return v;
   }
+  // Element count for a list whose entries occupy at least
+  // min_elem_bytes each — rejects negative and buffer-overrunning
+  // counts BEFORE any allocation sized from wire data.
+  size_t count(size_t min_elem_bytes) {
+    int32_t n = i32();
+    if (n < 0 ||
+        static_cast<size_t>(n) > remaining() / (min_elem_bytes ? min_elem_bytes : 1))
+      throw TruncatedFrameError();
+    return static_cast<size_t>(n);
+  }
+  size_t remaining() const { return buf_.size() - pos_; }
   bool done() const { return pos_ >= buf_.size(); }
 
  private:
+  void need(size_t n) const {
+    if (remaining() < n) throw TruncatedFrameError();
+  }
   void copy(void* p, size_t n) {
+    need(n);
     memcpy(p, buf_.data() + pos_, n);
     pos_ += n;
   }
@@ -183,7 +228,9 @@ inline void EncodeRequestList(Writer& w, const std::vector<Request>& rs) {
 }
 
 inline std::vector<Request> DecodeRequestList(Reader& rd) {
-  int32_t n = rd.i32();
+  // every encoded request occupies well over 16 bytes — the count
+  // bound rejects corrupt lengths before the allocation
+  size_t n = rd.count(16);
   std::vector<Request> rs(n);
   for (auto& r : rs) r = DecodeRequest(rd);
   return rs;
@@ -236,10 +283,195 @@ inline void EncodeResponseList(Writer& w, const std::vector<Response>& rs) {
 }
 
 inline std::vector<Response> DecodeResponseList(Reader& rd) {
-  int32_t n = rd.i32();
+  size_t n = rd.count(16);  // see DecodeRequestList
   std::vector<Response> rs(n);
   for (auto& r : rs) r = DecodeResponse(rd);
   return rs;
+}
+
+// --------------------------------------------------------------------------
+// per-rank announcement + the hierarchical / bypass codecs
+// --------------------------------------------------------------------------
+// One rank's per-cycle control-plane announcement, decoded from any of
+// the three wire forms (plain, bitmask vote, leader aggregate). The
+// coordinator consumes ONLY this struct, so star and tree mode share
+// the negotiation core verbatim — which is what makes the two modes
+// bit-identical by construction.
+struct Announce {
+  int32_t rank = 0;
+  uint8_t flags = 0;                 // kCtrlFlagShutdown / kCtrlFlagJoin
+  std::vector<int64_t> hits;         // cache positions announced as hits
+  std::vector<int64_t> invalids;     // positions needing gang eviction
+  std::vector<Request> reqs;         // cache misses (full requests)
+};
+
+// Hard cap on the bitmask vote width: cache positions are monotonic
+// (never reused), so a pathologically churny job could grow the mask
+// unboundedly — past this bound the announce falls back to the plain
+// position-list form.
+constexpr int64_t kCtrlBitmaskMaxPos = 1 << 20;
+
+// Encode one rank's announce. The steady-state bypass form — a fixed
+// width cache-position bitmask instead of per-name payloads — engages
+// when the cycle is PURE cache hits (no misses, no invalidations, no
+// join/shutdown flags): the dominant shape of a settled training or
+// serving loop, where control bytes then stop scaling with tensor-name
+// length entirely.
+inline void EncodeAnnounceFrame(Writer& w, const Announce& a,
+                                bool allow_bitmask) {
+  int64_t max_pos = -1;
+  for (auto p : a.hits) max_pos = p > max_pos ? p : max_pos;
+  // the mask must actually be SMALLER than the plain position list:
+  // positions are monotonic (never reused), so a long-lived job hitting
+  // a few high-position tensors would otherwise pay a max_pos/8-byte
+  // mask where the plain form costs 8 bytes per hit
+  int64_t mask_bytes = max_pos / 8 + 1;
+  bool bitmask = allow_bitmask && a.flags == 0 && !a.hits.empty() &&
+                 a.invalids.empty() && a.reqs.empty() &&
+                 max_pos < kCtrlBitmaskMaxPos &&
+                 mask_bytes <=
+                     static_cast<int64_t>(a.hits.size()) * 8 + 8;
+  if (bitmask) {
+    w.u8(kCtrlFlagBitmask);
+    int32_t nbytes = static_cast<int32_t>(mask_bytes);
+    w.i32(nbytes);
+    size_t base = w.buf.size();
+    w.buf.resize(base + static_cast<size_t>(nbytes), 0);
+    for (auto p : a.hits)
+      w.buf[base + static_cast<size_t>(p / 8)] |=
+          static_cast<uint8_t>(1u << (p % 8));
+    return;
+  }
+  w.u8(a.flags);
+  w.i64vec(a.hits);
+  w.i64vec(a.invalids);
+  EncodeRequestList(w, a.reqs);
+}
+
+// Decode a plain or bitmask announce frame into the rank's Announce.
+inline Announce DecodeAnnounceFrame(Reader& rd, int32_t rank) {
+  Announce a;
+  a.rank = rank;
+  uint8_t first = rd.u8();
+  if (first & kCtrlFlagBitmask) {
+    a.flags = 0;  // bitmask form implies no join/shutdown this cycle
+    size_t nbytes = rd.count(1);
+    for (size_t i = 0; i < nbytes; ++i) {
+      uint8_t byte = rd.u8();
+      while (byte) {
+        int bit = __builtin_ctz(byte);
+        a.hits.push_back(static_cast<int64_t>(i) * 8 + bit);
+        byte = static_cast<uint8_t>(byte & (byte - 1));
+      }
+    }
+    return a;
+  }
+  a.flags = first;
+  a.hits = rd.i64vec();
+  a.invalids = rd.i64vec();
+  a.reqs = DecodeRequestList(rd);
+  return a;
+}
+
+// Leader aggregate (tree mode): one cross-host frame batching every
+// announcement of the leader's subtree. Redundancy across co-located
+// ranks is collapsed — a steady training step announces each tensor
+// once per HOST instead of once per RANK:
+//   * identical hit sets merge into one (ranks, positions) group;
+//   * byte-identical requests (ignoring the announcing rank) merge
+//     into one (request, ranks) group;
+//   * invalidations are a deduplicated union (eviction broadcasts are
+//     rank-agnostic);
+//   * per-rank flags ride a full roster, because shutdown/join state
+//     must track every covered rank every cycle (a roster gap would
+//     freeze the rank's last flags at the coordinator).
+inline void EncodeAggregateFrame(Writer& w,
+                                 const std::vector<Announce>& anns) {
+  w.u8(kCtrlFlagAggregate);
+  w.i32(static_cast<int32_t>(anns.size()));
+  for (auto& a : anns) {
+    w.i32(a.rank);
+    w.u8(a.flags);
+  }
+  // hit groups: identical (sorted) hit sets share one entry
+  std::map<std::vector<int64_t>, std::vector<int64_t>> hit_groups;
+  for (auto& a : anns) {
+    if (a.hits.empty()) continue;
+    std::vector<int64_t> key = a.hits;
+    std::sort(key.begin(), key.end());
+    hit_groups[std::move(key)].push_back(a.rank);
+  }
+  w.i32(static_cast<int32_t>(hit_groups.size()));
+  for (auto& [positions, ranks] : hit_groups) {
+    w.i64vec(ranks);
+    w.i64vec(positions);
+  }
+  // invalidations: deduplicated union
+  std::set<int64_t> invalids;
+  for (auto& a : anns)
+    invalids.insert(a.invalids.begin(), a.invalids.end());
+  w.i64vec(std::vector<int64_t>(invalids.begin(), invalids.end()));
+  // request groups: byte-identical requests (rank zeroed) share one
+  // encoded body + the announcing-rank list
+  std::map<std::vector<uint8_t>,
+           std::pair<const Request*, std::vector<int64_t>>> req_groups;
+  for (auto& a : anns)
+    for (auto& q : a.reqs) {
+      Writer kw;
+      Request norm = q;
+      norm.rank = -1;
+      EncodeRequest(kw, norm);
+      auto& group = req_groups[std::move(kw.buf)];
+      if (group.first == nullptr) group.first = &q;
+      group.second.push_back(a.rank);
+    }
+  w.i32(static_cast<int32_t>(req_groups.size()));
+  for (auto& kv : req_groups) {
+    EncodeRequest(w, *kv.second.first);
+    w.i64vec(kv.second.second);
+  }
+}
+
+// Expand an aggregate frame back into per-rank announcements (the
+// Reader must be positioned AFTER the kCtrlFlagAggregate byte).
+inline std::vector<Announce> DecodeAggregateFrame(Reader& rd) {
+  size_t n = rd.count(5);  // roster entries are 5 bytes each
+  std::vector<Announce> anns(n);
+  std::map<int64_t, size_t> by_rank;
+  for (size_t i = 0; i < n; ++i) {
+    anns[i].rank = rd.i32();
+    anns[i].flags = rd.u8();
+    // a duplicated roster rank is a corrupt frame — route it onto the
+    // containment path rather than applying one rank's flags twice
+    if (!by_rank.emplace(anns[i].rank, i).second) throw TruncatedFrameError();
+  }
+  auto at = [&](int64_t r) -> Announce* {
+    auto it = by_rank.find(r);
+    return it == by_rank.end() ? nullptr : &anns[it->second];
+  };
+  size_t n_hits = rd.count(8);  // each group: two non-empty i64vecs
+  for (size_t g = 0; g < n_hits; ++g) {
+    auto ranks = rd.i64vec();
+    auto positions = rd.i64vec();
+    for (auto r : ranks)
+      if (Announce* a = at(r))
+        a->hits.insert(a->hits.end(), positions.begin(), positions.end());
+  }
+  auto invalids = rd.i64vec();
+  if (!anns.empty())
+    anns[0].invalids = std::move(invalids);  // rank-agnostic broadcast
+  size_t n_reqs = rd.count(16);  // see DecodeRequestList
+  for (size_t g = 0; g < n_reqs; ++g) {
+    Request proto = DecodeRequest(rd);
+    auto ranks = rd.i64vec();
+    for (auto r : ranks)
+      if (Announce* a = at(r)) {
+        Request q = proto;
+        q.rank = static_cast<int32_t>(r);
+        a->reqs.push_back(std::move(q));
+      }
+  }
+  return anns;
 }
 
 }  // namespace hvt

@@ -173,9 +173,13 @@ STATS_LAT_BUCKETS = 14
 STATS_LANE_SLOTS = 8
 # scalar slots appended AFTER the structured groups (c_api.cc
 # kStatsTailScalars) — the append-only escape hatch for new plain
-# counters: control-star frame bytes sent/received (incl. the 8-byte
-# length prefixes), every cycle including idle heartbeats
-STATS_TAIL_SCALARS = ("ctrl_tx_bytes", "ctrl_rx_bytes")
+# counters: control-plane frame bytes sent/received (incl. the 8-byte
+# length prefixes, every cycle including idle heartbeats), the number
+# of direct control-plane peers this rank serves (star rank 0: world-1;
+# tree rank 0: the host count), and the cycles served by the
+# steady-state positions-form bypass
+STATS_TAIL_SCALARS = ("ctrl_tx_bytes", "ctrl_rx_bytes", "ctrl_peers",
+                      "ctrl_bypass_cycles")
 
 
 def engine_stats() -> dict:
@@ -294,14 +298,20 @@ def drain_events(max_events: int = 4096) -> list:
         e = buf[i]
         kind = int(e.kind)
         op = int(e.op)
+        kind_name = (EVENT_KINDS[kind]
+                     if 0 <= kind < len(EVENT_KINDS) else "?")
+        # CTRL_BYTES repurposes the op field as the rank's CtrlRole
+        # wire id (csrc/engine.h ↔ utils/timeline.CTRL_ROLES) — naming
+        # it as a collective op would mislabel every CTRL event
+        op_name = ("" if kind_name == "CTRL_BYTES"
+                   else STATS_OPS[op].upper()
+                   if 0 <= op < len(STATS_OPS) else "")
         out.append({
             "ts_us": int(e.ts_us),
             "kind": kind,
-            "kind_name": (EVENT_KINDS[kind]
-                          if 0 <= kind < len(EVENT_KINDS) else "?"),
+            "kind_name": kind_name,
             "op": op,
-            "op_name": (STATS_OPS[op].upper()
-                        if 0 <= op < len(STATS_OPS) else ""),
+            "op_name": op_name,
             "name": e.name.decode(errors="replace"),
             "arg": int(e.arg),
             "arg2": int(e.arg2),

@@ -59,6 +59,15 @@ def parse_args(argv=None):
                         "port+r); 0 binds ephemeral ports")
     p.add_argument("--stall-warning-sec", type=int, default=60,
                    help="stall inspector warning threshold")
+    p.add_argument("--ctrl-topology", choices=["star", "tree"],
+                   default=None,
+                   help="control-plane shape (HVT_CTRL_TOPOLOGY): tree "
+                        "elects one leader per host to aggregate "
+                        "negotiation frames, capping rank 0's fan-in at "
+                        "the host count (docs/performance.md "
+                        "§control-plane); star is the default. The "
+                        "launcher sets it for every worker — the value "
+                        "must agree gang-wide")
     p.add_argument("--autotune", action="store_true",
                    help="enable Bayesian autotuning of fusion threshold "
                         "and cycle time (reference --autotune)")
@@ -154,6 +163,9 @@ def slot_env(base_env, slot, args, master_addr):
         env["HVT_TIMELINE_SHARD"] = args.timeline
     if getattr(args, "metrics_port", None) is not None:
         env["HVT_METRICS_PORT"] = str(args.metrics_port)
+    if getattr(args, "ctrl_topology", None):
+        # must agree across the gang (leaders/members derive from it)
+        env["HVT_CTRL_TOPOLOGY"] = args.ctrl_topology
     if getattr(args, "autotune", False):
         env["HVT_AUTOTUNE"] = "1"
         if args.autotune_log_file:

@@ -59,6 +59,14 @@ SCHEMA = "hvt-analyze-r1"
 # phase keys in report order; "metrics" carries <phase>_us_p50 for each
 PHASES = ("queue", "negotiate", "wire", "reduce", "exec", "e2e")
 
+# control-plane roles a CTRL instant can carry (args.role, stamped by
+# the timeline drainer from the engine's CtrlRole wire id) — tree mode
+# introduces the leader hop, and its aggregate bytes must be
+# attributable separately from root/member traffic. The authoritative
+# registry is utils/timeline.py CTRL_ROLES ↔ csrc/engine.h CtrlRole
+# (hvt_lint cross-checks them); this import keeps a single spelling.
+CTRL_ROLES = _tl.CTRL_ROLES
+
 _CYCLE_RE = re.compile(r"ENGINE_CYCLE\((\d+) responses\)")
 _CTRL_RE = re.compile(r"CTRL\((\d+) B tx, (\d+) B rx\)")
 _READY_RE = re.compile(r"RANK_READY_(\d+)$")
@@ -253,6 +261,12 @@ def analyze(events):
     rank_windows = {}      # pid -> [(enq, done, key)]
     rank_exec = {}         # pid -> [(b, e, key)]
     cycles, ctrl_tx, ctrl_rx = [], 0, 0
+    # per-role control-plane attribution (tree mode's leader hop shows
+    # up here; bytes are counted once gang-wide, at the rank whose
+    # sockets moved them — a leader's aggregate is never re-counted at
+    # the members it batches)
+    ctrl_by_role = {r: {"instants": 0, "tx_bytes": 0, "rx_bytes": 0}
+                    for r in CTRL_ROLES}
     ranks = set()
 
     for (pid, tid), evs in sorted(by_lane.items()):
@@ -268,8 +282,15 @@ def analyze(events):
                     continue
                 m = _CTRL_RE.match(ev.get("name", ""))
                 if m:
-                    ctrl_tx += int(m.group(1))
-                    ctrl_rx += int(m.group(2))
+                    tx, rx = int(m.group(1)), int(m.group(2))
+                    ctrl_tx += tx
+                    ctrl_rx += rx
+                    role = (ev.get("args") or {}).get("role")
+                    if role not in ctrl_by_role:
+                        role = "member"  # pre-role shards: workers
+                    ctrl_by_role[role]["instants"] += 1
+                    ctrl_by_role[role]["tx_bytes"] += tx
+                    ctrl_by_role[role]["rx_bytes"] += rx
             continue
         if not name.endswith(" (engine)"):
             continue  # eager dispatch lanes carry no phase data
@@ -364,6 +385,10 @@ def analyze(events):
                                if cycles else 0),
             "ctrl_tx_bytes": ctrl_tx,
             "ctrl_rx_bytes": ctrl_rx,
+            # per-role attribution: the tree's leader hop vs the root's
+            # fan-in/out vs member announces, each counted exactly once
+            "ctrl_by_role": {r: d for r, d in ctrl_by_role.items()
+                             if d["instants"]},
         },
     }
     metrics = {}
@@ -421,6 +446,9 @@ def print_report(rep, out=None):
         w(f"\ncycles: {cy['count']} with responses, mean "
           f"{cy['mean_responses']} responses/cycle; control plane "
           f"tx={cy['ctrl_tx_bytes']} B rx={cy['ctrl_rx_bytes']} B\n")
+        for role, d in cy.get("ctrl_by_role", {}).items():
+            w(f"  ctrl[{role}]: {d['instants']} working cycles, "
+              f"tx={d['tx_bytes']} B rx={d['rx_bytes']} B\n")
 
 
 # ---------------------------------------------------------------------------

@@ -358,6 +358,13 @@ _ENUM_RE = re.compile(r'enum\s+class\s+EventKind[^{]*\{(.*?)\};', re.S)
 _ENUM_ENTRY_RE = re.compile(r'^\s*(\w+)\s*=\s*(\d+)\s*,?', re.M)
 _FLAG_RE = re.compile(
     r'constexpr\s+uint8_t\s+(k\w*Flag\w*)\s*=\s*(0x[0-9A-Fa-f]+|\d+)\s*;')
+# control-plane role registry (hierarchical negotiation): engine.h
+# CtrlRole wire ids are stamped into CTRL_BYTES events and decoded by
+# the timeline drainer through CTRL_ROLES — both sides optional (the
+# fixture mini-trees predate the tree control plane), but when either
+# exists the other must match name-for-name.
+_CTRL_ROLE_RE = re.compile(r'enum\s+class\s+CtrlRole[^{]*\{(.*?)\};',
+                           re.S)
 
 
 def _timeline_kind_locals(text: str):
@@ -398,6 +405,36 @@ def check_events(root: Path):
     wire_h = _read(root, WIRE_H, vios, "events")
     if None in (events_h, native, timeline, wire_h):
         return vios
+
+    # control-plane role registry: engine.h CtrlRole ↔ timeline.py
+    # CTRL_ROLES (index == wire id). Optional on both-sides terms like
+    # the lane-slot block; a one-sided presence or a name/order drift
+    # would mislabel every CTRL instant's role attribution.
+    engine_h = (root / ENGINE_H).read_text() \
+        if (root / ENGINE_H).exists() else ""
+    role_m = _CTRL_ROLE_RE.search(engine_h)
+    py_roles = list(_py_literals(timeline, {"CTRL_ROLES"})
+                    .get("CTRL_ROLES", ()))
+    if role_m or py_roles:
+        c_roles = []
+        for name, val in _ENUM_ENTRY_RE.findall(
+                role_m.group(1) if role_m else ""):
+            if int(val) != len(c_roles):
+                vios.append(
+                    f"events: {ENGINE_H}: CtrlRole::{name} = {val} — "
+                    f"role wire ids must stay contiguous from 0 (they "
+                    f"index the CTRL_ROLES table)")
+            c_roles.append(name.lower())
+        if not role_m:
+            vios.append(
+                f"events: {TIMELINE_PY}: CTRL_ROLES is defined but "
+                f"{ENGINE_H} has no enum class CtrlRole — the role "
+                f"registry must live on both sides")
+        elif c_roles != py_roles:
+            vios.append(
+                f"events: {TIMELINE_PY}: CTRL_ROLES {py_roles} does not "
+                f"match {ENGINE_H} CtrlRole {c_roles} — CTRL instants "
+                f"would attribute control bytes to the wrong role")
 
     m = _ENUM_RE.search(events_h)
     if not m:
@@ -485,7 +522,16 @@ def check_events(root: Path):
             vios.append(
                 f"events: {culprit[0]}: re-defines {name} — frame-flag "
                 f"bits are registered exactly once, in {WIRE_H}")
-        if not any(re.search(rf'\b{name}\b', b) for b in bodies.values()):
+        # a use site is a reference outside the defining declaration —
+        # in any other csrc file, or in wire.h's own inline codecs
+        # (e.g. the bitmask announce encoder lives beside the registry).
+        # Comments are stripped so a doc mention can't masquerade as use.
+        wire_code = re.sub(r'//[^\n]*', '', wire_h)
+        wire_uses = len(re.findall(rf'\b{name}\b', wire_code)) \
+            - len(re.findall(rf'constexpr[^;\n]*\b{name}\s*=', wire_code))
+        if wire_uses <= 0 and \
+                not any(re.search(rf'\b{name}\b', b)
+                        for b in bodies.values()):
             vios.append(
                 f"events: {WIRE_H}: {name} is registered but never used "
                 f"by the engine — remove it or wire it up")

@@ -59,6 +59,12 @@ _ENQUEUED, _NEG_B, _NEG_E, _RANK_READY, _FUSED, _EXEC_B, _EXEC_E, \
     _DONE, _CYCLE, _STALL, _WAKEUP, _ABORT, _CTRL_BYTES, _WIRE_B, \
     _WIRE_E = range(15)
 
+# control-plane role names by wire id — must match csrc/engine.h
+# CtrlRole (the CTRL_BYTES event stamps the recording rank's role into
+# its op field; hvt_analyze attributes ctrl bytes per role through
+# this table). Cross-checked by tools/hvt_lint.py.
+CTRL_ROLES = ("root", "leader", "member")
+
 _ENGINE_DRAIN_SEC = 0.05
 
 
@@ -149,10 +155,13 @@ class _TimelineState:
             ev["name"] = name
         self._emit(ev)
 
-    def cycle_mark(self, name="CYCLE_START", ts=None):
-        self._emit({"ph": "i", "pid": self.pid, "tid": self._cycle_lane(),
-                    "name": name, "ts": _now_us() if ts is None else ts,
-                    "s": "p"})
+    def cycle_mark(self, name="CYCLE_START", ts=None, args=None):
+        ev = {"ph": "i", "pid": self.pid, "tid": self._cycle_lane(),
+              "name": name, "ts": _now_us() if ts is None else ts,
+              "s": "p"}
+        if args:
+            ev["args"] = args
+        self._emit(ev)
 
     # ---------------------------------------------------------- writer
     def _drain(self):
@@ -224,13 +233,20 @@ class _TimelineState:
                              f"{ev['arg2']} µs)", ts=ts)
                 continue
             if kind == _CTRL_BYTES:
-                # cycle-lane instant: control-star frame bytes this
+                # cycle-lane instant: control-plane frame bytes this
                 # cycle (arg = sent, arg2 = received) — hvt_analyze
-                # reads these for the per-cycle negotiation cost
+                # reads these for the per-cycle negotiation cost. The
+                # event's op field carries the rank's control role
+                # (engine.h CtrlRole / hvt_analyze CTRL_ROLES), so tree
+                # mode's leader hop is attributable separately.
                 if self.mark_cycles:
+                    role = (CTRL_ROLES[ev["op"]]
+                            if 0 <= ev["op"] < len(CTRL_ROLES)
+                            else "member")
                     self.cycle_mark(
                         name=f"CTRL({ev['arg']} B tx, "
-                             f"{ev['arg2']} B rx)", ts=ts)
+                             f"{ev['arg2']} B rx)",
+                        ts=ts, args={"role": role})
                 continue
             if kind == _ABORT:
                 # always recorded (mark_cycles or not): an abort is the

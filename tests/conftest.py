@@ -74,6 +74,7 @@ _SLOW_MODULES = {
     "test_tensorflow_real",      # real keras fits
     "test_torch_parallel",       # multi-process torch gangs
     "test_examples",             # every example as a subprocess
+    "test_ctrl_plane",           # 4/16-process tree/star control gangs
     "test_failure_containment",  # chaos gangs (SIGKILL/SIGSTOP + deadlines)
     "test_elastic_driver",       # launcher + failure/growth scenarios
     "test_runner",               # launcher subprocesses

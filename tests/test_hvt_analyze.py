@@ -117,6 +117,44 @@ def test_lane_percentiles_and_cycles():
     assert rep["cycles"]["mean_responses"] == 1.0
     assert rep["cycles"]["ctrl_tx_bytes"] == 300
     assert rep["cycles"]["ctrl_rx_bytes"] == 160
+    # role-less CTRL instants (pre-tree shards) attribute as "member"
+    assert rep["cycles"]["ctrl_by_role"] == {
+        "member": {"instants": 2, "tx_bytes": 300, "rx_bytes": 160}}
+
+
+def test_tree_mode_ctrl_role_breakdown():
+    """Tree-mode CTRL attribution: the leader hop shows up as its own
+    role row, counted once (at the leader), never re-counted at the
+    members whose announces it batched — and the totals stay
+    phase-complete (sum of roles == gang-wide ctrl bytes)."""
+    evs = [_meta(0, 9, "CYCLE"), _meta(1, 9, "CYCLE"),
+           _meta(2, 9, "CYCLE")]
+
+    def ctrl(pid, ts, tx, rx, role):
+        return {"ph": "i", "pid": pid, "tid": 9, "ts": ts,
+                "name": f"CTRL({tx} B tx, {rx} B rx)", "s": "p",
+                "args": {"role": role}}
+
+    # one negotiation cycle on a 3-rank tree: member -> leader -> root
+    evs += [ctrl(2, 100, 50, 200, "member"),   # announce up, resp down
+            ctrl(1, 120, 300, 250, "leader"),  # aggregate up + relay
+            ctrl(0, 140, 200, 300, "root")]    # fan-in/out at rank 0
+    # a second cycle where only root+leader exchange (member idle-ish)
+    evs += [ctrl(1, 300, 60, 40, "leader"),
+            ctrl(0, 320, 40, 60, "root")]
+    rep = A.analyze(evs)
+    br = rep["cycles"]["ctrl_by_role"]
+    assert br["root"] == {"instants": 2, "tx_bytes": 240,
+                          "rx_bytes": 360}
+    assert br["leader"] == {"instants": 2, "tx_bytes": 360,
+                            "rx_bytes": 290}
+    assert br["member"] == {"instants": 1, "tx_bytes": 50,
+                            "rx_bytes": 200}
+    # phase-complete: per-role rows sum to the gang totals
+    assert sum(d["tx_bytes"] for d in br.values()) == \
+        rep["cycles"]["ctrl_tx_bytes"] == 650
+    assert sum(d["rx_bytes"] for d in br.values()) == \
+        rep["cycles"]["ctrl_rx_bytes"] == 850
 
 
 def test_overlap_efficiency_serial_vs_inflight():

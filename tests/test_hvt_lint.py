@@ -312,6 +312,54 @@ def test_flag_defined_outside_registry_fails(tmp_path):
     assert any("re-defines kAbortFrameFlag" in v for v in vios), vios
 
 
+def _add_ctrl_roles(root: Path):
+    """Extend the clean fixture with the control-plane role registry
+    (PR-8 hierarchical negotiation): engine.h CtrlRole ↔ timeline.py
+    CTRL_ROLES."""
+    eh = root / hvt_lint.ENGINE_H
+    eh.write_text(eh.read_text() + """\
+enum class CtrlRole : int32_t {
+  ROOT = 0,
+  LEADER = 1,
+  MEMBER = 2,
+};
+""")
+    tl = root / hvt_lint.TIMELINE_PY
+    tl.write_text('CTRL_ROLES = ("root", "leader", "member")\n'
+                  + tl.read_text())
+
+
+def test_ctrl_role_fixture_is_clean(tmp_path):
+    make_clean_tree(tmp_path)
+    _add_ctrl_roles(tmp_path)
+    assert hvt_lint.check_events(tmp_path) == []
+
+
+def test_ctrl_role_registry_drift_fails(tmp_path):
+    """timeline.py CTRL_ROLES drifting from engine.h CtrlRole (here a
+    reordered pair) must fail — CTRL instants would attribute control
+    bytes to the wrong role."""
+    make_clean_tree(tmp_path)
+    _add_ctrl_roles(tmp_path)
+    tl = tmp_path / hvt_lint.TIMELINE_PY
+    tl.write_text(tl.read_text().replace(
+        '("root", "leader", "member")', '("root", "member", "leader")'))
+    vios = hvt_lint.check_events(tmp_path)
+    assert any("CTRL_ROLES" in v and "wrong role" in v
+               for v in vios), vios
+
+
+def test_ctrl_role_one_sided_registry_fails(tmp_path):
+    """CTRL_ROLES without the C++ enum (or vice versa) is a violation:
+    the registry is a cross-language contract, not a constant."""
+    make_clean_tree(tmp_path)
+    tl = tmp_path / hvt_lint.TIMELINE_PY
+    tl.write_text('CTRL_ROLES = ("root", "leader", "member")\n'
+                  + tl.read_text())
+    vios = hvt_lint.check_events(tmp_path)
+    assert any("no enum class CtrlRole" in v for v in vios), vios
+
+
 # ----------------------------------------------------------------- env
 
 def test_undocumented_env_read_fails(tmp_path):
@@ -361,4 +409,4 @@ def test_stats_slot_count_matches_python_bridge():
 
     text = (REPO_ROOT / hvt_lint.STATS_SLOTS_H).read_text()
     m = hvt_lint._SLOT_COUNT_RE.search(text)
-    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 102
+    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 104
