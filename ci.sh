@@ -28,6 +28,11 @@
 #   ./ci.sh --scale    # build + the simulated-gang control-plane
 #                      # harness at a small rank count (star vs tree
 #                      # over loopback) + the artifact schema check
+#   ./ci.sh --codec    # build + a quick wire-codec sweep over a faked
+#                      # 2-host gang (every registry codec, exact byte
+#                      # counters + relerr + EF convergence A/B) +
+#                      # schema --check of the fresh AND committed
+#                      # benchmarks/r09_codec_sweep.json artifacts
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
@@ -50,6 +55,7 @@ LOADTEST=0
 PERFGATE=0
 REBASELINE=0
 SCALE=0
+CODEC=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
@@ -57,6 +63,7 @@ SCALE=0
 [[ "${1:-}" == "--perfgate" ]] && PERFGATE=1
 [[ "${1:-}" == "--perfgate-rebaseline" ]] && REBASELINE=1
 [[ "${1:-}" == "--scale" ]] && SCALE=1
+[[ "${1:-}" == "--codec" ]] && CODEC=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -158,6 +165,26 @@ if [[ "$SCALE" == "1" ]]; then
     benchmarks/r08_controlplane_scaling.json
   rm -f "$ART"
   echo "CI OK (scale)"
+  exit 0
+fi
+
+if [[ "$CODEC" == "1" ]]; then
+  echo "=== [2/2] wire-codec sweep smoke (faked 2-host gang) ==="
+  # quick mode: one size per codec plane + a short convergence A/B.
+  # Byte counters are workload-determined (exact), so the reduction
+  # claims are stable even on a loaded box; only the p50 columns are
+  # noisy, and --check never gates on those. The committed artifact
+  # (benchmarks/r09_codec_sweep.json) comes from the full sweep — see
+  # BENCH_NOTES r10.
+  ART=$(mktemp /tmp/hvt_codecsweep_XXXX.json)
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    python benchmarks/engine_scaling.py --codec --quick --out "$ART"
+  python benchmarks/engine_scaling.py --check "$ART"
+  # the committed artifact must stay schema-valid too
+  python benchmarks/engine_scaling.py --check \
+    benchmarks/r09_codec_sweep.json
+  rm -f "$ART"
+  echo "CI OK (codec)"
   exit 0
 fi
 

@@ -472,10 +472,15 @@ def poll_engine_stats(registry=None):
     exec_n = reg.counter("hvt_engine_exec_total",
                          "data-plane responses executed by collective op",
                          ("op",))
+    # per-(op, codec) wire bytes off the engine's codec_tx_bytes block
+    # (codec "none" = raw transfers, so summing the codec label
+    # reproduces the per-op totals; replaced the old single-mode
+    # hvt_wire_compression_mode gauge)
     wire_tx = reg.counter(
         "hvt_wire_tx_bytes_total",
-        "bytes sent on the TCP data plane by collective op (compressed "
-        "transfers count their compressed size)", ("op",))
+        "bytes sent on the TCP data plane by collective op and wire "
+        "codec (compressed transfers count their compressed size)",
+        ("op", "codec"))
     wire_txc = reg.counter(
         "hvt_wire_tx_compressed_bytes_total",
         "TCP data-plane bytes sent in compressed form "
@@ -484,11 +489,30 @@ def poll_engine_stats(registry=None):
     cnt = stats.get("exec_count", {})
     tx = stats.get("wire_tx_bytes", {})
     txc = stats.get("wire_tx_comp_bytes", {})
+    codec_tx = stats.get("codec_tx_bytes", {})
     for op in native.STATS_OPS:
         exec_s.labels(op=op).set_total(ns.get(op, 0) / 1e9)
         exec_n.labels(op=op).set_total(cnt.get(op, 0))
-        wire_tx.labels(op=op).set_total(tx.get(op, 0))
         wire_txc.labels(op=op).set_total(txc.get(op, 0))
+        per_codec = {codec: codec_tx.get(codec, {}).get(op, 0)
+                     for codec in native.WIRE_CODECS}
+        if not any(per_codec.values()) and tx.get(op, 0):
+            # stale .so without the per-codec block: split its per-op
+            # total by the compressed counter instead of dropping it —
+            # the compressed portion belongs to the single stale-world
+            # mode (wire_compression() decodes it from the old scalar),
+            # only the remainder actually moved raw
+            t = tx.get(op, 0)
+            c = min(txc.get(op, 0), t)
+            per_codec["none"] = t - c
+            if c:
+                _, inter, _ = native.wire_compression()
+                stale_codec = (native.WIRE_CODECS[inter]
+                               if 0 <= inter < len(native.WIRE_CODECS)
+                               else "none")
+                per_codec[stale_codec] += c
+        for codec, val in per_codec.items():
+            wire_tx.labels(op=op, codec=codec).set_total(val)
 
     # engine-side latency histograms, bridged bucket-for-bucket: the
     # C++ bounds (1 µs · 4^i) are exactly DEFAULT_LATENCY_BUCKETS, so
@@ -506,9 +530,19 @@ def poll_engine_stats(registry=None):
                              d.get("sum_ns", 0) / 1e9,
                              d.get("count", 0))
 
-    reg.gauge("hvt_wire_compression_mode",
-              "configured wire codec (0 raw, 1 bf16); rank 0's value "
-              "governs the gang").set(native.wire_compression())
+    # error feedback: resident residual bytes + buffers the
+    # HVT_EF_MAX_BYTES budget evicted/refused (a rising drop counter
+    # means quantization is running uncompensated — raise the budget)
+    reg.gauge(
+        "hvt_ef_residual_bytes",
+        "resident error-feedback residual bytes (per-tensor fp32 "
+        "quantization-error memory, bounded by HVT_EF_MAX_BYTES)").set(
+            stats.get("ef_residual_bytes", 0))
+    reg.counter(
+        "hvt_ef_residuals_dropped_total",
+        "error-feedback residual buffers evicted or refused by the "
+        "HVT_EF_MAX_BYTES budget").labels().set_total(
+            stats.get("ef_residuals_dropped", 0))
 
     # per-set lane telemetry (serving gangs): lane "0" is the global
     # set, process-set lanes hash onto "1".."7" (collisions merge

@@ -1,0 +1,84 @@
+"""``horovod_tpu.compression`` — the quantized wire-codec subsystem.
+
+Python face of the engine's wire-codec registry (``csrc/codecs.{h,cc}``):
+block-scaled int8/fp8 and bf16 codecs for the eager data plane's TCP
+links, selected per link class (EQuARX-style — quantize the inter-host
+hops, keep intra-host traffic full precision) and compensated by
+per-tensor error-feedback residuals so repeated quantization does not
+bias training. Configure with ``HVT_WIRE_COMPRESSION`` (a codec name,
+an ``"<intra>,<inter>"`` pair, or ``auto``); see
+``docs/performance.md`` § "Wire compression: the codec subsystem".
+
+Distinct from the framework-level gradient compressors
+(``hvt.Compression`` / ``horovod_tpu.{tensorflow,torch}.compression``),
+which cast tensors *before* submission: wire codecs are transparent to
+callers and exist only on the wire.
+
+:data:`CODEC_IDS` is the codec name ↔ wire-id table, kept in lockstep
+with the C++ registry (``codecs.h`` ``HVT_WIRE_CODECS``) and the
+``docs/performance.md`` codec table by the ``codecs`` pass of
+``tools/hvt_lint.py``.
+"""
+
+from __future__ import annotations
+
+# codec name -> WireCodec wire id (csrc/codecs.h registry order)
+CODEC_IDS = {"none": 0, "bf16": 1, "int8": 2, "fp8": 3}
+
+# wire id -> name (index == id)
+CODEC_NAMES = tuple(sorted(CODEC_IDS, key=CODEC_IDS.get))
+
+
+def codec_id(name: str) -> int:
+    """WireCodec wire id for a codec name (``"raw"``/``""`` alias
+    ``"none"``). Raises ``ValueError`` for unknown names."""
+    if name in ("", "raw"):
+        return 0
+    if name not in CODEC_IDS:
+        raise ValueError(
+            f"unknown wire codec {name!r} (known: {CODEC_NAMES})")
+    return CODEC_IDS[name]
+
+
+def codec_name(wire_id: int) -> str:
+    """Codec name for a WireCodec wire id; unknown ids (a newer .so)
+    render as ``"codec<id>"`` rather than raising."""
+    if 0 <= wire_id < len(CODEC_NAMES):
+        return CODEC_NAMES[wire_id]
+    return f"codec{wire_id}"
+
+
+def wire_pair() -> tuple:
+    """The engine's current ``(intra, inter)`` codec-name pair — which
+    codec intra-host links and cross-host links move, e.g.
+    ``("none", "int8")`` under ``HVT_WIRE_COMPRESSION=none,int8``.
+    Under ``auto`` the pair reflects rank 0's latest tuner picks.
+    ``("none", "none")`` when the engine is absent."""
+    from horovod_tpu.engine import native
+
+    intra, inter, _auto = native.wire_compression()
+    return (codec_name(intra), codec_name(inter))
+
+
+def auto_active() -> bool:
+    """True while ``HVT_WIRE_COMPRESSION=auto`` drives codec selection
+    (rank 0 samples candidates per (size, link class) and locks the
+    byte-throughput argmax)."""
+    from horovod_tpu.engine import native
+
+    return native.wire_compression()[2]
+
+
+def tx_bytes(op: str = None) -> dict:
+    """TCP data-plane bytes sent per codec (exact counters from the
+    engine's stats block — the source of
+    ``hvt_wire_tx_bytes_total{op,codec}``). With ``op`` (an engine op
+    name, e.g. ``"allreduce"``): ``{codec: bytes}`` for that op;
+    without: ``{codec: {op: bytes}}``. ``{}`` when the engine is
+    absent."""
+    from horovod_tpu.engine import native
+
+    by_codec = (native.engine_stats() or {}).get("codec_tx_bytes", {})
+    if op is None:
+        return by_codec
+    return {codec: ops.get(op, 0) for codec, ops in by_codec.items()}

@@ -272,4 +272,67 @@ bool ParameterManager::Record(int64_t bytes) {
   return true;
 }
 
+// ---------------------------------------------------------------- CodecTuner
+
+namespace {
+constexpr WireCodec kCodecCands[CodecTuner::kNumCand] = {
+    WireCodec::RAW, WireCodec::BF16, WireCodec::INT8_BLOCK};
+}  // namespace
+
+void CodecTuner::Reset() {
+  for (auto& link : cells_)
+    for (auto& c : link) c = Cell{};
+}
+
+int CodecTuner::Bucket(int64_t bytes) {
+  int b = 0;
+  while ((int64_t{1} << (b + 11)) < bytes && b < kBuckets - 1) ++b;
+  return b;  // bucket 0 ≤ 2 KB, each next doubles
+}
+
+int CodecTuner::CandIndex(WireCodec c) {
+  for (int i = 0; i < kNumCand; ++i)
+    if (kCodecCands[i] == c) return i;
+  return -1;
+}
+
+WireCodec CodecTuner::Pick(int64_t bytes, int link) {
+  Cell& cell = cells_[link & 1][Bucket(bytes)];
+  if (cell.locked >= 0) return kCodecCands[cell.locked];
+  // rotate: the first candidate still short of its trial budget. Several
+  // responses may pick the same candidate before its observations land —
+  // the budget then merely overfills, which is harmless and keeps Pick
+  // deterministic without cross-call state.
+  for (int i = 0; i < kNumCand; ++i)
+    if (cell.n[i] < kTrials) return kCodecCands[i];
+  // all sampled: lock the byte-throughput argmax
+  int best = 0;
+  double best_tp = -1.0;
+  for (int i = 0; i < kNumCand; ++i) {
+    double tp = cell.ns[i] > 0
+                    ? static_cast<double>(cell.bytes[i]) / cell.ns[i]
+                    : 0.0;
+    if (tp > best_tp) {
+      best_tp = tp;
+      best = i;
+    }
+  }
+  cell.locked = best;
+  return kCodecCands[best];
+}
+
+void CodecTuner::Observe(int64_t bytes, int link, WireCodec codec,
+                         int64_t ns) {
+  int i = CandIndex(codec);
+  if (i < 0 || ns <= 0) return;
+  Cell& cell = cells_[link & 1][Bucket(bytes)];
+  cell.ns[i] += ns;
+  cell.bytes[i] += bytes;
+  cell.n[i] += 1;
+}
+
+bool CodecTuner::Locked(int64_t bytes, int link) const {
+  return cells_[link & 1][Bucket(bytes)].locked >= 0;
+}
+
 }  // namespace hvt

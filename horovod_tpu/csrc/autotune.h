@@ -29,6 +29,8 @@
 #include <string>
 #include <vector>
 
+#include "codecs.h"
+
 namespace hvt {
 
 // Small dense Gaussian process regressor, RBF kernel + observation noise.
@@ -136,6 +138,47 @@ class ParameterManager {
   int64_t bytes_acc_ = 0;
   double window_start_ = 0.0;
   std::atomic<int> samples_{0};
+};
+
+// Wire-codec auto-selection (HVT_WIRE_COMPRESSION=auto). Rank 0 tries
+// each candidate codec on live fp32-allreduce traffic, keyed by
+// (link class, log2-size bucket), and locks the byte-throughput argmax
+// per key once every candidate has enough samples — the sweep-sample
+// analog of the committed benchmarks/r09_codec_sweep.json curve,
+// measured in-situ instead of offline. Deterministic (fixed candidate
+// rotation, no RNG) and rank-0 only: workers just follow the codec ids
+// rank 0 stamps into each Response, so no cross-rank agreement problem
+// exists. Engine-thread only.
+class CodecTuner {
+ public:
+  // candidate rotation: raw baseline, bf16 (2x), int8 block (3.94x).
+  // fp8 is deliberately not auto-picked — same wire bytes as int8 with
+  // looser error bounds, so it can only tie (select it explicitly for
+  // heavy-tailed payloads; see docs/performance.md).
+  static constexpr int kNumCand = 3;
+  static constexpr int kTrials = 5;   // samples per candidate per key
+  static constexpr int kBuckets = 18; // log2 bytes, 1 KB .. 128 MB+
+
+  void Reset();
+  // Codec to stamp for an eligible response of `bytes` payload on link
+  // class `link` (0 intra / 1 inter): the still-exploring candidate, or
+  // the locked winner.
+  WireCodec Pick(int64_t bytes, int link);
+  // Measured execution of a response previously stamped via Pick.
+  void Observe(int64_t bytes, int link, WireCodec codec, int64_t ns);
+  // True once Pick(bytes, link) would return a locked winner.
+  bool Locked(int64_t bytes, int link) const;
+
+ private:
+  struct Cell {
+    int64_t ns[kNumCand] = {};
+    int64_t bytes[kNumCand] = {};
+    int n[kNumCand] = {};
+    int locked = -1;  // candidate index once decided
+  };
+  static int Bucket(int64_t bytes);
+  static int CandIndex(WireCodec c);
+  Cell cells_[2][kBuckets];
 };
 
 }  // namespace hvt

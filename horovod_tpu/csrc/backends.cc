@@ -77,7 +77,7 @@ void CollectiveBackend::AlltoallvMatrix(
 void CollectiveBackend::AllreduceGroup(void*, int64_t, DataType,
                                        ReduceKind,
                                        const std::vector<int>&, double,
-                                       WireCodec) {
+                                       WirePair) {
   throw std::runtime_error(std::string("hvt backend '") + Name() +
                            "' does not implement subset allreduce");
 }
@@ -113,15 +113,16 @@ void CollectiveBackend::ReduceScatter(void* buf, int64_t count,
   (void)my_pos;
   (void)m;
   if (full_world)
-    Allreduce(buf, count, dtype, red, 1.0, WireCodec::RAW);
+    Allreduce(buf, count, dtype, red, 1.0, WirePair{});
   else
-    AllreduceGroup(buf, count, dtype, red, group, 1.0, WireCodec::RAW);
+    AllreduceGroup(buf, count, dtype, red, group, 1.0, WirePair{});
 }
 
 void RingBackend::Allreduce(void* buf, int64_t count, DataType dtype,
                             ReduceKind red, double postscale,
-                            WireCodec wire) {
-  dp_->Allreduce(buf, count, dtype, red, postscale, wire);
+                            WirePair wire) {
+  dp_->Allreduce(buf, count, dtype, red, postscale,
+                 ResolveLinkCodec(topo_, wire, {}));
 }
 
 void RingBackend::Allgatherv(const void* in, int64_t my_rows,
@@ -144,8 +145,9 @@ void RingBackend::Alltoallv(const void* in,
 void RingBackend::AllreduceGroup(void* buf, int64_t count, DataType dtype,
                                  ReduceKind red,
                                  const std::vector<int>& group,
-                                 double postscale, WireCodec wire) {
-  dp_->AllreduceGroup(buf, count, dtype, red, group, postscale, wire);
+                                 double postscale, WirePair wire) {
+  dp_->AllreduceGroup(buf, count, dtype, red, group, postscale,
+                      ResolveLinkCodec(topo_, wire, group));
 }
 
 void RingBackend::AllgathervGroup(const void* in, int64_t my_rows,
@@ -344,7 +346,7 @@ bool ShmLocalBackend::Enabled(const Response& resp,
 
 void ShmLocalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
                                 ReduceKind red, double postscale,
-                                WireCodec wire) {
+                                WirePair wire) {
   (void)wire;  // no wire bytes to compress on a shm plane
   if (!used_logged_) {
     used_logged_ = true;
@@ -426,7 +428,7 @@ void ShmLocalBackend::Broadcast(void* buf, int64_t bytes, int root) {
 void ShmLocalBackend::AllreduceGroup(void* buf, int64_t count,
                                      DataType dtype, ReduceKind red,
                                      const std::vector<int>& group,
-                                     double postscale, WireCodec wire) {
+                                     double postscale, WirePair wire) {
   (void)wire;
   LogSubsetOnce(group);
   const size_t el = DataTypeSize(dtype);
@@ -548,7 +550,7 @@ bool HierarchicalBackend::Enabled(const Response& resp,
 
 void HierarchicalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
                                     ReduceKind red, double postscale,
-                                    WireCodec wire) {
+                                    WirePair wire) {
   // reference NCCLHierarchicalAllreduce decomposition
   // (nccl_operations.cc:188-350): local reduce-scatter, parallel
   // cross-host allreduce (one slice per local rank), local allgather.
@@ -557,20 +559,30 @@ void HierarchicalBackend::Allreduce(void* buf, int64_t count, DataType dtype,
   auto* bytes = static_cast<uint8_t*>(buf);
   std::vector<int64_t> seg_off(l + 1);
   for (int i = 0; i <= l; ++i) seg_off[i] = count * i / l;
-  dp_->RingReduceScatter(bytes, seg_off, el, dtype, red, topo_.local_group);
+  dp_->RingReduceScatter(bytes, seg_off, el, dtype, red, topo_.local_group,
+                         wire.intra);
   // I now own fully-reduced (locally) segment (my_local+1) % l; my cross
   // peers (same local index on every host) own the SAME segment of their
   // hosts' local sums — allreduce it across hosts, all slices in parallel.
-  // postscale + wire compression ride the cross-host phase: the slice
-  // comes back scaled (and, under BF16, each rank's slice is already
-  // bf16-truncated identically on every host), so the local allgather
-  // distributes finished data. Only the cross phase crosses the network,
-  // which is also where compressed wire bytes pay off.
+  // postscale + the INTER codec ride the cross-host phase: the slice
+  // comes back scaled (and each rank's slice already codec-truncated
+  // identically on every host), so the local allgather distributes
+  // finished data. Only the cross phase crosses the network, which is
+  // also where compressed wire bytes pay off — the intra codec
+  // (default: none, full precision) covers only the in-host phases.
   const int own = (topo_.my_local + 1) % l;
   int64_t own_n = seg_off[own + 1] - seg_off[own];
   dp_->AllreduceGroup(bytes + seg_off[own] * el, own_n, dtype, red,
-                      topo_.cross_group, postscale, wire);
-  dp_->RingAllgatherSegs(bytes, seg_off, el, topo_.local_group);
+                      topo_.cross_group, postscale, wire.inter);
+  if (dtype == DataType::FLOAT32)
+    if (const Codec* c = CodecFor(wire.intra))
+      // same owner-roundtrip invariant the flat ring maintains: the
+      // finished slice must read exactly as local peers will decode it
+      // off the compressed allgather, or ranks would diverge bitwise
+      c->Roundtrip(reinterpret_cast<float*>(bytes + seg_off[own] * el),
+                   own_n);
+  dp_->RingAllgatherSegs(bytes, seg_off, el, topo_.local_group,
+                         wire.intra);
 }
 
 }  // namespace hvt

@@ -18,6 +18,7 @@
 #include <string>
 #include <vector>
 
+#include "codecs.h"
 #include "common.h"
 #include "ring_ops.h"
 #include "wire.h"
@@ -38,6 +39,30 @@ struct Topology {
   static Topology Build(int rank, const std::vector<std::string>& hosts);
 };
 
+// EQuARX-style link classification: does a collective over `group`
+// (ascending global ranks; empty = full world) cross a host boundary?
+// Deterministic from the rendezvous topology, hence identical on every
+// rank — the property that lets each backend resolve a {intra, inter}
+// codec pair locally without another negotiation round.
+inline bool GroupSpansHosts(const Topology& t,
+                            const std::vector<int>& group) {
+  if (group.empty()) return t.n_hosts > 1;
+  if (t.host_of_rank.empty()) return false;
+  const std::string& h0 = t.host_of_rank[static_cast<size_t>(group[0])];
+  for (int r : group)
+    if (t.host_of_rank[static_cast<size_t>(r)] != h0) return true;
+  return false;
+}
+
+// The codec a ring over `group` moves: inter-host rings take the
+// `inter` codec, single-host rings the `intra` codec. (A mixed ring —
+// some hops local, some not — counts as inter: its wire stream is
+// forwarded hop to hop, so one codec must cover the whole rotation.)
+inline WireCodec ResolveLinkCodec(const Topology& t, const WirePair& w,
+                                  const std::vector<int>& group) {
+  return GroupSpansHosts(t, group) ? w.inter : w.intra;
+}
+
 class CollectiveBackend {
  public:
   virtual ~CollectiveBackend() = default;
@@ -51,12 +76,14 @@ class CollectiveBackend {
   // backends fold it into their last data pass (ring: each rank scales
   // just its owned segment before the allgather; shm: each rank scales
   // its chunk of the shared result) instead of a separate full sweep.
-  // wire: negotiated payload codec (WireCodec wire id from the
-  // Response); only the TCP ring moves wire bytes, so other backends
-  // may ignore it.
+  // wire: negotiated per-link-class codec pair from the Response
+  // ({intra, inter} WireCodec ids); each backend maps the pair onto its
+  // phases (ring: by whether the ring spans hosts; hierarchical: intra
+  // on the local phases, inter on the cross phase; shm: no wire at
+  // all).
   virtual void Allreduce(void* buf, int64_t count, DataType dtype,
                          ReduceKind red, double postscale,
-                         WireCodec wire) = 0;
+                         WirePair wire) = 0;
   virtual void Allgatherv(const void* in, int64_t my_rows,
                           const std::vector<int64_t>& rows,
                           int64_t row_bytes, void* out);
@@ -78,7 +105,7 @@ class CollectiveBackend {
   virtual void AllreduceGroup(void* buf, int64_t count, DataType dtype,
                               ReduceKind red,
                               const std::vector<int>& group,
-                              double postscale, WireCodec wire);
+                              double postscale, WirePair wire);
   virtual void AllgathervGroup(const void* in, int64_t my_rows,
                                const std::vector<int64_t>& rows,
                                int64_t row_bytes, void* out,
@@ -110,11 +137,14 @@ class CollectiveBackend {
 // Flat TCP ring over the full mesh — always enabled (the fallback).
 class RingBackend : public CollectiveBackend {
  public:
-  explicit RingBackend(DataPlane* dp) : dp_(dp) {}
+  // topo: used only to classify link classes for the wire-codec pair
+  // (single-host ring → intra codec, host-spanning ring → inter).
+  RingBackend(DataPlane* dp, Topology topo)
+      : dp_(dp), topo_(std::move(topo)) {}
   const char* Name() const override { return "ring"; }
   bool Enabled(const Response&, int64_t) const override { return true; }
   void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
-                 double postscale, WireCodec wire) override;
+                 double postscale, WirePair wire) override;
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,
                   void* out) override;
@@ -124,7 +154,7 @@ class RingBackend : public CollectiveBackend {
                  const std::vector<int64_t>& recv_rows) override;
   void AllreduceGroup(void* buf, int64_t count, DataType dtype,
                       ReduceKind red, const std::vector<int>& group,
-                      double postscale, WireCodec wire) override;
+                      double postscale, WirePair wire) override;
   void AllgathervGroup(const void* in, int64_t my_rows,
                        const std::vector<int64_t>& rows, int64_t row_bytes,
                        void* out, const std::vector<int>& group) override;
@@ -137,6 +167,7 @@ class RingBackend : public CollectiveBackend {
 
  private:
   DataPlane* dp_;
+  Topology topo_;
 };
 
 // Same-host POSIX-shared-memory data plane for single-host jobs: every
@@ -164,7 +195,7 @@ class ShmLocalBackend : public CollectiveBackend {
   const char* Name() const override { return "shm"; }
   bool Enabled(const Response& resp, int64_t total_elems) const override;
   void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
-                 double postscale, WireCodec wire) override;
+                 double postscale, WirePair wire) override;
   void Broadcast(void* buf, int64_t bytes, int root) override;
   void Allgatherv(const void* in, int64_t my_rows,
                   const std::vector<int64_t>& rows, int64_t row_bytes,
@@ -174,7 +205,7 @@ class ShmLocalBackend : public CollectiveBackend {
                        int64_t row_bytes, void* out, int my_pos) override;
   void AllreduceGroup(void* buf, int64_t count, DataType dtype,
                       ReduceKind red, const std::vector<int>& group,
-                      double postscale, WireCodec wire) override;
+                      double postscale, WirePair wire) override;
   void AllgathervGroup(const void* in, int64_t my_rows,
                        const std::vector<int64_t>& rows, int64_t row_bytes,
                        void* out, const std::vector<int>& group) override;
@@ -225,6 +256,11 @@ class ShmLocalBackend : public CollectiveBackend {
 // Local reduce-scatter → cross-host allreduce → local allgather.
 // Enabled for non-Adasum allreduces on a homogeneous multi-host topology
 // with >1 rank per host; HVT_HIERARCHICAL_ALLREDUCE=0 disables.
+// The {intra, inter} codec pair maps 1:1 onto its phases: the local
+// (intra-host) reduce-scatter/allgather take wire.intra — full
+// precision under the recommended `none,<codec>` pair — while the
+// cross-host phase takes wire.inter, which is exactly where DCN bytes
+// are paid (EQuARX's topology-aware quantization).
 class HierarchicalBackend : public CollectiveBackend {
  public:
   HierarchicalBackend(DataPlane* dp, Topology topo, bool enabled)
@@ -232,7 +268,7 @@ class HierarchicalBackend : public CollectiveBackend {
   const char* Name() const override { return "hierarchical"; }
   bool Enabled(const Response& resp, int64_t total_elems) const override;
   void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
-                 double postscale, WireCodec wire) override;
+                 double postscale, WirePair wire) override;
 
  private:
   DataPlane* dp_;

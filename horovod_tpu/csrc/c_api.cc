@@ -215,6 +215,12 @@ int hvt_engine_flags() {
 //          host to itself)
 //   103    ctrl_bypass_cycles (cycles served by the steady-state
 //          positions-form bypass instead of full response payloads)
+//   104..131 codec_tx_bytes[codec][op]: TCP data-plane bytes sent per
+//          (wire codec, OpType), codec-major (codecs.h registry order:
+//          none/bf16/int8/fp8) — hvt_wire_tx_bytes_total{op,codec}
+//   132    ef_residual_bytes (resident error-feedback residual bytes)
+//   133    ef_residuals_dropped (residual buffers HVT_EF_MAX_BYTES
+//          evicted or refused)
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
@@ -223,11 +229,15 @@ constexpr int kStatsScalars = 8;  // the slot-0..7 scalar block
 // STATS_TAIL_SCALARS — the append-only escape hatch for new plain
 // counters)
 constexpr int kStatsTailScalars = 4;
+// error-feedback scalars appended after the per-codec byte block
+constexpr int kStatsEfScalars = 2;
 constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
 constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
                                 2 * kStatsHist + hvt::kAbortCauses +
                                 1 + 3 * hvt::kLaneSlots +
-                                kStatsTailScalars;
+                                kStatsTailScalars +
+                                hvt::kWireCodecCount * hvt::kStatsOps +
+                                kStatsEfScalars;
 static_assert(kStatsSlotCount == HVT_STATS_SLOT_COUNT,
               "hvt_engine_stats layout drifted from stats_slots.h — the "
               "slot ABI is append-only: add new slots to the end of the "
@@ -275,13 +285,43 @@ int hvt_engine_stats(long long* out, int max_n) {
   v[base++] = s.ctrl_rx_bytes.load(std::memory_order_relaxed);
   v[base++] = s.ctrl_peers.load(std::memory_order_relaxed);
   v[base++] = s.ctrl_bypass_cycles.load(std::memory_order_relaxed);
+  for (int i = 0; i < hvt::kWireCodecCount * hvt::kStatsOps; ++i)
+    v[base++] = s.codec_tx_bytes[i].load(std::memory_order_relaxed);
+  v[base++] = s.ef_residual_bytes.load(std::memory_order_relaxed);
+  v[base++] = s.ef_residuals_dropped.load(std::memory_order_relaxed);
   for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
   return kStatsSlotCount;
 }
 
-// Negotiated wire codec as configured on this rank (WireCodec wire id;
-// rank 0's value governs the gang via per-response stamps).
+// Current wire-codec pair of this rank's engine, packed as
+// intra | inter << 8 (WireCodec wire ids, codecs.h registry), with bit
+// 16 set while HVT_WIRE_COMPRESSION=auto is active. Rank 0's values
+// govern the gang via per-response {intra, inter} stamps; under auto
+// the packed ids reflect rank 0's latest tuner picks.
 int hvt_wire_compression() { return Engine::Get().wire_mode(); }
+
+// Roundtrip `count` fp32 elements in place through wire codec id
+// `codec` (decode(encode(x)) — exactly what segment owners and the
+// error-feedback pass apply). Unit-test surface for the block-scaled
+// codecs: chunk/block-boundary numerics and EF math without spinning
+// up a gang. Returns 0; -1 for raw/unknown ids (nothing to do).
+int hvt_codec_roundtrip(void* data, long long count, int codec) {
+  const hvt::Codec* c =
+      hvt::CodecFor(static_cast<hvt::WireCodec>(codec));
+  if (c == nullptr) return -1;
+  c->Roundtrip(static_cast<float*>(data), static_cast<int64_t>(count));
+  return 0;
+}
+
+// Wire bytes codec id `codec` spends on `count` fp32 elements (raw:
+// 4 * count) — pins the exact-byte-counter math the codec sweep and
+// the data-plane tests assert against.
+long long hvt_codec_wire_bytes(long long count, int codec) {
+  const hvt::Codec* c =
+      hvt::CodecFor(static_cast<hvt::WireCodec>(codec));
+  if (c == nullptr) return 4 * count;
+  return static_cast<long long>(c->CompressedSize(count));
+}
 
 // Sticky broken state (coordinated abort landed): returns 1 and fills
 // dst with "<cause>: <reason>" (NUL-terminated, truncated to max_n)

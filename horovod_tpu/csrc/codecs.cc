@@ -1,0 +1,220 @@
+#include "codecs.h"
+
+#include <algorithm>
+#include <cfloat>
+#include <cmath>
+#include <string>
+
+namespace hvt {
+
+int WireCodecFromName(const char* name) {
+  if (name == nullptr) return static_cast<int>(WireCodec::RAW);
+  std::string s(name);
+  if (s.empty() || s == "raw") return static_cast<int>(WireCodec::RAW);
+#define HVT_CODEC_FROM_NAME(id, nm) \
+  if (s == nm) return id;
+  HVT_WIRE_CODECS(HVT_CODEC_FROM_NAME)
+#undef HVT_CODEC_FROM_NAME
+  return -1;
+}
+
+// ---- bf16 (migrated from ring_ops.cc, PR 3) --------------------------------
+
+namespace {
+
+class Bf16Codec final : public Codec {
+ public:
+  WireCodec id() const override { return WireCodec::BF16; }
+  size_t CompressedSize(int64_t n) const override {
+    return static_cast<size_t>(n) * 2;
+  }
+  size_t WireBlockBytes() const override { return 2; }
+  int64_t BlockElems() const override { return 1; }
+  void Compress(uint8_t* dst, const float* src, int64_t n) const override {
+    auto* __restrict d = reinterpret_cast<uint16_t*>(dst);
+    const float* __restrict s = src;
+    for (int64_t i = 0; i < n; ++i) d[i] = FloatToBf16(s[i]);
+  }
+  void Decompress(float* dst, const uint8_t* src,
+                  int64_t n) const override {
+    float* __restrict d = dst;
+    const auto* __restrict s = reinterpret_cast<const uint16_t*>(src);
+    for (int64_t i = 0; i < n; ++i) d[i] = Bf16ToFloat(s[i]);
+  }
+  void Roundtrip(float* dst, int64_t n) const override {
+    float* __restrict d = dst;
+    for (int64_t i = 0; i < n; ++i) d[i] = Bf16ToFloat(FloatToBf16(d[i]));
+  }
+};
+
+// ---- block-scaled int8 -----------------------------------------------------
+//
+// Wire block = fp32 absmax-derived scale, then kCodecBlockElems int8
+// codes: value = code * scale, code = rint(value / scale) in
+// [-127, 127] (symmetric; -128 unused so the grid is sign-balanced and
+// roundtrip is idempotent). A zero/absent block (all zeros) encodes
+// scale 0. Non-finite inputs saturate to ±127 codes via the fp32
+// clamp. 256 elems cost 4 + 256 wire bytes → 1024/260 ≈ 3.94x on the
+// fp32 payload.
+
+inline float BlockAbsMax(const float* s, int64_t m) {
+  float amax = 0.f;
+  for (int64_t i = 0; i < m; ++i) amax = std::max(amax, std::fabs(s[i]));
+  return amax;
+}
+
+// Shared block framing for the scaled codecs (int8/fp8): the wire
+// layout, tail-block rule, scale derivation, and the stack-buffer
+// Roundtrip are written ONCE here; an Impl supplies only its code
+// ceiling (the scale divisor) and the scalar encode/decode of
+// value/scale. CRTP, not virtual hooks — the per-element calls sit in
+// the hot loops.
+template <class Impl>
+class BlockCodec : public Codec {
+ public:
+  size_t CompressedSize(int64_t n) const override {
+    int64_t full = n / kCodecBlockElems;
+    int64_t rem = n % kCodecBlockElems;
+    return static_cast<size_t>(full) * (4 + kCodecBlockElems) +
+           (rem ? static_cast<size_t>(4 + rem) : 0);
+  }
+  size_t WireBlockBytes() const override { return 4 + kCodecBlockElems; }
+  int64_t BlockElems() const override { return kCodecBlockElems; }
+  void Compress(uint8_t* dst, const float* src, int64_t n) const override {
+    for (int64_t base = 0; base < n; base += kCodecBlockElems) {
+      const int64_t m = std::min(kCodecBlockElems, n - base);
+      const float* __restrict s = src + base;
+      float amax = BlockAbsMax(s, m);
+      // an Inf element would make the scale Inf and every finite
+      // neighbor decode as 0·inf = NaN; clamping the absmax keeps the
+      // scale finite so non-finite inputs saturate to the code ceiling
+      // (≈FLT_MAX/2 after decode) while their 255 block-mates stay ~0.
+      // The /2 headroom keeps ceiling·(amax/ceiling) clear of overflow
+      // when the scale division rounds up
+      if (!std::isfinite(amax)) amax = FLT_MAX * 0.5f;
+      float scale = amax > 0.f ? amax / Impl::kMaxCode : 0.f;
+      memcpy(dst, &scale, 4);
+      uint8_t* __restrict q = dst + 4;
+      if (scale > 0.f) {
+        const float inv = 1.f / scale;
+        for (int64_t i = 0; i < m; ++i) q[i] = Impl::Encode(s[i] * inv);
+      } else {
+        memset(q, 0, static_cast<size_t>(m));
+      }
+      dst += 4 + m;
+    }
+  }
+  void Decompress(float* dst, const uint8_t* src,
+                  int64_t n) const override {
+    for (int64_t base = 0; base < n; base += kCodecBlockElems) {
+      const int64_t m = std::min(kCodecBlockElems, n - base);
+      float scale;
+      memcpy(&scale, src, 4);
+      const uint8_t* __restrict q = src + 4;
+      float* __restrict d = dst + base;
+      for (int64_t i = 0; i < m; ++i) d[i] = Impl::Decode(q[i]) * scale;
+      src += 4 + m;
+    }
+  }
+  void Roundtrip(float* dst, int64_t n) const override {
+    // compress+decompress through a stack block so the owner's values
+    // are BY CONSTRUCTION what peers decode — no separately-maintained
+    // quantization math to drift
+    uint8_t wire[4 + kCodecBlockElems];
+    for (int64_t base = 0; base < n; base += kCodecBlockElems) {
+      const int64_t m = std::min(kCodecBlockElems, n - base);
+      Compress(wire, dst + base, m);
+      Decompress(dst + base, wire, m);
+    }
+  }
+};
+
+class Int8BlockCodec final : public BlockCodec<Int8BlockCodec> {
+ public:
+  static constexpr float kMaxCode = 127.f;
+  WireCodec id() const override { return WireCodec::INT8_BLOCK; }
+  static uint8_t Encode(float v) {
+    v = std::max(-127.f, std::min(127.f, v));  // NaN lands on the rail
+    return static_cast<uint8_t>(
+        static_cast<int8_t>(std::lrintf(v)));
+  }
+  static float Decode(uint8_t b) {
+    return static_cast<float>(static_cast<int8_t>(b));
+  }
+};
+
+// ---- block-scaled fp8 (e4m3) -----------------------------------------------
+//
+// Same block layout as int8; codes are OCP e4m3 bytes (1-4-3, bias 7,
+// max 448, no inf, 0x7f = NaN) of value / scale with
+// scale = absmax / 448. Wider dynamic range inside a block than int8
+// (~2^-9 .. 448 relative to the scale) at 3 mantissa bits — the trade
+// gradient tensors with heavy-tailed blocks prefer.
+
+inline float E4m3ToFloat(uint8_t b) {
+  const float sign = (b & 0x80) ? -1.f : 1.f;
+  const int exp = (b >> 3) & 0xF;
+  const int man = b & 7;
+  if (exp == 0xF && man == 7)  // NaN code; never emitted by Compress
+    return sign * 448.f;
+  float val;
+  if (exp == 0)
+    val = std::ldexp(static_cast<float>(man), -9);  // subnormal: m/8 · 2^-6
+  else
+    val = std::ldexp(1.0f + static_cast<float>(man) / 8.0f, exp - 7);
+  return sign * val;
+}
+
+inline uint8_t FloatToE4m3(float v) {
+  uint32_t bits;
+  memcpy(&bits, &v, 4);
+  const uint8_t sign = static_cast<uint8_t>((bits >> 24) & 0x80);
+  float a = std::fabs(v);
+  if (std::isnan(a)) return static_cast<uint8_t>(sign | 0x7E);  // sat, no NaN
+  if (a >= 448.f) return static_cast<uint8_t>(sign | 0x7E);     // 448
+  if (a < std::ldexp(1.0f, -10)) return sign;  // below half min subnormal
+  int e;
+  std::frexp(a, &e);
+  e -= 1;  // a = g · 2^e, g ∈ [1, 2)
+  if (e < -6) e = -6;  // subnormal range encodes with exp field 0
+  const float step = std::ldexp(1.0f, e - 3);
+  float q = std::nearbyint(a / step);  // round-to-nearest-even mantissa
+  if (q >= 16.f) {
+    q *= 0.5f;
+    e += 1;
+  }
+  if (e > 8 || (e == 8 && q > 14.f))
+    return static_cast<uint8_t>(sign | 0x7E);  // rounded past 448 → sat
+  const int iq = static_cast<int>(q);
+  if (iq < 8)  // subnormal (e == -6): exp field 0, mantissa iq
+    return static_cast<uint8_t>(sign | iq);
+  return static_cast<uint8_t>(sign | (((e + 7) << 3) | (iq - 8)));
+}
+
+class Fp8BlockCodec final : public BlockCodec<Fp8BlockCodec> {
+ public:
+  static constexpr float kMaxCode = 448.f;
+  WireCodec id() const override { return WireCodec::FP8_BLOCK; }
+  static uint8_t Encode(float v) { return FloatToE4m3(v); }
+  static float Decode(uint8_t b) { return E4m3ToFloat(b); }
+};
+
+}  // namespace
+
+const Codec* CodecFor(WireCodec id) {
+  static const Bf16Codec bf16;
+  static const Int8BlockCodec int8;
+  static const Fp8BlockCodec fp8;
+  switch (id) {
+    case WireCodec::BF16:
+      return &bf16;
+    case WireCodec::INT8_BLOCK:
+      return &int8;
+    case WireCodec::FP8_BLOCK:
+      return &fp8;
+    default:
+      return nullptr;  // RAW and unknown ids move raw bytes
+  }
+}
+
+}  // namespace hvt

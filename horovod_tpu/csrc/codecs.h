@@ -1,0 +1,137 @@
+// Quantized wire-codec registry — the codec family behind
+// HVT_WIRE_COMPRESSION (EQuARX-style block-scaled quantized allreduce,
+// arXiv:2506.17615). PR 3 proved the plumbing with ad-hoc bf16 helpers
+// inside ring_ops.cc; this header is their grown-up home: every codec
+// the data plane can put on a TCP link lives behind ONE narrow
+// interface (CompressedSize / Compress / Decompress / Roundtrip), and
+// the codec ids below are the single registry the C++ engine, the
+// Python name table (horovod_tpu/compression), and the
+// docs/performance.md codec table must agree on — machine-checked by
+// tools/hvt_lint.py's `codecs` pass.
+//
+// On-wire block format (int8/fp8): payloads are cut into blocks of
+// kCodecBlockElems fp32 elements; each block's fp32 scale rides
+// IN-BAND ahead of its quantized payload, so every WireBlockBytes()
+// bytes of the stream decode independently — which is what lets the
+// pipelined chunked ring (HVT_RING_CHUNK_BYTES) decode and reduce any
+// block-aligned prefix of a transfer while later chunks are still in
+// flight. bf16 is the degenerate case (1-elem "blocks", no scale).
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace hvt {
+
+// --------------------------------------------------------------------------
+// codec id ↔ canonical name registry. THE single source of truth for
+// wire-codec ids: the WireCodec enum, WireCodecName(), the Python name
+// table (horovod_tpu/compression CODEC_IDS + engine/native.py
+// WIRE_CODECS), and the docs/performance.md codec table are all kept
+// in lockstep by the hvt_lint `codecs` pass. Ids are wire values
+// (stamped into Responses and the stats-slot ABI): append-only, never
+// renumber.
+// --------------------------------------------------------------------------
+#define HVT_WIRE_CODECS(X) \
+  X(0, "none")             \
+  X(1, "bf16")             \
+  X(2, "int8")             \
+  X(3, "fp8")
+
+enum class WireCodec : uint8_t {
+  RAW = 0,         // bit-exact raw bytes (default)
+  BF16 = 1,        // round-to-nearest-even bf16 truncation, 2x
+  INT8_BLOCK = 2,  // per-block absmax int8, ~3.94x on fp32
+  FP8_BLOCK = 3,   // per-block absmax fp8 e4m3, ~3.94x on fp32
+};
+constexpr int kWireCodecCount = 4;
+
+inline const char* WireCodecName(WireCodec c) {
+  switch (static_cast<int>(c)) {
+#define HVT_CODEC_NAME_CASE(id, name) \
+  case id:                            \
+    return name;
+    HVT_WIRE_CODECS(HVT_CODEC_NAME_CASE)
+#undef HVT_CODEC_NAME_CASE
+  }
+  return "?";
+}
+
+// Per-link-class codec pair, stamped by rank 0 into every eligible
+// Response (EQuARX: quantize only the inter-host hops — the intra-host
+// phase of the hierarchical backend, and any ring whose members share
+// one host, take `intra`; anything that crosses hosts takes `inter`).
+struct WirePair {
+  WireCodec intra = WireCodec::RAW;
+  WireCodec inter = WireCodec::RAW;
+  bool any() const {
+    return intra != WireCodec::RAW || inter != WireCodec::RAW;
+  }
+};
+
+// Block geometry shared by the scaled codecs: 256 fp32 elements per
+// block (~1 KB raw) keeps the in-band scale overhead at 4/256 bytes
+// per element while the absmax stays local enough that one outlier
+// cannot wash out a whole tensor's resolution.
+constexpr int64_t kCodecBlockElems = 256;
+
+// fp32 <-> bf16 scalar conversions (round-to-nearest-even truncation);
+// shared with ring_ops.cc's half/bf16 reduce widening.
+inline float Bf16ToFloat(uint16_t h) {
+  uint32_t f = static_cast<uint32_t>(h) << 16;
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+inline uint16_t FloatToBf16(float v) {
+  uint32_t f;
+  memcpy(&f, &v, 4);
+  uint32_t rounding = 0x7fffu + ((f >> 16) & 1);
+  return static_cast<uint16_t>((f + rounding) >> 16);
+}
+
+// The narrow codec interface. Codecs operate on fp32 payloads only —
+// the engine's stamp rule already restricts compression to fp32
+// non-Adasum allreduces, every other dtype moves raw.
+class Codec {
+ public:
+  virtual ~Codec() = default;
+  virtual WireCodec id() const = 0;
+  // Bytes on the wire for n fp32 elements (the transfer size every
+  // participant must agree on).
+  virtual size_t CompressedSize(int64_t n) const = 0;
+  // Self-contained stream granularity: every WireBlockBytes() bytes
+  // decode BlockElems() elements independently of the rest of the
+  // stream (the scale rides in-band ahead of each block's payload).
+  // Ring chunks are aligned to this so chunked decodes stay valid.
+  virtual size_t WireBlockBytes() const = 0;
+  virtual int64_t BlockElems() const = 0;
+  virtual void Compress(uint8_t* dst, const float* src,
+                        int64_t n) const = 0;
+  virtual void Decompress(float* dst, const uint8_t* src,
+                          int64_t n) const = 0;
+  // dst[i] = decode(encode(dst[i])) in place — segment owners truncate
+  // exactly as peers will decompress, preserving the PR 3 invariant
+  // that every rank's final buffer is bit-identical. Also the
+  // quantizer the engine's error-feedback pass runs on inputs.
+  virtual void Roundtrip(float* dst, int64_t n) const = 0;
+};
+
+// Registry lookup: nullptr for RAW and unknown ids (raw bytes move
+// uncompressed — the safe default for a stale peer stamping an id this
+// build does not know).
+const Codec* CodecFor(WireCodec id);
+
+// Elements ahead of a block-aligned wire offset — maps a chunk's wire
+// byte offset back to its fp32 element offset during pipelined decode.
+inline int64_t CodecElemsBefore(const Codec& c, size_t wire_off) {
+  return static_cast<int64_t>(wire_off / c.WireBlockBytes()) *
+         c.BlockElems();
+}
+
+// Codec id for an env token ("none"/"raw"/""/codec names); -1 unknown.
+int WireCodecFromName(const char* name);
+
+}  // namespace hvt

@@ -18,6 +18,10 @@
 #include <string>
 #include <vector>
 
+// wire-codec registry (WireCodec ids + the Codec interface) — the ids
+// ride in Responses and the stats-slot ABI, so they live beside the
+// other wire types this header aggregates.
+#include "codecs.h"
 // clang -Wthread-safety macros (no-ops under gcc) — included from the
 // root header so every engine file can annotate its locking contracts.
 #include "thread_annotations.h"
@@ -114,15 +118,6 @@ enum class ReduceKind : uint8_t {
   MAX = 3,
   PRODUCT = 4,
   ADASUM = 5,
-};
-
-// Wire codec for TCP-ring payloads, negotiated per response by rank 0
-// (HVT_WIRE_COMPRESSION) so every participant agrees on transfer sizes.
-// BF16 halves fp32 DCN bytes at bf16 precision (EQuARX-style compressed
-// allreduce, arXiv:2506.17615); RAW is bit-exact and the default.
-enum class WireCodec : uint8_t {
-  RAW = 0,
-  BF16 = 1,
 };
 
 struct TensorShape {

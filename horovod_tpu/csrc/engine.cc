@@ -117,16 +117,67 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   ctrl_bypass_ = EnvInt("HVT_CTRL_BYPASS", 1) != 0;
   ctrl_role_ = rank_ == 0 ? CtrlRole::ROOT : CtrlRole::MEMBER;
   ctrl_children_.clear();
-  // Wire codec for fp32 allreduce payloads. Every rank parses the env
-  // for introspection, but only rank 0's value matters: it stamps the
-  // codec into each Response, so the gang always agrees even when the
-  // env differs across hosts.
+  // Wire-codec pair for fp32 allreduce payloads. Every rank parses the
+  // env for introspection, but only rank 0's values matter: it stamps
+  // the per-link-class pair into each Response, so the gang always
+  // agrees even when the env differs across hosts. Forms:
+  //   "<codec>"          same codec on both link classes (PR 3 compat)
+  //   "<intra>,<inter>"  EQuARX split — e.g. "none,int8" keeps in-host
+  //                      traffic full precision and quantizes only the
+  //                      cross-host hops
+  //   "auto"             intra none; inter picked per (size, link) by
+  //                      the CodecTuner from live sweep samples
+  //   "<intra>,auto"     fixed intra codec, tuner-picked inter —
+  //                      e.g. "bf16,auto" keeps bf16 in-host while the
+  //                      cross-host codec adapts
   {
+    wire_intra_ = wire_inter_ = 0;
+    wire_auto_ = false;
     const char* wc = getenv("HVT_WIRE_COMPRESSION");
-    wire_mode_ = (wc && std::string(wc) == "bf16")
-                     ? static_cast<uint8_t>(WireCodec::BF16)
-                     : static_cast<uint8_t>(WireCodec::RAW);
+    std::string spec = wc ? wc : "";
+    auto parse_tok = [&](const std::string& tok, bool allow_auto,
+                         uint8_t* out) {
+      if (allow_auto && tok == "auto") {
+        wire_auto_ = true;
+        *out = 0;
+        return;
+      }
+      int id = WireCodecFromName(tok.c_str());
+      if (id < 0 || id >= kWireCodecCount) {
+        HVT_LOG(WARNING, rank_)
+            << "HVT_WIRE_COMPRESSION: unknown codec '" << tok
+            << "' — moving raw bytes";
+        id = 0;
+      }
+      *out = static_cast<uint8_t>(id);
+    };
+    auto comma = spec.find(',');
+    if (comma == std::string::npos) {
+      uint8_t id = 0;
+      parse_tok(spec, /*allow_auto=*/true, &id);
+      wire_intra_ = wire_auto_ ? 0 : id;  // auto quantizes inter only
+      wire_inter_ = id;
+    } else {
+      parse_tok(spec.substr(0, comma), /*allow_auto=*/false,
+                &wire_intra_);
+      parse_tok(spec.substr(comma + 1), /*allow_auto=*/true,
+                &wire_inter_);
+    }
+    wire_cur_intra_.store(wire_intra_, std::memory_order_relaxed);
+    wire_cur_inter_.store(wire_inter_, std::memory_order_relaxed);
+    stamped_intra_ = wire_intra_;
+    stamped_inter_ = wire_inter_;
+    stamp_uniform_ = true;
+    codec_tuner_.Reset();
   }
+  // error feedback: compensate lossy wire quantization by carrying each
+  // tensor's quantization error into its next submission (cleared on
+  // shutdown/re-init; bounded by HVT_EF_MAX_BYTES)
+  ef_enabled_ = EnvInt("HVT_ERROR_FEEDBACK", 1) != 0;
+  ef_max_bytes_ = EnvInt("HVT_EF_MAX_BYTES", 64 << 20);
+  ef_bufs_.clear();
+  ef_bytes_ = 0;
+  ef_tick_ = 0;
   fusion_threshold_ = EnvInt("HVT_FUSION_THRESHOLD", 64 << 20);
   stall_warn_sec_ =
       static_cast<double>(EnvInt("HVT_STALL_WARN_SEC", 60));
@@ -247,7 +298,7 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
       data_.get(), rank_, size_, master_port, shm_cap, shm_on));
   backends_.push_back(std::make_unique<HierarchicalBackend>(
       data_.get(), topo_, hier_on));
-  backends_.push_back(std::make_unique<RingBackend>(data_.get()));
+  backends_.push_back(std::make_unique<RingBackend>(data_.get(), topo_));
   // must restart from the same value on every rank — an elastic re-init
   // mixes survivors with fresh workers, and the shm barrier words are
   // keyed to this sequence
@@ -265,6 +316,7 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   // scrape threads may poll hvt_engine_stats while Shutdown tears the
   // DataPlane down
   data_->BindTxCounters(stats_.wire_tx_bytes, stats_.wire_tx_comp_bytes);
+  data_->BindCodecTxCounters(stats_.codec_tx_bytes);
   // wire-phase spans land in the flight-recorder ring, which (like the
   // stats block) is engine-owned and outlives data_
   data_->BindEvents(&events_);
@@ -353,6 +405,12 @@ void Engine::Shutdown() {
   stall_warned_.clear();
   lanes_seen_.clear();
   fusion_buffers_.clear();
+  // error-feedback residuals are per-run state: a re-init (elastic
+  // restart, possibly a different codec) must start uncompensated
+  ef_bufs_.clear();
+  ef_bytes_ = 0;
+  ef_tick_ = 0;
+  stats_.ef_residual_bytes.store(0, std::memory_order_relaxed);
 }
 
 // --------------------------------------------------------------------------
@@ -940,7 +998,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     std::vector<Announce> anns;
     anns.push_back(std::move(mine));
     responses = Coordinate(anns);
-    StampWireCodec(responses, wire_mode_);
+    StampWireCodecs(responses);
     resp_flags = rank_shutdown_[0] ? kRespFlagShutdown : 0;
   } else if (ctrl_role_ == CtrlRole::ROOT) {
     // root: one frame per child — every rank in star mode, one LEADER
@@ -972,7 +1030,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
       }
     }
     responses = Coordinate(anns);
-    StampWireCodec(responses, wire_mode_);
+    StampWireCodecs(responses);
     bool all_down = true;
     for (bool b : rank_shutdown_)
       all_down = all_down && b;
@@ -987,7 +1045,18 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     // cycle came off the cache fast path, broadcast the POSITIONS and
     // let each rank rebuild the responses from its own (identical)
     // cache — response bytes then stop scaling with per-name payload.
-    bool bypass = ctrl_bypass_ && coordinate_pure_fastpath_;
+    // auto mode can stamp per-response codec pairs; a positions-form
+    // frame carries exactly ONE pair, so a non-uniform cycle must ship
+    // full responses instead. That happens while the tuner explores,
+    // and permanently when locked per-size-bucket picks diverge within
+    // one cycle (a small and a large cross-host allreduce coordinated
+    // together whose buckets locked different codecs) — the known cost
+    // of keeping the PR 8 one-pair frame format; fixed pairs and
+    // single-pick workloads always bypass. Intra-only responses are
+    // stamped raw and excluded from the uniformity check, so they
+    // never veto the bypass.
+    bool bypass =
+        ctrl_bypass_ && coordinate_pure_fastpath_ && stamp_uniform_;
     Writer out;
     out.u8(bypass
                ? static_cast<uint8_t>(resp_flags | kRespFlagPositions)
@@ -997,7 +1066,8 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
                                 (tuned_prefer_flat_ ? 2 : 0)));
     out.i64vec(pending_evictions_);
     if (bypass) {
-      out.u8(wire_mode_);
+      out.u8(stamped_intra_);
+      out.u8(stamped_inter_);
       // workers re-run FuseResponses on the rebuilt list, so the
       // (possibly autotuned) fusion threshold must ride along or the
       // fused units could diverge across ranks
@@ -1154,6 +1224,25 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
         events_.Record(EventKind::EXEC_END, n,
                        static_cast<int32_t>(resp.op), rank_, 0,
                        resp_lane);
+      // auto-mode feedback: rank 0 credits the executed codec with this
+      // response's wall time so the CodecTuner's per-(size, link) cells
+      // converge on the fastest codec for live traffic. Intra-only
+      // groups are skipped to mirror StampWireCodecs — no inter hop ran,
+      // so their timing must not train the inter-codec cells. Members
+      // only, like the lane stats above: a process set that excludes
+      // rank 0 executes here in ~µs (the skip path), and that phantom
+      // throughput would lock the tuner onto an arbitrary codec.
+      if (rank_ == 0 && mine && wire_auto_ && WireEligible(resp)) {
+        std::vector<int> wgrp;
+        for (auto mr : resp.members) wgrp.push_back(static_cast<int>(mr));
+        if (GroupSpansHosts(topo_, wgrp)) {
+          int64_t bytes = 0;
+          for (auto nn : resp.numels) bytes += nn * 4;
+          codec_tuner_.Observe(bytes, /*link=*/1,
+                               static_cast<WireCodec>(resp.wire_inter),
+                               exec_ns);
+        }
+      }
     }
     if (trace)
       for (auto& n : resp.names) timeline_.ExecuteEnd(n);
@@ -1674,20 +1763,75 @@ std::vector<Response> Engine::Coordinate(
   return out;
 }
 
-// Stamp the negotiated wire codec (HVT_WIRE_COMPRESSION on rank 0) on
-// every eligible TENSOR response — cache fast-path and slow-path alike
-// — so all participants compress/decompress identically. Only fp32
-// non-Adasum allreduces compress (bf16 halves their DCN bytes). Called
-// by the coordinator after Coordinate and by every rank rebuilding a
-// positions-form response (the broadcast carries rank 0's wire mode, so
-// the stamp rule evaluates identically gang-wide).
+// Only fp32 non-Adasum TENSOR allreduces compress — the single gate
+// shared by stamping, error feedback, and the auto tuner.
+bool Engine::WireEligible(const Response& r) {
+  return r.kind == Response::Kind::TENSOR &&
+         r.op == OpType::ALLREDUCE && r.dtype == DataType::FLOAT32 &&
+         r.reduce != ReduceKind::ADASUM;
+}
+
+// Stamp one uniform codec pair on every eligible response — workers
+// rebuilding a positions-form frame (the broadcast carries rank 0's
+// pair, so the stamp rule evaluates identically gang-wide), and the
+// fixed-mode coordinator path via StampWireCodecs below.
 void Engine::StampWireCodec(std::vector<Response>& responses,
-                            uint8_t wire_mode) {
-  if (wire_mode != static_cast<uint8_t>(WireCodec::BF16)) return;
+                            uint8_t wire_intra, uint8_t wire_inter) {
+  if (wire_intra == 0 && wire_inter == 0) return;
   for (auto& r : responses)
-    if (r.kind == Response::Kind::TENSOR && r.op == OpType::ALLREDUCE &&
-        r.dtype == DataType::FLOAT32 && r.reduce != ReduceKind::ADASUM)
-      r.wire = static_cast<uint8_t>(WireCodec::BF16);
+    if (WireEligible(r)) {
+      r.wire_intra = wire_intra;
+      r.wire_inter = wire_inter;
+    }
+}
+
+// Coordinator-side stamping (rank 0 after Coordinate, and the size==1
+// fast path). Fixed modes stamp the configured pair; auto mode asks
+// the CodecTuner per response (size-bucketed, link-classed), recording
+// whether the cycle ended uniform — the bypass frame can only carry
+// one pair.
+void Engine::StampWireCodecs(std::vector<Response>& responses) {
+  stamp_uniform_ = true;
+  stamped_intra_ = wire_intra_;
+  stamped_inter_ = wire_inter_;
+  if (!wire_auto_) {
+    StampWireCodec(responses, wire_intra_, wire_inter_);
+    return;
+  }
+  bool first = true;
+  for (auto& r : responses) {
+    if (!WireEligible(r)) continue;
+    int64_t bytes = 0;
+    for (auto n : r.numels) bytes += n * 4;
+    std::vector<int> grp;
+    for (auto m : r.members) grp.push_back(static_cast<int>(m));
+    int link = GroupSpansHosts(topo_, grp) ? 1 : 0;
+    // auto picks only the inter-host codec (EQuARX). A group confined
+    // to one host has no inter hop, so the tuner must not be consulted
+    // there — its exploration picks would never execute, yet they'd
+    // break bypass uniformity and (via Observe) lock link-0 cells onto
+    // codecs that never ran. The intra codec honors the pair spec:
+    // "bf16,auto" keeps bf16 in-host; bare "auto" parses intra as raw.
+    // Intra-only responses also sit OUT of the uniformity accounting:
+    // their wire_inter is never resolved (ResolveLinkCodec/EffectiveWire
+    // take the intra class), so the forced 0 differing from a locked
+    // inter pick must not veto the steady-state bypass — a workload
+    // mixing single-host process-set ops with cross-host ops would
+    // otherwise never regain the positions form after the tuner locks.
+    uint8_t pick = 0;
+    if (link != 0) {
+      pick = static_cast<uint8_t>(codec_tuner_.Pick(bytes, link));
+      if (first) {
+        stamped_inter_ = pick;
+        first = false;
+      } else if (pick != stamped_inter_) {
+        stamp_uniform_ = false;
+      }
+      wire_cur_inter_.store(pick, std::memory_order_relaxed);
+    }
+    r.wire_intra = wire_intra_;
+    r.wire_inter = pick;
+  }
 }
 
 // Worker-side decode of a rank-0→worker response frame — the full form
@@ -1710,19 +1854,33 @@ void Engine::DecodeResponseFrame(const std::vector<uint8_t>& frame,
   prefer_flat_ = (tuned & 2) != 0;
   evictions = rd.i64vec();
   if (first & kRespFlagPositions) {
-    uint8_t wire_mode = rd.u8();
+    uint8_t wi = rd.u8();  // PR 8's synced-codec slot, grown to the pair
+    uint8_t we = rd.u8();
     // adopt the coordinator's fusion threshold before re-fusing the
     // rebuilt list — local fusion must never diverge from rank 0's
     fusion_threshold_ = rd.i64();
-    responses = ResponsesFromPositions(rd.i64vec(), wire_mode);
+    responses = ResponsesFromPositions(rd.i64vec(), wi, we);
     stats_.ctrl_bypass_cycles.fetch_add(1, std::memory_order_relaxed);
+    wire_cur_intra_.store(wi, std::memory_order_relaxed);
+    wire_cur_inter_.store(we, std::memory_order_relaxed);
   } else {
     responses = DecodeResponseList(rd);
+    // mirror rank 0's stamps into this rank's reported pair — under
+    // auto the env parse says (none, none) on workers, and an operator
+    // debugging a stall via a worker's /debugz must see the codecs the
+    // links actually move
+    for (const auto& r : responses)
+      if (WireEligible(r)) {
+        wire_cur_intra_.store(r.wire_intra, std::memory_order_relaxed);
+        wire_cur_inter_.store(r.wire_inter, std::memory_order_relaxed);
+        break;
+      }
   }
 }
 
 std::vector<Response> Engine::ResponsesFromPositions(
-    const std::vector<int64_t>& positions, uint8_t wire_mode) {
+    const std::vector<int64_t>& positions, uint8_t wire_intra,
+    uint8_t wire_inter) {
   std::vector<Response> out;
   out.reserve(positions.size());
   for (auto pos : positions) {
@@ -1739,7 +1897,7 @@ std::vector<Response> Engine::ResponsesFromPositions(
     out.push_back(std::move(r));
   }
   FuseResponses(out);
-  StampWireCodec(out, wire_mode);
+  StampWireCodec(out, wire_intra, wire_inter);
   return out;
 }
 
@@ -2059,6 +2217,16 @@ std::string Engine::DiagnosticsJson() {
   snprintf(num, sizeof(num), "%.3f", d.stall_warn_sec);
   out += std::string(",\"stall_warn_sec\":") + num;
   out += ",\"events_dropped\":" + std::to_string(events_.dropped());
+  // wire-codec pair (current; auto shows rank 0's latest picks) — a
+  // mixed-codec gang is visible when debugging stalls via /debugz
+  out += std::string(",\"wire\":{\"intra\":\"") +
+         WireCodecName(static_cast<WireCodec>(
+             wire_cur_intra_.load(std::memory_order_relaxed))) +
+         "\",\"inter\":\"" +
+         WireCodecName(static_cast<WireCodec>(
+             wire_cur_inter_.load(std::memory_order_relaxed))) +
+         "\",\"auto\":";
+  out += wire_auto_ ? "true}" : "false}";
   out += ",\"broken\":";
   out += broken_.load() ? "true" : "false";
   if (broken_.load()) {
@@ -2104,6 +2272,79 @@ std::string Engine::DiagnosticsJson() {
   }
   out += "],\"stalls\":[" + stalls + "]}";
   return out;
+}
+
+// --------------------------------------------------------------------------
+// error feedback + link-class resolution
+// --------------------------------------------------------------------------
+
+// Which codec will actually touch this response's payload, given the
+// backend the engine picked: shm moves no wire bytes; the hierarchical
+// backend's lossy phase is its cross-host allreduce (the intra phases
+// are full precision under the recommended pair); a ring resolves by
+// whether its members span hosts. This is the codec the error-feedback
+// pass compensates — compensating a codec that never runs would
+// needlessly quantize the input.
+WireCodec Engine::EffectiveWire(const CollectiveBackend* be,
+                                const Response& resp,
+                                const std::vector<int>& grp) const {
+  if (!WireEligible(resp)) return WireCodec::RAW;
+  WirePair wp{static_cast<WireCodec>(resp.wire_intra),
+              static_cast<WireCodec>(resp.wire_inter)};
+  if (!wp.any()) return WireCodec::RAW;
+  const char* n = be->Name();
+  if (strcmp(n, "shm") == 0) return WireCodec::RAW;
+  if (strcmp(n, "hierarchical") == 0)
+    // compensate the first LOSSY hop: normally the cross-host phase,
+    // but an int8,none-style pair quantizes only the local
+    // reduce-scatter/allgather — falling through to wp.inter there
+    // would skip EF entirely while the intra codec biases every step
+    return wp.inter != WireCodec::RAW ? wp.inter : wp.intra;
+  return ResolveLinkCodec(topo_, wp,
+                          resp.members.empty() ? std::vector<int>{} : grp);
+}
+
+float* Engine::EfResidual(const std::string& name, uint64_t lane,
+                          int64_t n) {
+  const int64_t need = n * 4;
+  if (need > ef_max_bytes_) {
+    stats_.ef_residuals_dropped.fetch_add(1, std::memory_order_relaxed);
+    return nullptr;
+  }
+  std::string key = name;
+  key.push_back('\x1f');
+  key += std::to_string(lane);
+  auto it = ef_bufs_.find(key);
+  if (it != ef_bufs_.end() &&
+      static_cast<int64_t>(it->second.v.size()) != n) {
+    // shape changed under the same name: the old residual is for a
+    // different tensor — start clean
+    ef_bytes_ -= static_cast<int64_t>(it->second.v.size()) * 4;
+    ef_bufs_.erase(it);
+    it = ef_bufs_.end();
+  }
+  if (it == ef_bufs_.end()) {
+    // LRU-evict until the new buffer fits the budget
+    while (ef_bytes_ + need > ef_max_bytes_ && !ef_bufs_.empty()) {
+      auto lru = ef_bufs_.begin();
+      for (auto j = ef_bufs_.begin(); j != ef_bufs_.end(); ++j)
+        if (j->second.tick < lru->second.tick) lru = j;
+      ef_bytes_ -= static_cast<int64_t>(lru->second.v.size()) * 4;
+      ef_bufs_.erase(lru);
+      stats_.ef_residuals_dropped.fetch_add(1, std::memory_order_relaxed);
+    }
+    if (ef_bytes_ + need > ef_max_bytes_) {
+      stats_.ef_residuals_dropped.fetch_add(1, std::memory_order_relaxed);
+      return nullptr;
+    }
+    auto& buf = ef_bufs_[key];
+    buf.v.assign(static_cast<size_t>(n), 0.f);
+    ef_bytes_ += need;
+    it = ef_bufs_.find(key);
+  }
+  it->second.tick = ++ef_tick_;
+  stats_.ef_residual_bytes.store(ef_bytes_, std::memory_order_relaxed);
+  return it->second.v.data();
 }
 
 // --------------------------------------------------------------------------
@@ -2411,11 +2652,47 @@ void Engine::ExecuteResponse(const Response& resp,
         // reference serves every op from the selected backend
         // (operation_manager.cc). postscale (incl. the Average divide)
         // folds into the backend's final data pass, and the negotiated
-        // wire codec rides along for the TCP ring.
+        // wire-codec pair rides along for the TCP ring.
         double post = resp.postscale;
         if (resp.reduce == ReduceKind::AVERAGE) post /= m;
-        WireCodec wire = static_cast<WireCodec>(resp.wire);
+        WirePair wire{static_cast<WireCodec>(resp.wire_intra),
+                      static_cast<WireCodec>(resp.wire_inter)};
         auto* be = PickBackend(resp, total);
+        // error feedback: compensate the codec that will actually touch
+        // this payload. Add each tensor's stored residual, roundtrip the
+        // compensated input through the codec (idempotent on the wire's
+        // own grid, so the first-hop quantization of this rank's data
+        // becomes lossless — exactly so when ring-segment offsets are
+        // block-aligned; unaligned segments re-grid at most one wire
+        // quantum per element, uncaptured), and keep the new
+        // quantization error for the next submission of the same
+        // (name, lane). Per-rank local — every rank compensates only
+        // its own contribution, so cross-rank bit-identity of the
+        // collective is untouched. EffectiveWire picks ONE codec per
+        // payload: a pair with two lossy codecs (bf16,int8
+        // hierarchical) leaves the intra-phase bf16 rounding
+        // uncompensated — see docs/performance.md §EF.
+        const Codec* efc =
+            ef_enabled_ ? CodecFor(EffectiveWire(be, resp, grp)) : nullptr;
+        if (efc && WireEligible(resp)) {
+          const uint64_t lane = LaneId(resp.members);
+          int64_t eoff = 0;
+          for (size_t i = 0; i < resp.names.size(); ++i) {
+            const int64_t n = resp.numels[i];
+            if (entries[i]) {  // joined stand-ins carry no gradient
+              float* seg = reinterpret_cast<float*>(work) + eoff;
+              if (float* r = EfResidual(resp.names[i], lane, n)) {
+                for (int64_t j = 0; j < n; ++j) seg[j] += r[j];
+                memcpy(r, seg, static_cast<size_t>(n) * 4);
+                efc->Roundtrip(seg, n);
+                for (int64_t j = 0; j < n; ++j) r[j] -= seg[j];
+              } else {
+                efc->Roundtrip(seg, n);  // over budget: quantize w/o memory
+              }
+            }
+            eoff += n;
+          }
+        }
         be->BeginResponse(resp_seq_);
         if (resp.members.empty())
           be->Allreduce(work, total, resp.dtype, resp.reduce, post, wire);

@@ -67,6 +67,12 @@ namespace hvt {
 // thread, so every field is a relaxed atomic — cheap enough to keep the
 // counters unconditionally on.
 constexpr int kStatsOps = 7;  // OpType 0..6 (common.h)
+// the DataPlane writes codec_tx_bytes with a kWireOps stride while the
+// array below is sized with kStatsOps — drift between the two would be
+// out-of-bounds atomic writes, not just a misattributed slot
+static_assert(kWireOps == kStatsOps,
+              "ring_ops.h kWireOps must match engine.h kStatsOps: "
+              "DataPlane::CountTx indexes EngineStats::codec_tx_bytes");
 
 // --------------------------------------------------------------------------
 // per-set engine lanes
@@ -233,6 +239,17 @@ struct EngineStats {
   // cycles that rode the steady-state bypass (position-form response
   // rebuilt from the cache instead of full per-name payloads)
   std::atomic<int64_t> ctrl_bypass_cycles{0};
+  // per-(codec, op) TCP data-plane bytes sent, codec-major flat array —
+  // the source of hvt_wire_tx_bytes_total{op,codec}. Codec row 0
+  // ("none") counts raw transfers, so summing rows reproduces the
+  // per-op wire_tx_bytes totals. Owned here for the same
+  // outlives-the-DataPlane reason as the counters above.
+  std::atomic<int64_t> codec_tx_bytes[kWireCodecCount * kStatsOps]{};
+  // error-feedback residual store: resident fp32 residual bytes (gauge)
+  // and residual buffers dropped because HVT_EF_MAX_BYTES could not
+  // admit them (counter)
+  std::atomic<int64_t> ef_residual_bytes{0};
+  std::atomic<int64_t> ef_residuals_dropped{0};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -259,6 +276,9 @@ struct EngineStats {
     ctrl_rx_bytes = 0;
     ctrl_peers = 0;
     ctrl_bypass_cycles = 0;
+    for (auto& c : codec_tx_bytes) c = 0;
+    ef_residual_bytes = 0;
+    ef_residuals_dropped = 0;
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -349,9 +369,16 @@ class Engine {
                      std::memory_order_relaxed)
                : 0;
   }
-  // configured wire codec (WireCodec wire id; rank 0's value governs the
-  // gang — workers follow the per-response stamp)
-  int wire_mode() const { return wire_mode_; }
+  // current wire-codec pair packed as intra | inter << 8 (WireCodec
+  // ids), bit 16 set while HVT_WIRE_COMPRESSION=auto is active. Rank
+  // 0's values govern the gang — workers follow the per-response
+  // stamps; under auto the packed ids are rank 0's latest picks.
+  int wire_mode() const {
+    return static_cast<int>(wire_cur_intra_.load(std::memory_order_relaxed)) |
+           (static_cast<int>(wire_cur_inter_.load(std::memory_order_relaxed))
+            << 8) |
+           (wire_auto_ ? 1 << 16 : 0);
+  }
   EventRing& events() { return events_; }
   // JSON stall/queue snapshot for hvt_diagnostics (thread-safe).
   std::string DiagnosticsJson() EXCLUDES(diag_mu_, broken_mu_);
@@ -426,13 +453,34 @@ class Engine {
                            uint8_t& resp_flags);
   // Steady-state bypass: rebuild the coordinator's response list from
   // broadcast cache positions (caches are identical on every rank) and
-  // re-apply fusion + the wire-codec stamp deterministically.
+  // re-apply fusion + the wire-codec stamps deterministically (the
+  // frame carries rank 0's {intra, inter} pair — PR 8's synced-codec
+  // slot, grown to two ids).
   std::vector<Response> ResponsesFromPositions(
-      const std::vector<int64_t>& positions, uint8_t wire_mode);
-  // Stamp the negotiated wire codec on every eligible response (rank 0
-  // after Coordinate; workers after a positions-form rebuild).
+      const std::vector<int64_t>& positions, uint8_t wire_intra,
+      uint8_t wire_inter);
+  // Stamp a uniform codec pair on every eligible response (workers
+  // rebuilding a positions-form frame; rank 0 in fixed modes).
   static void StampWireCodec(std::vector<Response>& responses,
-                             uint8_t wire_mode);
+                             uint8_t wire_intra, uint8_t wire_inter);
+  // Rank-0 stamping: fixed modes stamp the configured pair; auto mode
+  // asks the CodecTuner per response. Records the stamped pair for the
+  // bypass frame and whether every eligible response got ONE uniform
+  // pair (the extra bypass eligibility condition under auto).
+  void StampWireCodecs(std::vector<Response>& responses);
+  // True when this response's payload is codec-eligible (fp32
+  // non-Adasum TENSOR allreduce) — the single stamp/EF/tuner gate.
+  static bool WireEligible(const Response& r);
+  // The codec that will actually touch this response's payload given
+  // the backend the engine picked — RAW for shm, the inter codec for
+  // hierarchical (its lossy phase), the link-resolved codec for rings.
+  // What the error-feedback pass must compensate.
+  WireCodec EffectiveWire(const CollectiveBackend* be, const Response& resp,
+                          const std::vector<int>& grp) const;
+  // Error-feedback residual for (name, lane): zero-filled on first use,
+  // LRU-bounded by HVT_EF_MAX_BYTES (nullptr when it cannot be
+  // admitted; the drop is counted). Engine-thread only.
+  float* EfResidual(const std::string& name, uint64_t lane, int64_t n);
   // lane-scoped negotiation key: tensor name + the process-set member
   // list (bare name for the global set) — the single spelling shared by
   // the request loop and the cache-hit fold so the two can never diverge
@@ -514,7 +562,37 @@ class Engine {
   std::condition_variable queue_cv_;
   std::deque<EntryPtr> submitted_ GUARDED_BY(queue_mu_);
   bool event_driven_ = true;  // HVT_EVENT_DRIVEN (0 → legacy sleep loop)
-  uint8_t wire_mode_ = 0;     // HVT_WIRE_COMPRESSION (WireCodec wire id)
+  // HVT_WIRE_COMPRESSION parse (see docs/performance.md): a single
+  // codec name applies to both link classes; "<intra>,<inter>" splits
+  // them; "auto" (inter only) hands the choice to the CodecTuner.
+  uint8_t wire_intra_ = 0;    // configured intra-host codec id
+  uint8_t wire_inter_ = 0;    // configured inter-host codec id (fixed modes)
+  bool wire_auto_ = false;    // inter codec chosen by codec_tuner_
+  // current resolved pair for introspection (== configured unless auto,
+  // where the engine thread refreshes it as the tuner explores/locks)
+  std::atomic<uint8_t> wire_cur_intra_{0};
+  std::atomic<uint8_t> wire_cur_inter_{0};
+  // the uniform pair stamped this cycle + whether it WAS uniform — the
+  // bypass frame broadcasts it (auto can stamp per-response pairs, and
+  // a non-uniform cycle must fall back to full response frames)
+  uint8_t stamped_intra_ = 0;
+  uint8_t stamped_inter_ = 0;
+  bool stamp_uniform_ = true;
+  CodecTuner codec_tuner_;    // rank-0 auto-mode codec selection
+
+  // error feedback (engine-thread only): per-(tensor, lane) fp32
+  // residuals so repeated lossy quantization doesn't bias training.
+  // Bounded by HVT_EF_MAX_BYTES with LRU eviction; cleared on
+  // shutdown/re-init.
+  struct EfBuf {
+    std::vector<float> v;
+    uint64_t tick = 0;
+  };
+  std::map<std::string, EfBuf> ef_bufs_;
+  int64_t ef_bytes_ = 0;
+  uint64_t ef_tick_ = 0;
+  int64_t ef_max_bytes_ = 64 << 20;  // HVT_EF_MAX_BYTES
+  bool ef_enabled_ = true;           // HVT_ERROR_FEEDBACK
 
   Mutex handles_mu_;
   std::condition_variable handles_cv_;

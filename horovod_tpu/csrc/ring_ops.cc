@@ -54,39 +54,8 @@ static inline uint16_t FloatToHalf(float v) {
   return static_cast<uint16_t>(sign | (exp << 10) | (man >> 13));
 }
 
-static inline float Bf16ToFloat(uint16_t h) {
-  uint32_t f = static_cast<uint32_t>(h) << 16;
-  float out;
-  memcpy(&out, &f, 4);
-  return out;
-}
-
-static inline uint16_t FloatToBf16(float v) {
-  uint32_t f;
-  memcpy(&f, &v, 4);
-  // round-to-nearest-even
-  uint32_t rounding = 0x7fffu + ((f >> 16) & 1);
-  return static_cast<uint16_t>((f + rounding) >> 16);
-}
-
-// ---- bf16 wire codec -------------------------------------------------------
-
-void CompressBf16(uint16_t* dst, const float* src, int64_t n) {
-  uint16_t* __restrict d = dst;
-  const float* __restrict s = src;
-  for (int64_t i = 0; i < n; ++i) d[i] = FloatToBf16(s[i]);
-}
-
-void DecompressBf16(float* dst, const uint16_t* src, int64_t n) {
-  float* __restrict d = dst;
-  const uint16_t* __restrict s = src;
-  for (int64_t i = 0; i < n; ++i) d[i] = Bf16ToFloat(s[i]);
-}
-
-void RoundtripBf16(float* dst, int64_t n) {
-  float* __restrict d = dst;
-  for (int64_t i = 0; i < n; ++i) d[i] = Bf16ToFloat(FloatToBf16(d[i]));
-}
+// (bf16 scalar conversions live in codecs.h — shared with the wire
+// codec registry, which migrated the PR 3 bf16 helpers.)
 
 // dst (fp32) op= widen(src bf16) — the compressed-wire reduce step,
 // fused so the widened chunk never needs its own scratch pass.
@@ -108,6 +77,23 @@ static void ReduceFromBf16(float* dst, const uint16_t* src, int64_t n,
       for (int64_t i = 0; i < n; ++i) d[i] += Bf16ToFloat(s[i]);
       break;
   }
+}
+
+// dst (fp32) op= decode(src wire bytes) for any registry codec. bf16
+// keeps its fused widen-reduce; block codecs decode into a staging
+// vector (chunk-sized) then reduce — the staging pass is noise next to
+// the 4x fewer socket bytes they exist to buy.
+static void ReduceFromWire(const Codec& c, float* dst, const uint8_t* src,
+                           int64_t n, ReduceKind red,
+                           std::vector<float>& staging) {
+  if (c.id() == WireCodec::BF16) {
+    ReduceFromBf16(dst, reinterpret_cast<const uint16_t*>(src), n, red);
+    return;
+  }
+  if (static_cast<int64_t>(staging.size()) < n)
+    staging.resize(static_cast<size_t>(n));
+  c.Decompress(staging.data(), src, n);
+  ReduceInto(dst, staging.data(), n, DataType::FLOAT32, red);
 }
 
 // ---- elementwise reductions ------------------------------------------------
@@ -292,7 +278,7 @@ DataPlane::DataPlane(int rank, int size, std::vector<Sock> peers)
 
 void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
                        Sock& in, uint8_t* recv_buf, size_t recv_n,
-                       size_t chunk_bytes, bool compressed,
+                       size_t chunk_bytes, WireCodec codec,
                        const std::function<void(size_t, size_t)>& on_chunk) {
   size_t sent = 0, rcvd = 0, notified = 0;
   auto flush_chunks = [&] {
@@ -366,7 +352,7 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
   if (events_ && wire_bytes > 0)
     events_->Record(EventKind::WIRE_END, wire_name_, stat_op_, 0,
                     wire_bytes, wire_lane_);
-  CountTx(send_n, compressed);
+  CountTx(send_n, codec);
 }
 
 // ---- collectives -----------------------------------------------------------
@@ -381,16 +367,23 @@ void DataPlane::RingReduceScatter(uint8_t* bytes,
   const int idx = GroupIndexOf(group, rank_);
   const int next = group[(idx + 1) % l];
   const int prev = group[(idx + l - 1) % l];
-  const bool comp = wire == WireCodec::BF16 && el == 4;
-  const size_t wel = comp ? 2 : el;  // bytes per element on the wire
+  // codecs operate on fp32 payloads only; anything else moves raw
+  const Codec* cdc = el == 4 ? CodecFor(wire) : nullptr;
+  const WireCodec wid = cdc ? wire : WireCodec::RAW;
+  auto wbytes = [&](int64_t n) {
+    return cdc ? cdc->CompressedSize(n) : static_cast<size_t>(n) * el;
+  };
   int64_t max_seg = 0;
   for (int i = 0; i < l; ++i)
     max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
-  scratch_.resize(static_cast<size_t>(max_seg) * wel);
-  if (comp) wire_send_.resize(static_cast<size_t>(max_seg) * wel);
-  // element-aligned chunking so each completed chunk reduces in place
-  const size_t chunk =
-      std::max<size_t>(wel, (static_cast<size_t>(chunk_bytes_) / wel) * wel);
+  scratch_.resize(wbytes(max_seg));
+  if (cdc) wire_send_.resize(wbytes(max_seg));
+  // chunk alignment: raw streams align to the element, codec streams to
+  // the self-contained wire block (in-band scales) — either way a
+  // completed chunk decodes and reduces in place
+  const size_t align = cdc ? cdc->WireBlockBytes() : el;
+  const size_t chunk = std::max<size_t>(
+      align, (static_cast<size_t>(chunk_bytes_) / align) * align);
 
   // after l-1 steps, group index i owns fully-reduced segment (i+1) % l
   for (int step = 0; step < l - 1; ++step) {
@@ -398,41 +391,44 @@ void DataPlane::RingReduceScatter(uint8_t* bytes,
     int recv_seg = (idx - step - 1 + l) % l;
     int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
     int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
+    const size_t send_w = wbytes(send_n), recv_w = wbytes(recv_n);
     const uint8_t* sp = bytes + seg_off[send_seg] * el;
-    if (comp) {
-      CompressBf16(reinterpret_cast<uint16_t*>(wire_send_.data()),
-                   reinterpret_cast<const float*>(sp), send_n);
+    if (cdc) {
+      cdc->Compress(wire_send_.data(),
+                    reinterpret_cast<const float*>(sp), send_n);
       sp = wire_send_.data();
     }
     uint8_t* dst_seg = bytes + seg_off[recv_seg] * el;
     auto reduce_chunk = [&](size_t off, size_t len) {
-      if (comp)
-        ReduceFromBf16(
-            reinterpret_cast<float*>(dst_seg) + off / 2,
-            reinterpret_cast<const uint16_t*>(scratch_.data() + off),
-            static_cast<int64_t>(len / 2), red);
-      else
+      if (cdc) {
+        // off is block-aligned (chunk is a block multiple); the final
+        // chunk may end mid-block only at the stream's end, where the
+        // remaining element count closes the partial tail block
+        int64_t e0 = CodecElemsBefore(*cdc, off);
+        int64_t e1 = off + len >= recv_w
+                         ? recv_n
+                         : CodecElemsBefore(*cdc, off + len);
+        ReduceFromWire(*cdc, reinterpret_cast<float*>(dst_seg) + e0,
+                       scratch_.data() + off, e1 - e0, red, decode_);
+      } else {
         ReduceInto(dst_seg + off, scratch_.data() + off,
                    static_cast<int64_t>(len / el), dtype, red);
+      }
     };
     if (pipeline_) {
-      Duplex(peer(next), sp, static_cast<size_t>(send_n) * wel, peer(prev),
-             scratch_.data(), static_cast<size_t>(recv_n) * wel, chunk,
-             comp, reduce_chunk);
+      Duplex(peer(next), sp, send_w, peer(prev), scratch_.data(), recv_w,
+             chunk, wid, reduce_chunk);
     } else {
       // blocking baseline: full-duplex via index-parity ordering (avoids
       // head-of-line deadlock for frames below the socket buffer size)
       if (idx % 2 == 0) {
-        SendCounted(peer(next), sp, static_cast<size_t>(send_n) * wel, comp);
-        peer(prev).RecvAll(scratch_.data(),
-                           static_cast<size_t>(recv_n) * wel);
+        SendCounted(peer(next), sp, send_w, wid);
+        peer(prev).RecvAll(scratch_.data(), recv_w);
       } else {
-        peer(prev).RecvAll(scratch_.data(),
-                           static_cast<size_t>(recv_n) * wel);
-        SendCounted(peer(next), sp, static_cast<size_t>(send_n) * wel, comp);
+        peer(prev).RecvAll(scratch_.data(), recv_w);
+        SendCounted(peer(next), sp, send_w, wid);
       }
-      if (recv_n > 0)
-        reduce_chunk(0, static_cast<size_t>(recv_n) * wel);
+      if (recv_n > 0) reduce_chunk(0, recv_w);
     }
   }
 }
@@ -447,56 +443,56 @@ void DataPlane::RingAllgatherSegs(uint8_t* bytes,
   const int idx = GroupIndexOf(group, rank_);
   const int next = group[(idx + 1) % l];
   const int prev = group[(idx + l - 1) % l];
-  const bool comp = wire == WireCodec::BF16 && el == 4;
-  const size_t wel = comp ? 2 : el;
-  const size_t chunk =
-      std::max<size_t>(wel, (static_cast<size_t>(chunk_bytes_) / wel) * wel);
-  if (comp) {
+  const Codec* cdc = el == 4 ? CodecFor(wire) : nullptr;
+  const WireCodec wid = cdc ? wire : WireCodec::RAW;
+  auto wbytes = [&](int64_t n) {
+    return cdc ? cdc->CompressedSize(n) : static_cast<size_t>(n) * el;
+  };
+  const size_t align = cdc ? cdc->WireBlockBytes() : el;
+  const size_t chunk = std::max<size_t>(
+      align, (static_cast<size_t>(chunk_bytes_) / align) * align);
+  if (cdc) {
     int64_t max_seg = 0;
     for (int i = 0; i < l; ++i)
       max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
-    wire_send_.resize(static_cast<size_t>(max_seg) * wel);
-    wire_recv_.resize(static_cast<size_t>(max_seg) * wel);
+    wire_send_.resize(wbytes(max_seg));
+    wire_recv_.resize(wbytes(max_seg));
   }
   for (int step = 0; step < l - 1; ++step) {
     int send_seg = (idx + 1 - step + l) % l;
     int recv_seg = (idx - step + l) % l;
     int64_t send_n = seg_off[send_seg + 1] - seg_off[send_seg];
     int64_t recv_n = seg_off[recv_seg + 1] - seg_off[recv_seg];
-    if (comp) {
+    if (cdc) {
       // step 0 compresses the owned segment; later steps forward the
       // compressed form received last step (no recompression, and the
       // values stay identical at every hop)
+      const size_t send_w = wbytes(send_n), recv_w = wbytes(recv_n);
       if (step == 0)
-        CompressBf16(
-            reinterpret_cast<uint16_t*>(wire_send_.data()),
+        cdc->Compress(
+            wire_send_.data(),
             reinterpret_cast<const float*>(bytes + seg_off[send_seg] * el),
             send_n);
       float* dst = reinterpret_cast<float*>(bytes + seg_off[recv_seg] * el);
       auto widen_chunk = [&](size_t off, size_t len) {
-        DecompressBf16(dst + off / 2,
-                       reinterpret_cast<const uint16_t*>(
-                           wire_recv_.data() + off),
-                       static_cast<int64_t>(len / 2));
+        int64_t e0 = CodecElemsBefore(*cdc, off);
+        int64_t e1 = off + len >= recv_w
+                         ? recv_n
+                         : CodecElemsBefore(*cdc, off + len);
+        cdc->Decompress(dst + e0, wire_recv_.data() + off, e1 - e0);
       };
       if (pipeline_) {
-        Duplex(peer(next), wire_send_.data(),
-               static_cast<size_t>(send_n) * wel, peer(prev),
-               wire_recv_.data(), static_cast<size_t>(recv_n) * wel, chunk,
-               true, widen_chunk);
+        Duplex(peer(next), wire_send_.data(), send_w, peer(prev),
+               wire_recv_.data(), recv_w, chunk, wid, widen_chunk);
       } else {
         if (idx % 2 == 0) {
-          SendCounted(peer(next), wire_send_.data(),
-                      static_cast<size_t>(send_n) * wel, true);
-          peer(prev).RecvAll(wire_recv_.data(),
-                             static_cast<size_t>(recv_n) * wel);
+          SendCounted(peer(next), wire_send_.data(), send_w, wid);
+          peer(prev).RecvAll(wire_recv_.data(), recv_w);
         } else {
-          peer(prev).RecvAll(wire_recv_.data(),
-                             static_cast<size_t>(recv_n) * wel);
-          SendCounted(peer(next), wire_send_.data(),
-                      static_cast<size_t>(send_n) * wel, true);
+          peer(prev).RecvAll(wire_recv_.data(), recv_w);
+          SendCounted(peer(next), wire_send_.data(), send_w, wid);
         }
-        if (recv_n > 0) widen_chunk(0, static_cast<size_t>(recv_n) * wel);
+        if (recv_n > 0) widen_chunk(0, recv_w);
       }
       std::swap(wire_send_, wire_recv_);
       continue;
@@ -505,17 +501,18 @@ void DataPlane::RingAllgatherSegs(uint8_t* bytes,
       Duplex(peer(next), bytes + seg_off[send_seg] * el,
              static_cast<size_t>(send_n) * el, peer(prev),
              bytes + seg_off[recv_seg] * el,
-             static_cast<size_t>(recv_n) * el, chunk, false, nullptr);
+             static_cast<size_t>(recv_n) * el, chunk, WireCodec::RAW,
+             nullptr);
     } else if (idx % 2 == 0) {
       SendCounted(peer(next), bytes + seg_off[send_seg] * el,
-                  static_cast<size_t>(send_n) * el, false);
+                  static_cast<size_t>(send_n) * el, WireCodec::RAW);
       peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
                          static_cast<size_t>(recv_n) * el);
     } else {
       peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
                          static_cast<size_t>(recv_n) * el);
       SendCounted(peer(next), bytes + seg_off[send_seg] * el,
-                  static_cast<size_t>(send_n) * el, false);
+                  static_cast<size_t>(send_n) * el, WireCodec::RAW);
     }
   }
 }
@@ -531,12 +528,13 @@ void DataPlane::AllreduceGroup(void* buf, int64_t count, DataType dtype,
   const size_t el = DataTypeSize(dtype);
   auto* bytes = static_cast<uint8_t*>(buf);
   const int l = static_cast<int>(group.size());
-  const bool comp = wire == WireCodec::BF16 && dtype == DataType::FLOAT32;
+  const Codec* cdc =
+      dtype == DataType::FLOAT32 ? CodecFor(wire) : nullptr;
+  const WireCodec wid = cdc ? wire : WireCodec::RAW;
   // segment boundaries (element granularity)
   std::vector<int64_t> seg_off(l + 1);
   for (int i = 0; i <= l; ++i) seg_off[i] = count * i / l;
-  RingReduceScatter(bytes, seg_off, el, dtype, red, group,
-                    comp ? WireCodec::BF16 : WireCodec::RAW);
+  RingReduceScatter(bytes, seg_off, el, dtype, red, group, wid);
   // postscale folds into the allgather: each rank scales only the one
   // segment it owns fully-reduced, and the rotation distributes scaled
   // data — 1/l of the scalar work and no separate full-buffer sweep
@@ -545,13 +543,12 @@ void DataPlane::AllreduceGroup(void* buf, int64_t count, DataType dtype,
   const int64_t own_n = seg_off[own + 1] - seg_off[own];
   if (postscale != 1.0)
     ScaleBuffer(bytes + seg_off[own] * el, own_n, dtype, postscale);
-  if (comp)
+  if (cdc)
     // truncate the owned segment exactly as peers will decompress it, so
     // every rank's final buffer is bit-identical
-    RoundtripBf16(reinterpret_cast<float*>(bytes + seg_off[own] * el),
-                  own_n);
-  RingAllgatherSegs(bytes, seg_off, el, group,
-                    comp ? WireCodec::BF16 : WireCodec::RAW);
+    cdc->Roundtrip(reinterpret_cast<float*>(bytes + seg_off[own] * el),
+                   own_n);
+  RingAllgatherSegs(bytes, seg_off, el, group, wid);
 }
 
 void DataPlane::Allreduce(void* buf, int64_t count, DataType dtype,
@@ -591,15 +588,15 @@ void DataPlane::AllgathervGroup(const void* in, int64_t my_rows,
     if (pipeline_) {
       Duplex(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
              peer(prev), dst + offs[recv_blk] * row_bytes, recv_bytes,
-             chunk, false, nullptr);
+             chunk, WireCodec::RAW, nullptr);
     } else if (idx % 2 == 0) {
       SendCounted(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
-                  false);
+                  WireCodec::RAW);
       peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
     } else {
       peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
       SendCounted(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
-                  false);
+                  WireCodec::RAW);
     }
   }
 }
@@ -618,7 +615,8 @@ void DataPlane::BroadcastGroup(void* buf, int64_t bytes, int root,
   if (rank_ == root) {
     for (int r : group) {
       if (r == root) continue;
-      SendCounted(peer(r), buf, static_cast<size_t>(bytes), false);
+      SendCounted(peer(r), buf, static_cast<size_t>(bytes),
+                  WireCodec::RAW);
     }
   } else {
     peer(root).RecvAll(buf, static_cast<size_t>(bytes));
@@ -660,15 +658,16 @@ void DataPlane::AlltoallvGroup(const void* in,
       if (sb || rb)
         Duplex(peer(other), src + soff[opos] * row_bytes, sb, peer(other),
                dst + roff[opos] * row_bytes, rb,
-               static_cast<size_t>(chunk_bytes_), false, nullptr);
+               static_cast<size_t>(chunk_bytes_), WireCodec::RAW,
+               nullptr);
     } else if (idx < opos) {
       if (sb) SendCounted(peer(other), src + soff[opos] * row_bytes, sb,
-                          false);
+                          WireCodec::RAW);
       if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
     } else {
       if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
       if (sb) SendCounted(peer(other), src + soff[opos] * row_bytes, sb,
-                          false);
+                          WireCodec::RAW);
     }
   }
 }

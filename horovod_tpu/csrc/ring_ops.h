@@ -20,12 +20,17 @@
 // fp16/bf16 are accumulated in fp32 (reference half.{h,cc} + the fused
 // scale kernels do the same widening).
 //
-// Wire compression: when a response is stamped WireCodec::BF16 (fp32
-// allreduce under HVT_WIRE_COMPRESSION=bf16), both ring phases move
-// bf16-truncated payloads — half the DCN bytes — and widen back to fp32
-// for the reduce. Every rank ends with bit-identical buffers: after the
-// reduce-scatter each rank round-trips its owned segment through bf16
-// before the allgather, so owners and receivers see the same values.
+// Wire compression: when a response is stamped with a non-RAW WireCodec
+// (fp32 allreduce under HVT_WIRE_COMPRESSION; see csrc/codecs.h for the
+// codec family), both ring phases move compressed payloads — bf16
+// halves the bytes, the block-scaled int8/fp8 codecs cut ~3.94x — and
+// widen back to fp32 for the reduce. Chunked pipelining survives
+// because every codec's stream is self-contained at WireBlockBytes()
+// granularity (in-band per-block scales), and ring chunks are aligned
+// to it. Every rank ends with bit-identical buffers: after the
+// reduce-scatter each rank round-trips its owned segment through the
+// codec before the allgather, so owners and receivers see the same
+// values; compressed allgather forwarding never recompresses.
 #pragma once
 
 #include <atomic>
@@ -75,7 +80,9 @@ class DataPlane {
                       WireCodec wire = WireCodec::RAW);
   // Ring reduce-scatter phase: after it, the rank at group index i owns
   // fully-reduced segment (i+1) % |group| of `bytes` (segments given by
-  // seg_off, element size el). wire == BF16 requires el == 4 (fp32).
+  // seg_off, element size el). A non-RAW wire codec requires el == 4
+  // (fp32); callers pass the codec already resolved for this link class
+  // (the backends map {intra, inter} pairs onto phases).
   void RingReduceScatter(uint8_t* bytes,
                          const std::vector<int64_t>& seg_off, size_t el,
                          DataType dtype, ReduceKind red,
@@ -83,8 +90,8 @@ class DataPlane {
                          WireCodec wire = WireCodec::RAW);
   // Ring allgather phase rotating owned segments (inverse of the above's
   // ownership: entering, group index i holds segment (i+1) % |group|).
-  // With BF16 wire, received segments are forwarded in compressed form
-  // (no recompression at intermediate hops).
+  // With a compressing wire codec, received segments are forwarded in
+  // compressed form (no recompression at intermediate hops).
   void RingAllgatherSegs(uint8_t* bytes,
                          const std::vector<int64_t>& seg_off, size_t el,
                          const std::vector<int>& group,
@@ -135,6 +142,13 @@ class DataPlane {
     tx_sink_ = tx;
     txc_sink_ = tx_comp;
   }
+  // Per-(codec, op) byte attribution behind
+  // hvt_wire_tx_bytes_total{op,codec}: a flat
+  // [kWireCodecCount * kWireOps] array, codec-major — caller-owned like
+  // the per-op counters above.
+  void BindCodecTxCounters(std::atomic<int64_t>* sink) {
+    codec_tx_sink_ = sink;
+  }
   void set_stat_op(int op) {
     stat_op_ = (op >= 0 && op < kWireOps) ? op : 0;
   }
@@ -155,17 +169,20 @@ class DataPlane {
 
  private:
   Sock& peer(int r) { return peers_[static_cast<size_t>(r)]; }
-  void CountTx(size_t n, bool compressed) {
+  void CountTx(size_t n, WireCodec codec) {
     if (!tx_sink_) return;
     tx_sink_[stat_op_].fetch_add(static_cast<int64_t>(n),
                                  std::memory_order_relaxed);
-    if (compressed)
+    if (codec != WireCodec::RAW)
       txc_sink_[stat_op_].fetch_add(static_cast<int64_t>(n),
                                     std::memory_order_relaxed);
+    if (codec_tx_sink_)
+      codec_tx_sink_[static_cast<int>(codec) * kWireOps + stat_op_]
+          .fetch_add(static_cast<int64_t>(n), std::memory_order_relaxed);
   }
-  void SendCounted(Sock& s, const void* data, size_t n, bool compressed) {
+  void SendCounted(Sock& s, const void* data, size_t n, WireCodec codec) {
     s.SendAll(data, n);
-    CountTx(n, compressed);
+    CountTx(n, codec);
   }
   // Full-duplex pump: stream send_n bytes to `out` while receiving
   // recv_n bytes from `in` (nonblocking + poll, so neither direction
@@ -175,7 +192,7 @@ class DataPlane {
   // the same socket (2-member rings).
   void Duplex(Sock& out, const uint8_t* send_buf, size_t send_n, Sock& in,
               uint8_t* recv_buf, size_t recv_n, size_t chunk_bytes,
-              bool compressed,
+              WireCodec codec,
               const std::function<void(size_t, size_t)>& on_chunk);
 
   int rank_, size_;
@@ -185,11 +202,14 @@ class DataPlane {
   int stat_op_ = 0;             // engine-thread-only (set_stat_op)
   std::atomic<int64_t>* tx_sink_ = nullptr;   // [kWireOps], caller-owned
   std::atomic<int64_t>* txc_sink_ = nullptr;  // [kWireOps], caller-owned
+  // [kWireCodecCount * kWireOps] codec-major, caller-owned
+  std::atomic<int64_t>* codec_tx_sink_ = nullptr;
   EventRing* events_ = nullptr;               // caller-owned (engine)
   std::string wire_name_;       // engine-thread-only (set_wire_ctx)
   int wire_lane_ = 0;
   std::vector<uint8_t> scratch_;
   std::vector<uint8_t> wire_send_, wire_recv_;  // compressed ping-pong
+  std::vector<float> decode_;   // block-codec chunk-decode staging
 };
 
 // Elementwise accumulate: dst = dst (op) src, for count elements.
@@ -200,11 +220,7 @@ void ReduceInto(void* dst, const void* src, int64_t count, DataType dtype,
 // truncating toward zero.
 void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor);
 
-// bf16 wire codec helpers (fp32 payloads only).
-void CompressBf16(uint16_t* dst, const float* src, int64_t n);
-void DecompressBf16(float* dst, const uint16_t* src, int64_t n);
-// dst[i] = bf16_roundtrip(dst[i]) — truncate in place so the owner of a
-// segment matches what its peers decompressed.
-void RoundtripBf16(float* dst, int64_t n);
+// (the bf16 wire helpers that used to live here are now the BF16 entry
+// of the codec registry — csrc/codecs.{h,cc})
 
 }  // namespace hvt

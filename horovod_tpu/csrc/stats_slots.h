@@ -21,7 +21,7 @@
 // `hist.count`.
 #pragma once
 
-#define HVT_STATS_SLOT_COUNT 104
+#define HVT_STATS_SLOT_COUNT 134
 
 // X-macro: HVT_STATS_SLOT(index, "name")
 #define HVT_STATS_SLOTS(X)                  \
@@ -128,4 +128,34 @@
   X(100, "ctrl_tx_bytes")                   \
   X(101, "ctrl_rx_bytes")                   \
   X(102, "ctrl_peers")                      \
-  X(103, "ctrl_bypass_cycles")
+  X(103, "ctrl_bypass_cycles")              \
+  X(104, "codec_tx_bytes[none][allreduce]") \
+  X(105, "codec_tx_bytes[none][allgather]") \
+  X(106, "codec_tx_bytes[none][broadcast]") \
+  X(107, "codec_tx_bytes[none][alltoall]") \
+  X(108, "codec_tx_bytes[none][reducescatter]") \
+  X(109, "codec_tx_bytes[none][join]")     \
+  X(110, "codec_tx_bytes[none][barrier]")  \
+  X(111, "codec_tx_bytes[bf16][allreduce]") \
+  X(112, "codec_tx_bytes[bf16][allgather]") \
+  X(113, "codec_tx_bytes[bf16][broadcast]") \
+  X(114, "codec_tx_bytes[bf16][alltoall]") \
+  X(115, "codec_tx_bytes[bf16][reducescatter]") \
+  X(116, "codec_tx_bytes[bf16][join]")     \
+  X(117, "codec_tx_bytes[bf16][barrier]")  \
+  X(118, "codec_tx_bytes[int8][allreduce]") \
+  X(119, "codec_tx_bytes[int8][allgather]") \
+  X(120, "codec_tx_bytes[int8][broadcast]") \
+  X(121, "codec_tx_bytes[int8][alltoall]") \
+  X(122, "codec_tx_bytes[int8][reducescatter]") \
+  X(123, "codec_tx_bytes[int8][join]")     \
+  X(124, "codec_tx_bytes[int8][barrier]")  \
+  X(125, "codec_tx_bytes[fp8][allreduce]") \
+  X(126, "codec_tx_bytes[fp8][allgather]") \
+  X(127, "codec_tx_bytes[fp8][broadcast]") \
+  X(128, "codec_tx_bytes[fp8][alltoall]")  \
+  X(129, "codec_tx_bytes[fp8][reducescatter]") \
+  X(130, "codec_tx_bytes[fp8][join]")      \
+  X(131, "codec_tx_bytes[fp8][barrier]")   \
+  X(132, "ef_residual_bytes")              \
+  X(133, "ef_residuals_dropped")          

@@ -93,10 +93,14 @@ struct Response {
   // process set the collective runs over (empty → global); non-member
   // ranks skip the response entirely
   std::vector<int64_t> members;
-  // wire codec for the data-plane transfer (WireCodec wire id), stamped
-  // by rank 0 so all participants compress/decompress identically;
-  // 0 = raw bytes
-  uint8_t wire = 0;
+  // per-link-class wire codecs for the data-plane transfer (WireCodec
+  // wire ids), stamped by rank 0 so all participants compress/
+  // decompress identically; 0 = raw bytes. Intra-host links (the
+  // hierarchical backend's local phases, single-host rings) take
+  // wire_intra; anything crossing hosts takes wire_inter — the EQuARX
+  // "quantize only the DCN hops" split when the pair differs.
+  uint8_t wire_intra = 0;
+  uint8_t wire_inter = 0;
   // NOT on the wire: full per-name dims, populated by the coordinator's
   // BuildResponse / cache fast path for ITS OWN local execution.
   // Rank 0's response-cache copies must hold the true shapes — its
@@ -252,7 +256,8 @@ inline void EncodeResponse(Writer& w, const Response& r) {
   w.i64(r.trailing);
   w.i32(r.group_id);
   w.i64vec(r.members);
-  w.u8(r.wire);
+  w.u8(r.wire_intra);
+  w.u8(r.wire_inter);
 }
 
 inline Response DecodeResponse(Reader& rd) {
@@ -273,7 +278,8 @@ inline Response DecodeResponse(Reader& rd) {
   r.trailing = rd.i64();
   r.group_id = rd.i32();
   r.members = rd.i64vec();
-  r.wire = rd.u8();
+  r.wire_intra = rd.u8();
+  r.wire_inter = rd.u8();
   return r;
 }
 

@@ -51,6 +51,14 @@ class Handle:
         return self._event.is_set()
 
     def wait(self, timeout=None):
+        """Block until the collective completes and return its result
+        (framework-converted). ``timeout`` (seconds) bounds the wait —
+        :class:`HorovodTimeoutError` if still pending, with the handle
+        left waitable. The result is MOVED out on first success: wait a
+        handle once. Wire-level concerns (the negotiated
+        ``{intra, inter}`` codec pair, error feedback) never surface
+        here — a compressed collective completes exactly like a raw
+        one, just with fewer bytes on the DCN hops."""
         if not self._event.wait(timeout):
             from horovod_tpu.common.exceptions import HorovodTimeoutError
 

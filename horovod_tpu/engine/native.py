@@ -180,6 +180,15 @@ STATS_LANE_SLOTS = 8
 # steady-state positions-form bypass
 STATS_TAIL_SCALARS = ("ctrl_tx_bytes", "ctrl_rx_bytes", "ctrl_peers",
                       "ctrl_bypass_cycles")
+# wire-codec registry (index == WireCodec wire id, csrc/codecs.h —
+# lockstep with horovod_tpu/compression CODEC_IDS and the
+# docs/performance.md codec table; hvt_lint `codecs` pass checks all
+# three). The per-(codec, op) byte block decodes codec-major after the
+# tail scalars.
+WIRE_CODECS = ("none", "bf16", "int8", "fp8")
+# error-feedback scalars appended after the codec block (c_api.cc
+# kStatsEfScalars)
+STATS_EF_SCALARS = ("ef_residual_bytes", "ef_residuals_dropped")
 
 
 def engine_stats() -> dict:
@@ -225,17 +234,36 @@ def engine_stats() -> dict:
     for key in STATS_TAIL_SCALARS:
         out[key] = vals[lbase]
         lbase += 1
+    out["codec_tx_bytes"] = {}
+    for codec in WIRE_CODECS:
+        out["codec_tx_bytes"][codec] = dict(
+            zip(STATS_OPS, vals[lbase:lbase + n_ops]))
+        lbase += n_ops
+    for key in STATS_EF_SCALARS:
+        out[key] = vals[lbase]
+        lbase += 1
     return out
 
 
-def wire_compression() -> int:
-    """Configured wire codec of this rank's engine (0 = raw, 1 = bf16);
-    rank 0's value governs the gang via per-response stamps. 0 when the
+def wire_compression() -> tuple:
+    """Current wire-codec pair of this rank's engine as
+    ``(intra_id, inter_id, auto)`` — WireCodec wire ids per link class
+    (0 none, 1 bf16, 2 int8, 3 fp8; :data:`WIRE_CODECS` maps ids to
+    names) plus whether ``HVT_WIRE_COMPRESSION=auto`` is active. Rank
+    0's values govern the gang via per-response stamps; under auto the
+    ids are rank 0's latest tuner picks. ``(0, 0, False)`` when the
     library or symbol is absent."""
     lib = _load()
     if lib is None or getattr(lib, "hvt_wire_compression", None) is None:
-        return 0
-    return int(lib.hvt_wire_compression())
+        return (0, 0, False)
+    packed = int(lib.hvt_wire_compression())
+    if getattr(lib, "hvt_codec_roundtrip", None) is None:
+        # stale pre-registry .so: the scalar is a single WireCodec id
+        # applied to EVERY link — decoding it as a packed pair would
+        # report inter-host traffic as raw while the old engine is
+        # actually compressing it
+        return (packed & 0xFF, packed & 0xFF, False)
+    return (packed & 0xFF, (packed >> 8) & 0xFF, bool(packed >> 16 & 1))
 
 
 # ---------------------------------------------------------------------------
@@ -275,7 +303,9 @@ ABORT_CAUSES = ("timeout", "peer_lost", "remote_abort", "heartbeat",
 STATS_SLOT_COUNT = (len(STATS_SCALARS) + 4 * len(STATS_OPS)
                     + 2 * (STATS_LAT_BUCKETS + 1 + 2) + len(ABORT_CAUSES)
                     + 1 + 3 * STATS_LANE_SLOTS
-                    + len(STATS_TAIL_SCALARS))
+                    + len(STATS_TAIL_SCALARS)
+                    + len(WIRE_CODECS) * len(STATS_OPS)
+                    + len(STATS_EF_SCALARS))
 
 
 def events_supported() -> bool:

@@ -582,15 +582,24 @@ def poll(handle) -> bool:
     return handle.done()
 
 
-def wire_compression() -> str:
-    """Negotiated wire codec of the eager data plane: ``"bf16"`` when
-    ``HVT_WIRE_COMPRESSION=bf16`` is active on this rank's engine (fp32
-    allreduces then move half the DCN bytes, within bf16 precision),
-    else ``"none"``. Rank 0's setting governs the gang — the codec is
-    stamped into every coordinated response, so mixed environments
-    still agree on transfer sizes. Distinct from ``hvt.Compression``
-    (framework-level cast before submission): wire compression is
-    transparent to callers and applies inside the TCP ring only."""
-    from horovod_tpu.engine import native
+def wire_compression() -> tuple:
+    """Current wire-codec pair of the eager data plane as
+    ``(intra, inter)`` codec names — which codec intra-host links and
+    cross-host links move (``"none"``, ``"bf16"``, ``"int8"`` or
+    ``"fp8"``; the ``horovod_tpu.compression`` registry). E.g.
+    ``("none", "int8")`` under ``HVT_WIRE_COMPRESSION=none,int8``
+    (EQuARX-style: only the DCN hops quantize), ``("bf16", "bf16")``
+    under the single-token form, ``("none", "none")`` by default.
+    Under ``auto`` the pair reflects rank 0's latest tuner picks
+    (``horovod_tpu.compression.auto_active()`` tells). Rank 0's
+    setting governs the gang — the pair is stamped into every
+    coordinated response, so mixed environments still agree on
+    transfer sizes; ``hvt.diagnostics()`` / ``GET /debugz`` show each
+    rank's view when debugging a mixed-codec gang. Distinct from
+    ``hvt.Compression`` (framework-level cast before submission):
+    wire codecs are transparent to callers and exist only on the TCP
+    links, with per-tensor error feedback compensating the
+    quantization (``HVT_ERROR_FEEDBACK``)."""
+    from horovod_tpu import compression as _compression
 
-    return "bf16" if native.wire_compression() == 1 else "none"
+    return _compression.wire_pair()

@@ -34,6 +34,14 @@ no compiler, no imports of the checked modules):
     every ``getenv("HVT_…")`` / ``os.environ[...]("HVT_…")`` read in the
     tree has a docs row, and every documented knob still has a read
     site (no ghost documentation).
+``codecs``
+    the wire-codec registry: codec ids defined once in
+    ``csrc/codecs.h`` (``HVT_WIRE_CODECS`` X-macro + the WireCodec
+    enum + ``kWireCodecCount``), the Python name table
+    (``horovod_tpu/compression`` ``CODEC_IDS`` and ``native.py``
+    ``WIRE_CODECS``) and the ``docs/performance.md`` codec table all
+    in lockstep — a drifted id would make ranks disagree on transfer
+    sizes, a drifted name would mislabel every per-codec metric.
 
 Run ``python -m horovod_tpu.tools.hvt_lint`` (all passes), optionally
 naming a subset, ``--root`` for an alternate tree (the fixture tests
@@ -54,6 +62,9 @@ from pathlib import Path
 # fixture trees with these same paths, so keep them data, not code.
 # ---------------------------------------------------------------------------
 C_API_CC = "horovod_tpu/csrc/c_api.cc"
+CODECS_H = "horovod_tpu/csrc/codecs.h"
+COMPRESSION_PY = "horovod_tpu/compression/__init__.py"
+PERFORMANCE_MD = "docs/performance.md"
 ENGINE_H = "horovod_tpu/csrc/engine.h"
 ENGINE_CC = "horovod_tpu/csrc/engine.cc"
 EVENTS_H = "horovod_tpu/csrc/events.h"
@@ -254,7 +265,8 @@ def check_slots(root: Path):
     consts = _py_literals(native, {"STATS_SCALARS", "STATS_OPS",
                                    "STATS_LAT_BUCKETS", "ABORT_CAUSES",
                                    "STATS_LANE_SLOTS",
-                                   "STATS_TAIL_SCALARS"})
+                                   "STATS_TAIL_SCALARS", "WIRE_CODECS",
+                                   "STATS_EF_SCALARS"})
     missing = [k for k in ("STATS_SCALARS", "STATS_OPS",
                            "STATS_LAT_BUCKETS", "ABORT_CAUSES")
                if k not in consts]
@@ -264,6 +276,11 @@ def check_slots(root: Path):
         return vios
     lane_slots = int(consts.get("STATS_LANE_SLOTS", 0) or 0)
     tail = list(consts.get("STATS_TAIL_SCALARS", ()) or ())
+    # per-codec byte block + EF scalars (appended after the tail
+    # scalars) — optional on the same both-sides terms as the lane
+    # block (fixture mini-trees predate the codec registry)
+    codecs = list(consts.get("WIRE_CODECS", ()) or ())
+    ef = list(consts.get("STATS_EF_SCALARS", ()) or ())
     expected = list(consts["STATS_SCALARS"])
     for grp in SLOT_OP_GROUPS:
         expected += [f"{grp}[{op}]" for op in consts["STATS_OPS"]]
@@ -277,6 +294,10 @@ def check_slots(root: Path):
         for grp in SLOT_LANE_GROUPS:
             expected += [f"{grp}[{i}]" for i in range(lane_slots)]
     expected += tail
+    for codec in codecs:
+        expected += [f"codec_tx_bytes[{codec}][{op}]"
+                     for op in consts["STATS_OPS"]]
+    expected += ef
     if names != expected:
         diffs = [i for i, (a, b) in enumerate(zip(names, expected))
                  if a != b]
@@ -296,6 +317,20 @@ def check_slots(root: Path):
     scalars = _c_int_const(c_api, "kStatsScalars")
     c_lanes = _c_int_const(engine_h, "kLaneSlots") or 0
     c_tail = _c_int_const(c_api, "kStatsTailScalars") or 0
+    codecs_h = (root / CODECS_H).read_text() \
+        if (root / CODECS_H).exists() else ""
+    c_codecs = _c_int_const(codecs_h, "kWireCodecCount") or 0
+    c_ef = _c_int_const(c_api, "kStatsEfScalars") or 0
+    if c_codecs != len(codecs):
+        vios.append(
+            f"slots: {CODECS_H} kWireCodecCount={c_codecs} but "
+            f"{NATIVE_PY} WIRE_CODECS has {len(codecs)} entries — the "
+            f"per-codec byte block would decode shifted")
+    if c_ef != len(ef):
+        vios.append(
+            f"slots: {C_API_CC} kStatsEfScalars={c_ef} but {NATIVE_PY} "
+            f"STATS_EF_SCALARS has {len(ef)} entries — the EF scalar "
+            f"block would decode shifted")
     if c_lanes != lane_slots:
         vios.append(
             f"slots: {ENGINE_H} kLaneSlots={c_lanes} but {NATIVE_PY} "
@@ -314,7 +349,8 @@ def check_slots(root: Path):
         c_count = (scalars + len(SLOT_OP_GROUPS) * ops
                    + len(SLOT_HISTS) * (lat + 1 + 2) + causes
                    + (1 + len(SLOT_LANE_GROUPS) * c_lanes
-                      if c_lanes else 0) + c_tail)
+                      if c_lanes else 0) + c_tail
+                   + c_codecs * ops + c_ef)
         if declared is not None and c_count != declared:
             vios.append(
                 f"slots: {C_API_CC}: C++ layout emits {c_count} slots "
@@ -341,6 +377,9 @@ def check_slots(root: Path):
     if lane_slots:
         claimed += ["lanes_active"] + list(SLOT_LANE_GROUPS)
     claimed += tail
+    if codecs:
+        claimed += ["codec_tx_bytes"]
+    claimed += ef
     for key in claimed:
         if f'"{key}"' not in basics:
             vios.append(
@@ -610,6 +649,107 @@ def check_env(root: Path):
 
 
 # ---------------------------------------------------------------------------
+# pass 5: wire-codec registry parity
+# ---------------------------------------------------------------------------
+
+_CODEC_ENUM_RE = re.compile(r'enum\s+class\s+WireCodec[^{]*\{(.*?)\};',
+                            re.S)
+
+
+def _doc_codec_table(perf_md: str):
+    """Backticked first-column names of the docs codec table (the
+    markdown table following the 'codec table' heading); None when the
+    heading is absent."""
+    m = re.search(r'^#+.*codec table.*$', perf_md, re.M | re.I)
+    if not m:
+        return None
+    names = []
+    for line in perf_md[m.end():].splitlines():
+        line = line.strip()
+        if names and not line.startswith("|"):
+            break
+        row = re.match(r'\|\s*`([^`]+)`\s*\|', line)
+        if row:
+            names.append(row.group(1))
+    return names
+
+
+def check_codecs(root: Path):
+    vios = []
+    have_h = (root / CODECS_H).exists()
+    have_py = (root / COMPRESSION_PY).exists()
+    if not have_h and not have_py:
+        return vios  # pre-codec-registry tree (fixture mini-trees)
+    codecs_h = _read(root, CODECS_H, vios, "codecs")
+    comp_py = _read(root, COMPRESSION_PY, vios, "codecs")
+    native = _read(root, NATIVE_PY, vios, "codecs")
+    perf_md = _read(root, PERFORMANCE_MD, vios, "codecs")
+    if None in (codecs_h, comp_py, native, perf_md):
+        return vios
+
+    # registry X-macro: ids contiguous from 0, names unique
+    rows = [(int(i), n) for i, n in _SLOT_RE.findall(codecs_h)]
+    names = [n for _, n in rows]
+    for pos, (idx, name) in enumerate(rows):
+        if idx != pos:
+            vios.append(
+                f"codecs: {CODECS_H}: codec \"{name}\" has id {idx} at "
+                f"registry position {pos} — codec ids are wire values "
+                f"and must stay contiguous from 0 (append, never "
+                f"renumber)")
+    count = _c_int_const(codecs_h, "kWireCodecCount")
+    if count != len(rows):
+        vios.append(
+            f"codecs: {CODECS_H}: kWireCodecCount={count} but the "
+            f"HVT_WIRE_CODECS registry lists {len(rows)} codecs")
+    # the enum must cover exactly the registry ids
+    em = _CODEC_ENUM_RE.search(codecs_h)
+    if not em:
+        vios.append(f"codecs: {CODECS_H}: enum class WireCodec not found")
+    else:
+        entries = [(n, int(v))
+                   for n, v in _ENUM_ENTRY_RE.findall(em.group(1))]
+        if sorted(v for _, v in entries) != list(range(len(rows))):
+            vios.append(
+                f"codecs: {CODECS_H}: WireCodec enum ids "
+                f"{sorted(v for _, v in entries)} do not cover the "
+                f"registry ids 0..{len(rows) - 1} — enum and registry "
+                f"must describe the same wire values")
+
+    # python name tables: compression.CODEC_IDS and native.WIRE_CODECS
+    ids = _py_literals(comp_py, {"CODEC_IDS"}).get("CODEC_IDS")
+    if not isinstance(ids, dict):
+        vios.append(f"codecs: {COMPRESSION_PY}: CODEC_IDS dict literal "
+                    f"not found")
+    elif ids != {n: i for i, n in enumerate(names)}:
+        vios.append(
+            f"codecs: {COMPRESSION_PY}: CODEC_IDS {ids} does not match "
+            f"the {CODECS_H} registry "
+            f"{{{', '.join(f'{n!r}: {i}' for i, n in enumerate(names))}}}"
+            f" — the Python name table would mislabel wire ids")
+    wire_codecs = list(_py_literals(native, {"WIRE_CODECS"})
+                       .get("WIRE_CODECS", ()) or ())
+    if wire_codecs != names:
+        vios.append(
+            f"codecs: {NATIVE_PY}: WIRE_CODECS {wire_codecs} does not "
+            f"match the {CODECS_H} registry {names} — per-codec stats "
+            f"would decode under the wrong labels")
+
+    # docs codec table: one row per registry codec, no stale rows
+    doc = _doc_codec_table(perf_md)
+    if doc is None:
+        vios.append(
+            f"codecs: {PERFORMANCE_MD}: no 'codec table' heading — the "
+            f"codec guide must table every registry codec")
+    elif sorted(doc) != sorted(names):
+        vios.append(
+            f"codecs: {PERFORMANCE_MD}: codec table rows {sorted(doc)} "
+            f"do not match the {CODECS_H} registry {sorted(names)} — "
+            f"add the missing row / delete the stale one")
+    return vios
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -618,6 +758,7 @@ PASSES = {
     "slots": check_slots,
     "events": check_events,
     "env": check_env,
+    "codecs": check_codecs,
 }
 
 

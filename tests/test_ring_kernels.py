@@ -1,9 +1,11 @@
-"""Process-local units for the PR-3 data-plane overhaul: ScaleBuffer
-integer rounding (via the ``hvt_scale_buffer`` test entry point), the
-extended ``hvt_engine_stats`` layout (wire byte counters + engine-side
-latency histograms), the new C API symbols, and the bridged-histogram
-``set_state`` path in the metrics registry. Gang-level behavior
-(event-driven latency, pipelined-ring numerics, bf16 wire) lives in
+"""Process-local units for the data-plane kernels: ScaleBuffer integer
+rounding (via the ``hvt_scale_buffer`` test entry point), the
+block-scaled wire codecs (``hvt_codec_roundtrip`` /
+``hvt_codec_wire_bytes`` — block independence, idempotence, exact wire
+sizes, error-feedback math), the extended ``hvt_engine_stats`` layout,
+the new C API symbols, and the bridged-histogram ``set_state`` path in
+the metrics registry. Gang-level behavior (event-driven latency,
+pipelined-ring numerics, compressed wire) lives in
 ``tests/test_data_plane.py``.
 """
 
@@ -27,7 +29,20 @@ def _lib():
     lib = ctypes.CDLL(LIB)
     lib.hvt_scale_buffer.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
                                      ctypes.c_int, ctypes.c_double]
+    lib.hvt_codec_roundtrip.argtypes = [ctypes.c_void_p,
+                                        ctypes.c_longlong, ctypes.c_int]
+    lib.hvt_codec_wire_bytes.argtypes = [ctypes.c_longlong, ctypes.c_int]
+    lib.hvt_codec_wire_bytes.restype = ctypes.c_longlong
     return lib
+
+
+def _roundtrip(arr, codec_id):
+    lib = _lib()
+    out = np.ascontiguousarray(arr, dtype=np.float32).copy()
+    rc = lib.hvt_codec_roundtrip(out.ctypes.data_as(ctypes.c_void_p),
+                                 len(out), codec_id)
+    assert rc == 0
+    return out
 
 
 def _scale(arr, factor):
@@ -94,10 +109,143 @@ def test_new_c_api_symbols_exported():
 
 
 def test_wire_compression_defaults_off():
-    assert native.wire_compression() in (0, 1)
-    # in the test session HVT_WIRE_COMPRESSION is not set → raw
+    intra, inter, auto = native.wire_compression()
+    assert 0 <= intra < len(native.WIRE_CODECS)
+    assert 0 <= inter < len(native.WIRE_CODECS)
+    # in the test session HVT_WIRE_COMPRESSION is not set → raw pair
     if not os.environ.get("HVT_WIRE_COMPRESSION"):
-        assert native.wire_compression() == 0
+        assert (intra, inter, auto) == (0, 0, False)
+
+
+def test_wire_compression_stale_so_decodes_single_mode(monkeypatch):
+    """A pre-registry .so (no hvt_codec_roundtrip export) returns the
+    single-codec mode scalar, which applied to EVERY link — it must
+    decode as (id, id), not as a packed pair that would misreport
+    inter-host traffic as raw while the old engine compresses it."""
+    class _StaleLib:
+        hvt_codec_roundtrip = None
+
+        @staticmethod
+        def hvt_wire_compression():
+            return 1  # old-world "bf16 on every link"
+
+    monkeypatch.setattr(native, "_load", lambda: _StaleLib())
+    assert native.wire_compression() == (1, 1, False)
+
+
+# ---------------------------------------------------------------- codecs
+
+
+CODECS = {"bf16": 1, "int8": 2, "fp8": 3}
+
+
+def test_codec_wire_bytes_exact():
+    lib = _lib()
+    # raw/unknown: 4 bytes per elem; bf16: 2; block codecs: 260 per
+    # 256-elem block, partial tail pays 4 + rem
+    assert lib.hvt_codec_wire_bytes(1000, 0) == 4000
+    assert lib.hvt_codec_wire_bytes(1000, 1) == 2000
+    for cid in (2, 3):
+        assert lib.hvt_codec_wire_bytes(256, cid) == 260
+        assert lib.hvt_codec_wire_bytes(512, cid) == 520
+        assert lib.hvt_codec_wire_bytes(300, cid) == 260 + 4 + 44
+        assert lib.hvt_codec_wire_bytes(1, cid) == 5
+    # the headline ratio the r09 sweep pins: ≥3.5x for int8 on fp32
+    n = 1 << 18
+    assert 4 * n / lib.hvt_codec_wire_bytes(n, 2) >= 3.5
+
+
+def test_codec_roundtrip_error_bounds():
+    rng = np.random.RandomState(7)
+    x = (rng.randn(4096).astype(np.float32)
+         * np.logspace(-2, 2, 4096).astype(np.float32))
+    for name, cid in CODECS.items():
+        y = _roundtrip(x, cid)
+        blocks = np.abs(x.reshape(-1, 256)).max(axis=1)
+        err = np.abs(y - x).reshape(-1, 256).max(axis=1)
+        # documented bounds: bf16 ~2^-8 relative, int8 blockmax/254,
+        # fp8 (e4m3) ~1/16 relative of blockmax
+        bound = {"bf16": 1 / 128, "int8": 1.01 / 254,
+                 "fp8": 1 / 14}[name]
+        assert (err <= blocks * bound + 1e-12).all(), name
+
+
+def test_codec_roundtrip_idempotent():
+    # roundtripped values lie exactly on the codec's own grid: a second
+    # roundtrip is the identity — the property that makes the engine's
+    # EF pre-quantization of inputs lossless on the first wire hop
+    rng = np.random.RandomState(11)
+    x = rng.randn(1000).astype(np.float32) * 37.5
+    for cid in CODECS.values():
+        y = _roundtrip(x, cid)
+        np.testing.assert_array_equal(_roundtrip(y, cid), y)
+
+
+def test_codec_blocks_self_contained():
+    # a 300-elem stream = one full block + a 44-elem tail; each must
+    # quantize independently (in-band scales) — the invariant chunked
+    # pipelined decode relies on
+    rng = np.random.RandomState(3)
+    x = rng.randn(300).astype(np.float32)
+    for cid in (2, 3):
+        whole = _roundtrip(x, cid)
+        np.testing.assert_array_equal(whole[:256], _roundtrip(x[:256], cid))
+        np.testing.assert_array_equal(whole[256:], _roundtrip(x[256:], cid))
+
+
+def test_codec_zero_and_constant_blocks_exact():
+    for cid in (2, 3):
+        np.testing.assert_array_equal(
+            _roundtrip(np.zeros(256, np.float32), cid), np.zeros(256))
+        # a constant block quantizes exactly (absmax maps onto the grid)
+        c = np.full(256, 3.25, np.float32)
+        np.testing.assert_array_equal(_roundtrip(c, cid), c)
+
+
+def test_codec_nonfinite_saturates_without_poisoning_block():
+    """An Inf element must not poison its block: the scale clamps to
+    FLT_MAX, so the non-finite element saturates to a large finite
+    value while its 255 finite block-mates decode ~0 — not 0·inf = NaN
+    (which error feedback would then re-add forever)."""
+    for cid in (2, 3):
+        for bad in (np.inf, -np.inf, np.nan):
+            x = np.full(256, 0.01, np.float32)
+            x[7] = bad
+            out = _roundtrip(x, cid)
+            assert np.all(np.isfinite(out)), (cid, bad)
+            # the transient stays confined to its own element
+            mates = np.delete(out, 7)
+            assert np.all(np.abs(mates) <= 0.02), (cid, bad, mates.max())
+            if np.isinf(bad):  # Inf rides the clamped FLT_MAX scale
+                assert abs(out[7]) > 1e30, (cid, bad, out[7])
+            # NaN doesn't enter the absmax (max() ignores it), so it
+            # saturates onto the block's own finite grid instead
+
+
+def test_error_feedback_unbiases_quantizer():
+    # the engine's EF recurrence, run through the real codec: with
+    # residual carry the TIME-AVERAGE of quantized outputs converges to
+    # the true value even for components far below the quantization
+    # threshold; without it they are zeroed forever
+    x = np.full(256, 0.01, np.float32)
+    x[0] = 100.0  # pins the block scale at 100/127 ≈ 0.79 ≫ 0.01
+    steps = 400
+    acc_ef = np.zeros(256)
+    r = np.zeros(256, np.float32)
+    acc_plain = np.zeros(256)
+    for _ in range(steps):
+        comp = x + r
+        q = _roundtrip(comp, 2)
+        r = comp - q
+        acc_ef += q
+        acc_plain += _roundtrip(x, 2)
+    mean_ef = acc_ef / steps
+    mean_plain = acc_plain / steps
+    # plain quantization: the small entries round to 0 every step
+    assert mean_plain[1] == 0.0
+    # EF: the running mean recovers them within a few quanta / steps
+    np.testing.assert_allclose(mean_ef[1:], 0.01, rtol=0.25)
+    np.testing.assert_allclose(mean_ef[0], 100.0, rtol=1e-3)
 
 
 def test_engine_stats_extended_layout():
@@ -154,8 +302,16 @@ def test_poll_engine_stats_emits_new_series():
                  "hvt_wire_tx_compressed_bytes_total",
                  "hvt_cycle_duration_seconds",
                  "hvt_engine_wakeup_latency_seconds",
-                 "hvt_wire_compression_mode"):
+                 "hvt_ef_residual_bytes",
+                 "hvt_ef_residuals_dropped_total"):
         assert reg.get(name) is not None, f"missing series {name}"
+    # the mode gauge is gone: per-codec labels on the tx counter
+    # replaced it (one series per (op, codec) pair)
+    assert reg.get("hvt_wire_compression_mode") is None
+    labels = {tuple(sorted(lbl.items()))
+              for lbl, _ in reg.get("hvt_wire_tx_bytes_total").samples()}
+    for codec in native.WIRE_CODECS:
+        assert (("codec", codec), ("op", "allreduce")) in labels
     # histogram bridge plumbs the engine buckets through (a live engine
     # keeps observing between the two reads, so compare with slack)
     st = native.engine_stats()
