@@ -33,6 +33,11 @@
 #                      # counters + relerr + EF convergence A/B) +
 #                      # schema --check of the fresh AND committed
 #                      # benchmarks/r09_codec_sweep.json artifacts
+#   ./ci.sh --soak     # build + the self-healing chaos campaign
+#                      # (benchmarks/soak_transient.py + the reconnect
+#                      # gang suite): seeded randomized transient
+#                      # faults over a 4-proc gang, asserting
+#                      # bit-identical results and zero aborts
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
@@ -56,6 +61,7 @@ PERFGATE=0
 REBASELINE=0
 SCALE=0
 CODEC=0
+SOAK=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
@@ -64,6 +70,7 @@ CODEC=0
 [[ "${1:-}" == "--perfgate-rebaseline" ]] && REBASELINE=1
 [[ "${1:-}" == "--scale" ]] && SCALE=1
 [[ "${1:-}" == "--codec" ]] && CODEC=1
+[[ "${1:-}" == "--soak" ]] && SOAK=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -124,6 +131,18 @@ if [[ "$CHAOS" == "1" ]]; then
   echo "=== [2/2] chaos / failure-containment suite ==="
   run_pytest tests/test_failure_containment.py -q
   echo "CI OK (chaos)"
+  exit 0
+fi
+
+if [[ "$SOAK" == "1" ]]; then
+  echo "=== [2/3] self-healing reconnect gang suite ==="
+  run_pytest tests/test_self_healing.py -q
+  echo "=== [3/3] seeded transient-fault soak ==="
+  ART=$(mktemp /tmp/hvt_soak_XXXX.json)
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    python benchmarks/soak_transient.py --rounds 4 --out "$ART"
+  echo "soak artifact: $ART"
+  echo "CI OK (soak)"
   exit 0
 fi
 

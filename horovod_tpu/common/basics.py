@@ -530,6 +530,27 @@ def poll_engine_stats(registry=None):
                              d.get("sum_ns", 0) / 1e9,
                              d.get("count", 0))
 
+    # self-healing links (csrc/transport.h): transparent reconnects by
+    # plane, plus the replay volume — a rising reconnect counter with
+    # zero aborts is a flaky fabric being absorbed; pair with the
+    # per-link state in hvt.diagnostics()/debugz to find WHICH link
+    link_rec = reg.counter(
+        "hvt_link_reconnects_total",
+        "transparent link reconnects (transient socket failures healed "
+        "by the transport layer without an abort), by link plane",
+        ("plane",))
+    lr = stats.get("link_reconnects", {})
+    for plane in native.STATS_LINK_PLANES:
+        link_rec.labels(plane=plane).set_total(lr.get(plane, 0))
+    bridge("hvt_frames_replayed_total",
+           "whole control-plane frames re-sent from the replay ring "
+           "after a link reconnect",
+           "frames_replayed")
+    bridge("hvt_link_replay_bytes_total",
+           "bytes re-sent from the per-link replay ring "
+           "(HVT_REPLAY_BUDGET_BYTES) after reconnects, both planes",
+           "replay_bytes")
+
     # error feedback: resident residual bytes + buffers the
     # HVT_EF_MAX_BYTES budget evicted/refused (a rising drop counter
     # means quantization is running uncompensated — raise the budget)

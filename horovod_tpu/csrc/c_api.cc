@@ -221,6 +221,10 @@ int hvt_engine_flags() {
 //   132    ef_residual_bytes (resident error-feedback residual bytes)
 //   133    ef_residuals_dropped (residual buffers HVT_EF_MAX_BYTES
 //          evicted or refused)
+//   134..135 link_reconnects per LinkPlane (ctrl, data): transparent
+//          self-healing reconnects — hvt_link_reconnects_total{plane}
+//   136    frames_replayed (whole control frames re-sent after heals)
+//   137    replay_bytes (replay-ring bytes re-sent after heals)
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
@@ -231,13 +235,20 @@ constexpr int kStatsScalars = 8;  // the slot-0..7 scalar block
 constexpr int kStatsTailScalars = 4;
 // error-feedback scalars appended after the per-codec byte block
 constexpr int kStatsEfScalars = 2;
+// self-healing link telemetry appended after the EF scalars: one
+// reconnect counter per LinkPlane, then the replay scalars
+constexpr int kStatsLinkPlanes = 2;
+constexpr int kStatsRecoveryScalars = 2;
+static_assert(kStatsLinkPlanes == hvt::kLinkPlanes,
+              "transport.h kLinkPlanes drifted from the stats layout");
 constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
 constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
                                 2 * kStatsHist + hvt::kAbortCauses +
                                 1 + 3 * hvt::kLaneSlots +
                                 kStatsTailScalars +
                                 hvt::kWireCodecCount * hvt::kStatsOps +
-                                kStatsEfScalars;
+                                kStatsEfScalars + kStatsLinkPlanes +
+                                kStatsRecoveryScalars;
 static_assert(kStatsSlotCount == HVT_STATS_SLOT_COUNT,
               "hvt_engine_stats layout drifted from stats_slots.h — the "
               "slot ABI is append-only: add new slots to the end of the "
@@ -289,6 +300,10 @@ int hvt_engine_stats(long long* out, int max_n) {
     v[base++] = s.codec_tx_bytes[i].load(std::memory_order_relaxed);
   v[base++] = s.ef_residual_bytes.load(std::memory_order_relaxed);
   v[base++] = s.ef_residuals_dropped.load(std::memory_order_relaxed);
+  for (int i = 0; i < hvt::kLinkPlanes; ++i)
+    v[base++] = s.link_reconnects[i].load(std::memory_order_relaxed);
+  v[base++] = s.frames_replayed.load(std::memory_order_relaxed);
+  v[base++] = s.replay_bytes.load(std::memory_order_relaxed);
   for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
   return kStatsSlotCount;
 }

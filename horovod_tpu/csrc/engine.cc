@@ -52,9 +52,20 @@ static std::string ParseAbortFrame(const std::vector<uint8_t>& f) {
 
 // HVT_FAULT_INJECT grammar (chaos harness; see docs/troubleshooting.md):
 //   kill:rank=R:after_ops=N   raise(SIGKILL) before data-plane op N+1
-//   drop_conn:rank=R[:after_ops=N]   close every engine socket (default
-//                                    after the first op)
+//   drop_conn:rank=R[:after_ops=N]   mark every engine link DEAD (the
+//                                    PERMANENT loss — escalates to the
+//                                    coordinated abort, PR 4 semantics)
 //   delay_ms:rank=R:MS        sleep MS ms before every data-plane op
+// Transient faults (the self-healing links must reconnect through
+// these with zero aborts):
+//   flaky_conn:rank=R:count=N[:after_ops=K]   N times, cut rank R's
+//       data links mid-transfer (and its upstream control link); the
+//       first cut arms after op K (default 1), repeats every 2 ops
+//   partition:hosts=A|B:ms=MS[:after_ops=K]   cut every link crossing
+//       the A|B host boundary (comma-separated host lists; matched
+//       against the rendezvous topology) and hold reconnects for MS ms
+//   reset_storm:every_ops=N[:rank=R]   every N data ops, reset one of
+//       the rank's data links (round-robin); all ranks unless rank=R
 // Specs for other ranks (or Python-level specs like after_sec, owned by
 // task_runner) are ignored here.
 static void ParseFaultInject(const std::string& spec, int my_rank,
@@ -62,7 +73,9 @@ static void ParseFaultInject(const std::string& spec, int my_rank,
   out = Engine::FaultSpec{};
   size_t p = spec.find(':');
   std::string kind = spec.substr(0, p);
-  int64_t rank = -1, after_ops = -1, bare = -1;
+  int64_t rank = -1, after_ops = -1, bare = -1, count = -1, every = -1;
+  int64_t ms = -1;
+  std::string hosts;
   bool has_after_sec = false;
   while (p != std::string::npos) {
     size_t q = spec.find(':', p + 1);
@@ -73,26 +86,63 @@ static void ParseFaultInject(const std::string& spec, int my_rank,
       rank = atoll(tok.c_str() + 5);
     else if (tok.rfind("after_ops=", 0) == 0)
       after_ops = atoll(tok.c_str() + 10);
+    else if (tok.rfind("count=", 0) == 0)
+      count = atoll(tok.c_str() + 6);
+    else if (tok.rfind("every_ops=", 0) == 0)
+      every = atoll(tok.c_str() + 10);
+    else if (tok.rfind("ms=", 0) == 0)
+      ms = atoll(tok.c_str() + 3);
+    else if (tok.rfind("hosts=", 0) == 0)
+      hosts = tok.substr(6);
     else if (tok.rfind("after_sec=", 0) == 0)
       has_after_sec = true;  // Python-level trigger (task_runner)
     else if (!tok.empty() && (isdigit(tok[0]) || tok[0] == '-'))
       bare = atoll(tok.c_str());
     p = q;
   }
-  if (rank != my_rank) return;
-  if (kind == "kill" && after_ops >= 0) {
+  if (kind == "kill" && after_ops >= 0 && rank == my_rank) {
     // after_sec-triggered kills belong to task_runner; arm here only
     // for the op-count trigger
     out.kind = Engine::FaultKind::KILL;
     out.after_ops = after_ops;
-  } else if (kind == "drop_conn" && !has_after_sec) {
+  } else if (kind == "drop_conn" && !has_after_sec && rank == my_rank) {
     out.kind = Engine::FaultKind::DROP_CONN;
     out.after_ops = after_ops >= 0 ? after_ops : 0;
-  } else if (kind == "delay_ms") {
+  } else if (kind == "delay_ms" && rank == my_rank) {
     out.kind = Engine::FaultKind::DELAY_MS;
     out.after_ops = after_ops >= 0 ? after_ops : 0;
     out.arg = bare > 0 ? bare : 0;
+  } else if (kind == "flaky_conn" && rank == my_rank) {
+    out.kind = Engine::FaultKind::FLAKY_CONN;
+    out.after_ops = after_ops >= 0 ? after_ops : 1;
+    out.count = count > 0 ? count : 1;
+  } else if (kind == "partition" && hosts.find('|') != std::string::npos) {
+    // host-based, no rank=: every rank decides its side at trigger time
+    out.kind = Engine::FaultKind::PARTITION;
+    out.after_ops = after_ops >= 0 ? after_ops : 0;
+    out.arg = ms > 0 ? ms : 0;
+    size_t bar = hosts.find('|');
+    out.hosts_a = hosts.substr(0, bar);
+    out.hosts_b = hosts.substr(bar + 1);
+  } else if (kind == "reset_storm" && every > 0 &&
+             (rank < 0 || rank == my_rank)) {
+    out.kind = Engine::FaultKind::RESET_STORM;
+    out.every_ops = every;
+    out.after_ops = -1;  // last-fired marker
   }
+}
+
+// comma-separated host-list membership (partition fault)
+static bool HostInList(const std::string& csv, const std::string& host) {
+  size_t p = 0;
+  while (p <= csv.size()) {
+    size_t q = csv.find(',', p);
+    size_t end = q == std::string::npos ? csv.size() : q;
+    if (csv.compare(p, end - p, host) == 0) return true;
+    if (q == std::string::npos) break;
+    p = q + 1;
+  }
+  return false;
 }
 
 // --------------------------------------------------------------------------
@@ -198,8 +248,24 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
       static_cast<size_t>(EnvInt("HVT_CACHE_CAPACITY", 1024)));
   autotune_.Initialize(fusion_threshold_, cycle_ms_);
   std::vector<std::string> topo_hosts(size_, "localhost");
+  // self-healing link plumbing: the hub must exist before the first
+  // TcpLink wraps a socket (links register with it); its telemetry
+  // sinks are stats fields, which outlive every link, so scrapes can
+  // never race a teardown
+  shutdown_requested_ = false;
+  hub_.Reset();
+  hub_.my_rank = rank_;
+  hub_.reconnects = stats_.link_reconnects;
+  hub_.frames_replayed = &stats_.frames_replayed;
+  hub_.replay_bytes = &stats_.replay_bytes;
+  hub_.events = &events_;
+  hub_.stop = &shutdown_requested_;
+  // abort sniffing: sibling sweeps peek queued control frames for this
+  // bit so a rank stuck reconnecting joins a gang teardown immediately
+  hub_.abort_flag = kAbortFrameFlag;
   try {
     if (size_ > 1) {
+      data_listener_.Close();
       data_listener_.Listen(0);
       const char* host_env = getenv("HVT_HOSTNAME");
       std::string my_host = host_env ? host_env : "127.0.0.1";
@@ -215,40 +281,57 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
       // gloo_context.cc)
       std::vector<std::string> endpoints(size_);
       if (rank_ == 0) {
-        Listener control_listener;
-        control_listener.Listen(master_port);
+        // the control listener is a MEMBER and stays open for the
+        // engine's lifetime: a worker link that drops re-dials the
+        // master port and rank 0 re-accepts here (transport.h)
+        control_listener_.Close();
+        control_listener_.Listen(master_port);
         endpoints[0] = my_ep;
         topo_hosts[0] = my_topo;
-        workers_.resize(size_);
+        std::vector<Sock> raw(size_);
         for (int i = 0; i < size_ - 1; ++i) {
-          Sock s = control_listener.Accept();
+          Sock s = control_listener_.Accept();
           auto frame = s.RecvFrame();
           Reader rd(frame);
           int32_t r = rd.i32();
           endpoints[r] = rd.str();
           topo_hosts[r] = rd.str();
-          workers_[r] = std::move(s);
+          raw[r] = std::move(s);
         }
         Writer w;
         for (auto& ep : endpoints) w.str(ep);
         for (auto& th : topo_hosts) w.str(th);
-        for (int r = 1; r < size_; ++r) workers_[r].SendFrame(w.buf);
+        for (int r = 1; r < size_; ++r) raw[r].SendFrame(w.buf);
+        // wrap into self-healing links AFTER the rendezvous exchange —
+        // both ends wrap at the same stream position, so the replay
+        // sequence numbers agree from byte 0
+        workers_.clear();
+        workers_.resize(static_cast<size_t>(size_));
+        for (int r = 1; r < size_; ++r)
+          workers_[static_cast<size_t>(r)] = std::make_unique<TcpLink>(
+              std::move(raw[static_cast<size_t>(r)]), LinkPlane::CTRL,
+              r, &hub_, "", 0, &control_listener_);
       } else {
-        control_ = Sock::Connect(master_addr, master_port);
+        Sock c = Sock::Connect(master_addr, master_port);
         Writer w;
         w.i32(rank_);
         w.str(my_ep);
         w.str(my_topo);
-        control_.SendFrame(w.buf);
-        auto frame = control_.RecvFrame();
+        c.SendFrame(w.buf);
+        auto frame = c.RecvFrame();
         Reader rd(frame);
         for (auto& ep : endpoints) ep = rd.str();
         for (auto& th : topo_hosts) th = rd.str();
+        // workers re-DIAL the master port when the link drops
+        control_ = std::make_unique<TcpLink>(std::move(c),
+                                             LinkPlane::CTRL, 0, &hub_,
+                                             master_addr, master_port);
       }
-
       // full data mesh: i connects to j for i < j; acceptor learns the
-      // peer's rank from a 4-byte hello
-      std::vector<Sock> peers(size_);
+      // peer's rank from a 4-byte hello. Each socket is wrapped into a
+      // TcpLink with the same dial/accept role for reconnects (the
+      // data listener stays open for the engine's lifetime).
+      std::vector<std::unique_ptr<Transport>> peers(size_);
       int to_accept = rank_;  // ranks below me dial in
       for (int j = rank_ + 1; j < size_; ++j) {
         auto pos = endpoints[j].rfind(':');
@@ -257,13 +340,16 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
         Sock s = Sock::Connect(host, port);
         int32_t me = rank_;
         s.SendAll(&me, 4);
-        peers[j] = std::move(s);
+        peers[static_cast<size_t>(j)] = std::make_unique<TcpLink>(
+            std::move(s), LinkPlane::DATA, j, &hub_, host, port);
       }
       for (int k = 0; k < to_accept; ++k) {
         Sock s = data_listener_.Accept();
         int32_t who = -1;
         s.RecvAll(&who, 4);
-        peers[who] = std::move(s);
+        peers[static_cast<size_t>(who)] = std::make_unique<TcpLink>(
+            std::move(s), LinkPlane::DATA, who, &hub_, "", 0,
+            &data_listener_);
       }
       data_ = std::make_unique<DataPlane>(rank_, size_, std::move(peers));
 
@@ -275,7 +361,8 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
         for (int r = 1; r < size_; ++r) ctrl_children_.push_back(r);
       }
     } else {
-      data_ = std::make_unique<DataPlane>(0, 1, std::vector<Sock>{});
+      data_ = std::make_unique<DataPlane>(
+          0, 1, std::vector<std::unique_ptr<Transport>>{});
     }
   } catch (const std::exception& e) {
     return Status::Error(std::string("hvt init failed: ") + e.what());
@@ -379,13 +466,16 @@ void Engine::Shutdown() {
   queue_cv_.notify_all();
   if (thread_.joinable()) thread_.join();
   workers_.clear();
-  control_.Close();
-  tree_parent_.Close();
+  control_.reset();
+  tree_parent_.reset();
   tree_child_socks_.clear();
   ctrl_children_.clear();
   backends_.clear();  // before data_: backends hold raw DataPlane*
   data_.reset();
   data_listener_.Close();
+  control_listener_.Close();
+  tree_listener_.Close();
+  hub_.Reset();  // parked reconnect dials die with the run
   initialized_ = false;
   timeline_.Shutdown();
   // reset engine-thread state for a potential re-init (elastic restart)
@@ -608,24 +698,33 @@ void Engine::EnterBroken(int cause, const std::string& why) {
   // cycle. The one slower path: a tree member already BLOCKED on a
   // wedged-but-alive leader converges at its own control deadline
   // (heartbeat/op timeout) — still bounded, one deadline not N.
+  // Stop the healing machinery FIRST: reconnect attempts refuse
+  // (hub_.closed) and the listeners close, so a peer's re-dial to this
+  // deliberately-aborting rank is REFUSED instantly — an aborting rank
+  // must look dead, not flaky, or survivors would burn their retry
+  // window before converging on the PR 4 clock.
+  hub_.closed.store(true);
+  data_listener_.Close();
+  control_listener_.Close();
+  tree_listener_.Close();
   auto frame = BuildAbortFrame(rank_, why);
-  auto try_send = [&](const Sock& s) {
-    if (!s.valid()) return;
+  auto try_send = [&](TcpLink* s) {
+    if (!s || !s->valid()) return;
     try {
-      s.SendFrame(frame, 1000);
+      s->SendFrame(frame, 1000);
     } catch (const std::exception&) {
     }
   };
   if (rank_ == 0) {
     for (int r = 1; r < size_; ++r)
-      try_send(workers_[static_cast<size_t>(r)]);
+      try_send(workers_[static_cast<size_t>(r)].get());
   } else {
-    try_send(control_);
-    try_send(tree_parent_);
+    try_send(control_.get());
+    try_send(tree_parent_.get());
   }
   for (auto& [child, sock] : tree_child_socks_) {
     (void)child;
-    try_send(sock);
+    try_send(sock.get());
   }
   // Close the data mesh: peers blocked mid-collective on a socket to
   // this rank wake with PeerLostError immediately (FIN from Close), so
@@ -633,6 +732,11 @@ void Engine::EnterBroken(int cause, const std::string& why) {
   if (data_) data_->Abort();
   FailAll("hvt engine aborted (" + std::string(AbortCauseName(cause)) +
           "): " + why);
+}
+
+void Engine::CutLinksToRank(int r) {
+  for (TcpLink* l : hub_.links)
+    if (l->peer_rank() == r) l->InjectCutNow();
 }
 
 void Engine::MaybeInjectFault() {
@@ -648,17 +752,20 @@ void Engine::MaybeInjectFault() {
       }
       break;
     case FaultKind::DROP_CONN:
+      // PERMANENT loss (PR 4 semantics): links go DEAD — the next I/O
+      // escalates straight into the coordinated abort, no reconnect
       if (ops > fault_.after_ops) {
         HVT_LOG(WARNING, rank_)
             << "HVT_FAULT_INJECT: dropping all engine connections";
         fault_ = FaultSpec{};  // fire once
         if (data_) data_->Abort();
-        control_.Close();
-        for (auto& s : workers_) s.Close();
-        tree_parent_.Close();
+        if (control_) control_->Abort();
+        for (auto& s : workers_)
+          if (s) s->Abort();
+        if (tree_parent_) tree_parent_->Abort();
         for (auto& [child, s] : tree_child_socks_) {
           (void)child;
-          s.Close();
+          s->Abort();
         }
       }
       break;
@@ -666,6 +773,80 @@ void Engine::MaybeInjectFault() {
       if (ops > fault_.after_ops && fault_.arg > 0)
         std::this_thread::sleep_for(
             std::chrono::milliseconds(fault_.arg));
+      break;
+    case FaultKind::FLAKY_CONN:
+      // TRANSIENT: arm a mid-transfer cut on every data link (the
+      // socket closes after 8 KB more tx — genuinely mid-collective)
+      // and reset the upstream control link; the self-healing layer
+      // reconnects + replays, and the collective completes
+      // bit-identically with zero aborts.
+      if (ops > fault_.after_ops && fault_.count > 0) {
+        HVT_LOG(WARNING, rank_)
+            << "HVT_FAULT_INJECT: flaky_conn cut (" << fault_.count
+            << " left)";
+        fault_.count--;
+        fault_.after_ops = ops + 2;  // space successive injections
+        for (TcpLink* l : hub_.links)
+          if (l->plane() == LinkPlane::DATA) {
+            l->InjectCutAfter(8192);
+            // rx-side cut too: closing with unread kernel-buffered
+            // data forces the peer through the replay ring
+            l->InjectCutAfterRx(8192);
+          }
+        // cut the live upstream control link: control_ for star
+        // workers AND tree leaders (their parent link to rank 0),
+        // tree_parent_ for members. A tree MEMBER's control_ is the
+        // reconnect-disabled parked side channel — cutting it would
+        // just retire it, not exercise a heal.
+        if (control_ && (!tree_mode_ || ctrl_role_ == CtrlRole::LEADER))
+          control_->InjectCutNow();
+        if (tree_parent_) tree_parent_->InjectCutNow();
+      }
+      break;
+    case FaultKind::PARTITION:
+      // TRANSIENT: cut every link crossing the A|B host boundary and
+      // hold reconnects for ms=MS — heals by itself afterwards.
+      if (ops > fault_.after_ops) {
+        const std::string& my_host =
+            topo_.host_of_rank[static_cast<size_t>(rank_)];
+        int side = HostInList(fault_.hosts_a, my_host)   ? 0
+                   : HostInList(fault_.hosts_b, my_host) ? 1
+                                                         : -1;
+        if (side >= 0) {
+          const std::string& other =
+              side == 0 ? fault_.hosts_b : fault_.hosts_a;
+          HVT_LOG(WARNING, rank_)
+              << "HVT_FAULT_INJECT: partitioning away from hosts "
+              << other << " for " << fault_.arg << " ms";
+          hub_.hold_until_ms = NowMs() + fault_.arg;
+          for (int r = 0; r < size_; ++r)
+            if (r != rank_ &&
+                HostInList(other,
+                           topo_.host_of_rank[static_cast<size_t>(r)]))
+              CutLinksToRank(r);
+        }
+        fault_ = FaultSpec{};  // fire once
+      }
+      break;
+    case FaultKind::RESET_STORM:
+      // TRANSIENT: every_ops data ops, reset ONE data link
+      // (round-robin) — a sustained connection-churn soak.
+      if (fault_.every_ops > 0 && ops > 0 &&
+          ops % fault_.every_ops == 0 && ops != fault_.after_ops) {
+        fault_.after_ops = ops;  // last-fired marker
+        std::vector<TcpLink*> dl;
+        for (TcpLink* l : hub_.links)
+          if (l->plane() == LinkPlane::DATA && l->valid())
+            dl.push_back(l);
+        if (!dl.empty()) {
+          size_t pick = static_cast<size_t>(ops / fault_.every_ops) %
+                        dl.size();
+          HVT_LOG(WARNING, rank_)
+              << "HVT_FAULT_INJECT: reset_storm cutting data link to "
+              << "rank " << dl[pick]->peer_rank();
+          dl[pick]->InjectCutNow();
+        }
+      }
       break;
     case FaultKind::NONE:
       break;
@@ -732,26 +913,29 @@ void Engine::SetupTreeControl(
   }
 
   // leader control ports travel over the star: gather at rank 0, then
-  // broadcast the full rank→port table
-  Listener ctrl_listener;
+  // broadcast the full rank→port table. The leader listener is a
+  // MEMBER (tree_listener_) and stays open so a dropped member link
+  // can re-accept — the "leader re-accept" leg of the self-healing
+  // control plane.
   bool listening = ctrl_role_ == CtrlRole::LEADER && !my_members.empty();
-  if (listening) ctrl_listener.Listen(0);
+  tree_listener_.Close();
+  if (listening) tree_listener_.Listen(0);
   std::vector<int32_t> ctrl_ports(size_, 0);
   if (rank_ == 0) {
     for (int r = 1; r < size_; ++r) {
-      auto frame = workers_[static_cast<size_t>(r)].RecvFrame();
+      auto frame = workers_[static_cast<size_t>(r)]->RecvFrame();
       Reader rd(frame);  // Reader holds a reference — keep frame alive
       ctrl_ports[static_cast<size_t>(r)] = rd.i32();
     }
     Writer w;
     for (auto p : ctrl_ports) w.i32(p);
     for (int r = 1; r < size_; ++r)
-      workers_[static_cast<size_t>(r)].SendFrame(w.buf);
+      workers_[static_cast<size_t>(r)]->SendFrame(w.buf);
   } else {
     Writer w;
-    w.i32(listening ? static_cast<int32_t>(ctrl_listener.port()) : 0);
-    control_.SendFrame(w.buf);
-    auto frame = control_.RecvFrame();
+    w.i32(listening ? static_cast<int32_t>(tree_listener_.port()) : 0);
+    control_->SendFrame(w.buf);
+    auto frame = control_->RecvFrame();
     Reader rd(frame);  // see above
     for (auto& p : ctrl_ports) p = rd.i32();
   }
@@ -759,17 +943,34 @@ void Engine::SetupTreeControl(
   if (ctrl_role_ == CtrlRole::MEMBER) {
     const std::string& ep = endpoints[static_cast<size_t>(my_leader)];
     std::string host = ep.substr(0, ep.rfind(':'));
-    tree_parent_ = Sock::Connect(
-        host, ctrl_ports[static_cast<size_t>(my_leader)]);
+    int lport = ctrl_ports[static_cast<size_t>(my_leader)];
+    Sock raw = Sock::Connect(host, lport);
     int32_t me = rank_;
-    tree_parent_.SendAll(&me, 4);
+    raw.SendAll(&me, 4);
+    tree_parent_ = std::make_unique<TcpLink>(
+        std::move(raw), LinkPlane::CTRL, my_leader, &hub_, host, lport);
   } else if (listening) {
     for (size_t k = 0; k < my_members.size(); ++k) {
-      Sock s = ctrl_listener.Accept();
+      Sock s = tree_listener_.Accept();
       int32_t who = -1;
       s.RecvAll(&who, 4);
-      tree_child_socks_[who] = std::move(s);
+      tree_child_socks_[who] = std::make_unique<TcpLink>(
+          std::move(s), LinkPlane::CTRL, who, &hub_, "", 0,
+          &tree_listener_);
     }
+  }
+
+  // Parked star links carry nothing but root-abort frames after this
+  // point: a drop there must NOT spin up a reconnect against a peer
+  // that will never handshake mid-cycle — the link is quietly retired
+  // instead (the leader path still reaches every member).
+  if (rank_ == 0) {
+    std::set<int> kids(ctrl_children_.begin(), ctrl_children_.end());
+    for (int r = 1; r < size_; ++r)
+      if (!kids.count(r) && workers_[static_cast<size_t>(r)])
+        workers_[static_cast<size_t>(r)]->SetReconnect(false);
+  } else if (ctrl_role_ == CtrlRole::MEMBER && control_) {
+    control_->SetReconnect(false);
   }
 }
 
@@ -959,8 +1160,12 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     return !a.hits.empty() || !a.invalids.empty() || !a.reqs.empty();
   };
   // deadline-bounded control recv: heartbeat pace when idle, op
-  // deadline when work is outstanding — classified per peer
-  auto recv_ctrl = [&](const Sock& s, int64_t ctl_ms, bool idle,
+  // deadline when work is outstanding — classified per peer. A
+  // transient drop heals INSIDE RecvFrame (the self-healing link
+  // reconnects + replays); only an escalated loss surfaces here, and
+  // its reason (retry budget, replay budget, peer dead) rides along
+  // into the abort.
+  auto recv_ctrl = [&](TcpLink& s, int64_t ctl_ms, bool idle,
                        const std::string& who) {
     try {
       auto frame = s.RecvFrame(ctl_ms);
@@ -978,8 +1183,9 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
       throw OpTimeoutError("no control frame from " + who + " within " +
                            std::to_string(ctl_ms) +
                            " ms (HVT_OP_TIMEOUT_MS)");
-    } catch (const PeerLostError&) {
-      throw PeerLostError("control connection to " + who + " lost");
+    } catch (const PeerLostError& e) {
+      throw PeerLostError("control connection to " + who + " lost (" +
+                          e.what() + ")");
     }
   };
 
@@ -1010,7 +1216,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     bool idle = pending_.empty() && !join_pending_ && counts_.empty();
     int64_t ctl_ms = ControlTimeoutMs(idle);
     for (int child : ctrl_children_) {
-      auto frame = recv_ctrl(workers_[static_cast<size_t>(child)],
+      auto frame = recv_ctrl(*workers_[static_cast<size_t>(child)],
                              ctl_ms, idle,
                              "rank " + std::to_string(child));
       if (IsAbortFrame(frame))
@@ -1078,7 +1284,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
       EncodeResponseList(out, responses);
     }
     for (int child : ctrl_children_)
-      workers_[static_cast<size_t>(child)].SendFrame(out.buf);
+      workers_[static_cast<size_t>(child)]->SendFrame(out.buf);
     ctl_tx += (static_cast<int64_t>(out.buf.size()) +
                kFramePrefixBytes) *
               static_cast<int64_t>(ctrl_children_.size());
@@ -1095,7 +1301,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     std::vector<Announce> anns;
     bool subtree_payload = did_negotiate;
     for (int child : ctrl_children_) {
-      auto frame = recv_ctrl(tree_child_socks_[child], ctl_ms, idle,
+      auto frame = recv_ctrl(*tree_child_socks_[child], ctl_ms, idle,
                              "member rank " + std::to_string(child));
       if (IsAbortFrame(frame))
         throw RemoteAbortError(ParseAbortFrame(frame));
@@ -1109,17 +1315,17 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     Writer agg;
     EncodeAggregateFrame(agg, anns);
     ctl_tx += static_cast<int64_t>(agg.buf.size()) + kFramePrefixBytes;
-    control_.SendFrame(agg.buf);
+    control_->SendFrame(agg.buf);
     // a busy subtree keeps the response wait on the op deadline even
     // when this leader itself has nothing outstanding
     bool up_idle = idle && !subtree_payload;
-    auto frame = recv_ctrl(control_, ControlTimeoutMs(up_idle), up_idle,
+    auto frame = recv_ctrl(*control_, ControlTimeoutMs(up_idle), up_idle,
                            "rank 0 (coordinator)");
     if (IsAbortFrame(frame))
       throw RemoteAbortError(ParseAbortFrame(frame));
     ctl_rx += static_cast<int64_t>(frame.size()) + kFramePrefixBytes;
     for (int child : ctrl_children_)
-      tree_child_socks_[child].SendFrame(frame);
+      tree_child_socks_[child]->SendFrame(frame);
     ctl_tx += (static_cast<int64_t>(frame.size()) + kFramePrefixBytes) *
               static_cast<int64_t>(ctrl_children_.size());
     did_negotiate = subtree_payload;
@@ -1128,7 +1334,7 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     // member: one announce up (a bitmask vote when the cycle is pure
     // cache hits), one response frame down. The upstream peer is the
     // host leader in tree mode, rank 0 in star mode.
-    const Sock& up = tree_mode_ ? tree_parent_ : control_;
+    TcpLink& up = tree_mode_ ? *tree_parent_ : *control_;
     const std::string peer =
         tree_mode_ ? "the host leader" : "rank 0 (coordinator)";
     // Tree members park their star socket after init; the only frame
@@ -1137,13 +1343,20 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     // when its leader is wedged (stalled, not dead — a dead leader's
     // FIN surfaces through tree_parent_ immediately). A member already
     // blocked waiting on a wedged leader converges at its own control
-    // deadline instead.
-    if (tree_mode_ && control_.valid()) {
-      struct pollfd pd {control_.fd(), POLLIN, 0};
+    // deadline instead. The parked link is reconnect-disabled (see
+    // SetupTreeControl): a drop here retires the side channel quietly
+    // rather than spinning a reconnect nobody will answer.
+    if (tree_mode_ && control_ && control_->valid() &&
+        control_->fd() >= 0) {
+      struct pollfd pd {control_->fd(), POLLIN, 0};
       if (::poll(&pd, 1, 0) > 0) {
-        auto f = control_.RecvFrame(1000);
-        if (IsAbortFrame(f))
-          throw RemoteAbortError(ParseAbortFrame(f));
+        try {
+          auto f = control_->RecvFrame(1000);
+          if (IsAbortFrame(f))
+            throw RemoteAbortError(ParseAbortFrame(f));
+        } catch (const PeerLostError&) {
+          control_->Abort();  // side channel gone; leader path remains
+        }
       }
     }
     Writer w;
@@ -2170,6 +2383,14 @@ void Engine::UpdateDiag() {
       d.negotiations.push_back(std::move(n));
     }
   }
+  // per-link health (transport.h): a flapping link shows up here
+  // (state/retries/seconds-in-state) before it ever becomes an abort
+  for (TcpLink* l : hub_.links)
+    d.links.push_back(DiagLink{l->peer_rank(),
+                               static_cast<int>(l->plane()),
+                               static_cast<int>(l->state()),
+                               l->retries(), l->epoch(),
+                               now - l->state_since_sec()});
   d.stall_warn_sec = stall_warn_sec_;
   d.updated_sec = now;
   MutexLock lk(diag_mu_);
@@ -2245,6 +2466,20 @@ std::string Engine::DiagnosticsJson() {
     snprintf(num, sizeof(num), "%.3f", d.pending[i].age_sec);
     out += std::string("\",\"age_sec\":") + num;
     out += ",\"lane\":" + std::to_string(d.pending[i].lane) + "}";
+  }
+  out += "],\"links\":[";
+  for (size_t i = 0; i < d.links.size(); ++i) {
+    const auto& l = d.links[i];
+    if (i) out += ',';
+    out += "{\"peer\":" + std::to_string(l.peer);
+    out += std::string(",\"plane\":\"") +
+           LinkPlaneName(static_cast<LinkPlane>(l.plane)) + "\"";
+    out += std::string(",\"state\":\"") +
+           LinkStateName(static_cast<LinkState>(l.state)) + "\"";
+    out += ",\"retries\":" + std::to_string(l.retries);
+    out += ",\"epoch\":" + std::to_string(l.epoch);
+    snprintf(num, sizeof(num), "%.3f", l.in_state_sec);
+    out += std::string(",\"in_state_sec\":") + num + "}";
   }
   out += "],\"negotiations\":[";
   // stalls = negotiations past the warn threshold; emitted as a separate

@@ -57,6 +57,7 @@
 #include "net.h"
 #include "ring_ops.h"
 #include "timeline.h"
+#include "transport.h"
 #include "wire.h"
 
 namespace hvt {
@@ -250,6 +251,13 @@ struct EngineStats {
   // admit them (counter)
   std::atomic<int64_t> ef_residual_bytes{0};
   std::atomic<int64_t> ef_residuals_dropped{0};
+  // self-healing links (transport.h): transparent reconnects per plane
+  // (hvt_link_reconnects_total{plane}), whole control frames re-sent
+  // after a reconnect, and total replay-ring bytes re-sent. Owned here
+  // (like the wire counters) so scrapes never race link teardown.
+  std::atomic<int64_t> link_reconnects[kLinkPlanes]{};
+  std::atomic<int64_t> frames_replayed{0};
+  std::atomic<int64_t> replay_bytes{0};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -279,6 +287,9 @@ struct EngineStats {
     for (auto& c : codec_tx_bytes) c = 0;
     ef_residual_bytes = 0;
     ef_residuals_dropped = 0;
+    for (auto& l : link_reconnects) l = 0;
+    frames_replayed = 0;
+    replay_bytes = 0;
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -313,12 +324,25 @@ struct DiagPending {
                  // replica's lane is wedged
 };
 
+// Per-link health for hvt.diagnostics() / GET /debugz: a flapping link
+// is visible (state, retry count, seconds-in-state) BEFORE it turns
+// into an abort.
+struct DiagLink {
+  int peer = -1;
+  int plane = 0;      // LinkPlane wire id (0 ctrl, 1 data)
+  int state = 0;      // LinkState wire id
+  int retries = 0;    // dial retries of the current/last episode
+  int64_t epoch = 0;  // session epoch (one bump per successful heal)
+  double in_state_sec = 0;
+};
+
 struct DiagState {
   bool valid = false;
   int64_t cycles = 0;
   int queue_depth = 0;           // undrained client submissions
   std::vector<DiagPending> pending;
   std::vector<DiagNegotiation> negotiations;  // rank 0 only
+  std::vector<DiagLink> links;
   double stall_warn_sec = 60.0;
   double updated_sec = 0;
 };
@@ -328,11 +352,20 @@ class Engine {
   static Engine& Get();
 
   // HVT_FAULT_INJECT (chaos harness) — parsed at Init for this rank.
-  enum class FaultKind { NONE, KILL, DROP_CONN, DELAY_MS };
+  // KILL/DROP_CONN/DELAY_MS are the PR 4 hard faults (drop_conn marks
+  // links DEAD — the permanent-loss baseline); FLAKY_CONN, PARTITION
+  // and RESET_STORM are TRANSIENT: they cut sockets the self-healing
+  // links are expected to reconnect through with zero aborts.
+  enum class FaultKind {
+    NONE, KILL, DROP_CONN, DELAY_MS, FLAKY_CONN, PARTITION, RESET_STORM
+  };
   struct FaultSpec {
     FaultKind kind = FaultKind::NONE;
     int64_t after_ops = 0;
-    int64_t arg = 0;
+    int64_t arg = 0;        // delay_ms: MS; partition: ms=MS hold
+    int64_t count = 0;      // flaky_conn: injections remaining
+    int64_t every_ops = 0;  // reset_storm: period
+    std::string hosts_a, hosts_b;  // partition: the two host groups
   };
 
   Status Init(int rank, int size, const std::string& master_addr,
@@ -424,6 +457,9 @@ class Engine {
       EXCLUDES(broken_mu_, queue_mu_, handles_mu_);
   // HVT_FAULT_INJECT hook, called once per data-plane response.
   void MaybeInjectFault();
+  // Transiently cut every link whose peer is `r` (chaos helper: the
+  // links stay HEALTHY and reconnect on their next use).
+  void CutLinksToRank(int r);
   // Control-plane recv deadline: HVT_HEARTBEAT_MS when this side is
   // idle (frames are then pure keepalives), HVT_OP_TIMEOUT_MS when
   // work is outstanding.
@@ -505,19 +541,24 @@ class Engine {
   // the ring fallback accepts everything)
   CollectiveBackend* PickBackend(const Response& resp, int64_t total_elems);
 
-  // control plane
-  Sock control_;                 // workers: connection to rank 0
-  std::vector<Sock> workers_;    // rank 0: connections from workers
+  // control plane — self-healing links (transport.h). Dial roles match
+  // the original rendezvous: workers/members dial, rank 0 / leaders
+  // keep their listeners open for reconnect re-accepts.
+  LinkPtr control_;              // workers: link to rank 0
+  std::vector<LinkPtr> workers_; // rank 0: links from workers
   // hierarchical control plane (HVT_CTRL_TOPOLOGY=tree)
   bool tree_mode_ = false;
   bool ctrl_bypass_ = true;      // HVT_CTRL_BYPASS (0 → always full
                                  // frames; parity/debug baseline)
   CtrlRole ctrl_role_ = CtrlRole::ROOT;
-  std::vector<int> ctrl_children_;        // root: leaders; leader: members
-  std::map<int, Sock> tree_child_socks_;  // leader: member connections
-  Sock tree_parent_;                      // member: connection to leader
+  std::vector<int> ctrl_children_;         // root: leaders; leader: members
+  std::map<int, LinkPtr> tree_child_socks_;  // leader: member links
+  LinkPtr tree_parent_;                    // member: link to leader
   std::unique_ptr<DataPlane> data_;
   Listener data_listener_;
+  Listener control_listener_;    // rank 0: stays open for ctrl re-accepts
+  Listener tree_listener_;       // tree leaders: member re-accepts
+  ReconnectHub hub_;             // shared reconnect state + link registry
   // ordered backend list (reference operations.cc:142-249); built at Init
   std::vector<std::unique_ptr<CollectiveBackend>> backends_;
   // global TENSOR-response counter (identical stream on every rank);

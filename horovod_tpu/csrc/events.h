@@ -64,6 +64,13 @@ enum class EventKind : int32_t {
                         // ring step / pairwise exchange): arg2 = bytes
                         // this pump will move (tx + rx), lane = LaneSlot
   WIRE_END = 14,        // matching end; arg2 = bytes moved
+  RECONNECT = 15,       // a link healed (transport.h): name = "rank R"
+                        // (the peer), op = LinkPlane (0 ctrl, 1 data),
+                        // arg = dial retries used, arg2 = time spent in
+                        // RECONNECTING (µs) — the stall the heal cost
+  REPLAY = 16,          // frames/bytes re-sent after a reconnect:
+                        // name/op as RECONNECT, arg = whole control
+                        // frames replayed, arg2 = bytes replayed
 };
 
 // POD view of one event — mirrored field-for-field by the ctypes

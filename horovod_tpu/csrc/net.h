@@ -8,6 +8,7 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -238,6 +239,54 @@ class Sock {
     return b;
   }
 
+  // Single connect attempt with a short bounded wait (nonblocking
+  // connect + poll) — the reconnect engine's dial primitive. Returns an
+  // invalid Sock on any failure (refused, timeout, resolve error); the
+  // caller owns the retry/backoff policy, unlike Connect below which
+  // retries internally for the whole rendezvous budget.
+  static Sock DialOnce(const std::string& host, int port,
+                       int timeout_ms = 1000) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    std::string p = std::to_string(port);
+    if (getaddrinfo(host.c_str(), p.c_str(), &hints, &res) != 0 || !res)
+      return Sock();
+    int fd = ::socket(res->ai_family, res->ai_socktype,
+                      res->ai_protocol);
+    if (fd < 0) {
+      freeaddrinfo(res);
+      return Sock();
+    }
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+    freeaddrinfo(res);
+    if (rc != 0) {
+      if (errno != EINPROGRESS) {
+        ::close(fd);
+        return Sock();
+      }
+      struct pollfd pd {fd, POLLOUT, 0};
+      if (::poll(&pd, 1, timeout_ms) <= 0) {
+        ::close(fd);
+        return Sock();
+      }
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0) {
+        ::close(fd);
+        return Sock();
+      }
+    }
+    fcntl(fd, F_SETFL, fl);  // back to blocking for the Sock contract
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    ConfigureSockBufs(fd);
+    return Sock(fd);
+  }
+
   static Sock Connect(const std::string& host, int port,
                       int timeout_sec = 60) {
     // HVT_CONNECT_TIMEOUT (seconds) overrides the caller's budget —
@@ -320,6 +369,21 @@ class Listener {
     socklen_t len = sizeof(addr);
     getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len);
     port_ = ntohs(addr.sin_port);
+  }
+  // Bounded single accept for the reconnect engine: returns an invalid
+  // Sock when nothing dialed in within timeout_ms (never throws on
+  // timeout — the caller owns the episode budget).
+  Sock TryAccept(int timeout_ms) const {
+    if (fd_ < 0) return Sock();
+    struct pollfd pd {fd_, POLLIN, 0};
+    int rc = ::poll(&pd, 1, timeout_ms);
+    if (rc <= 0) return Sock();
+    int c = ::accept(fd_, nullptr, nullptr);
+    if (c < 0) return Sock();
+    int one = 1;
+    setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    ConfigureSockBufs(c);
+    return Sock(c);
   }
   Sock Accept(int timeout_sec = 60) const {
     // bounded like Connect (HVT_CONNECT_TIMEOUT): a peer that never

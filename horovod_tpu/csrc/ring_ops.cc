@@ -266,7 +266,8 @@ void ScaleBuffer(void* dst, int64_t count, DataType dtype, double factor) {
 
 // ---- transport pump --------------------------------------------------------
 
-DataPlane::DataPlane(int rank, int size, std::vector<Sock> peers)
+DataPlane::DataPlane(int rank, int size,
+                     std::vector<std::unique_ptr<Transport>> peers)
     : rank_(rank), size_(size), peers_(std::move(peers)) {
   pipeline_ = EnvInt("HVT_RING_PIPELINE", 1) != 0;
   // 1 MB default: measured sweet spot on loopback gangs — small enough
@@ -276,9 +277,9 @@ DataPlane::DataPlane(int rank, int size, std::vector<Sock> peers)
   if (chunk_bytes_ < 64) chunk_bytes_ = 64;
 }
 
-void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
-                       Sock& in, uint8_t* recv_buf, size_t recv_n,
-                       size_t chunk_bytes, WireCodec codec,
+void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
+                       size_t send_n, Transport& in, uint8_t* recv_buf,
+                       size_t recv_n, size_t chunk_bytes, WireCodec codec,
                        const std::function<void(size_t, size_t)>& on_chunk) {
   size_t sent = 0, rcvd = 0, notified = 0;
   auto flush_chunks = [&] {
@@ -304,6 +305,20 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
     events_->Record(EventKind::WIRE_BEGIN, wire_name_, stat_op_, 0,
                     wire_bytes, wire_lane_);
   while (sent < send_n || rcvd < recv_n) {
+    // a link mid-reconnect reports fd < 0: drive its Some() op directly
+    // (the call heals the link or escalates) instead of parking an
+    // incomplete direction outside the poll set. A heal can take whole
+    // seconds, so the progress deadline re-arms — the transfer itself
+    // made none, but the link just proved the peer alive.
+    if (sent < send_n && out.fd() < 0) {
+      sent += out.SendSome(send_buf + sent, send_n - sent);
+      if (deadline >= 0) deadline = NowMs() + timeout_ms;
+    }
+    if (rcvd < recv_n && in.fd() < 0) {
+      rcvd += in.RecvSome(recv_buf + rcvd,
+                          std::min(recv_n - rcvd, 2 * chunk_bytes));
+      if (deadline >= 0) deadline = NowMs() + timeout_ms;
+    }
     struct pollfd fds[2];
     // a COMPLETED direction is masked with fd = -1 (poll ignores
     // negative fds) — events = 0 would not suppress POLLERR/POLLHUP,
@@ -324,11 +339,24 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
             std::to_string(timeout_ms) + " ms (HVT_OP_TIMEOUT_MS)");
       wait_ms = left > 1000 ? 1000 : static_cast<int>(left);
     }
-    if (::poll(fds, 2, wait_ms) < 0) {
+    if (wait_ms < 0 || wait_ms > 200) wait_ms = 200;
+    int prc = ::poll(fds, 2, wait_ms);
+    if (prc < 0) {
       if (errno == EINTR) continue;
       throw PeerLostError("hvt: poll failed on data socket");
     }
+    if (prc == 0) {
+      // idle poll round: let the links service the engine's OTHER
+      // broken connections (transport.h Transport::Idle) — a stalled
+      // pump may be stalled exactly because a peer is waiting on a
+      // reconnect only this thread can drive. One sweep covers the
+      // whole hub (it excludes only the sweeping link, which the
+      // pump's own fd<0 recovery handles).
+      in.Idle();
+      continue;
+    }
     size_t before = sent + rcvd;
+    int64_t gen_before = in.Generation() + out.Generation();
     // service BOTH socket directions before doing any reduce work: the
     // peer must never sit idle behind our compute. The recv is capped
     // per iteration so a fast sender cannot monopolize the loop either.
@@ -341,8 +369,13 @@ void DataPlane::Duplex(Sock& out, const uint8_t* send_buf, size_t send_n,
         (fds[0].revents & (POLLOUT | POLLERR | POLLHUP))) {
       sent += out.SendSome(send_buf + sent, send_n - sent);
     }
-    if (deadline >= 0 && sent + rcvd > before)
-      deadline = NowMs() + timeout_ms;  // progress re-arms the deadline
+    // progress re-arms the deadline — and so does a heal that happened
+    // INSIDE a Some() call (generation bump): the reconnect may have
+    // consumed most of the budget, but it just proved the peer alive
+    if (deadline >= 0 &&
+        (sent + rcvd > before ||
+         in.Generation() + out.Generation() != gen_before))
+      deadline = NowMs() + timeout_ms;
     // reduce completed chunks last, overlapping the in-flight transfer
     // (the kernel keeps streaming into/out of the socket buffers while
     // this runs)
@@ -423,9 +456,9 @@ void DataPlane::RingReduceScatter(uint8_t* bytes,
       // head-of-line deadlock for frames below the socket buffer size)
       if (idx % 2 == 0) {
         SendCounted(peer(next), sp, send_w, wid);
-        peer(prev).RecvAll(scratch_.data(), recv_w);
+        peer(prev).Recv(scratch_.data(), recv_w);
       } else {
-        peer(prev).RecvAll(scratch_.data(), recv_w);
+        peer(prev).Recv(scratch_.data(), recv_w);
         SendCounted(peer(next), sp, send_w, wid);
       }
       if (recv_n > 0) reduce_chunk(0, recv_w);
@@ -487,9 +520,9 @@ void DataPlane::RingAllgatherSegs(uint8_t* bytes,
       } else {
         if (idx % 2 == 0) {
           SendCounted(peer(next), wire_send_.data(), send_w, wid);
-          peer(prev).RecvAll(wire_recv_.data(), recv_w);
+          peer(prev).Recv(wire_recv_.data(), recv_w);
         } else {
-          peer(prev).RecvAll(wire_recv_.data(), recv_w);
+          peer(prev).Recv(wire_recv_.data(), recv_w);
           SendCounted(peer(next), wire_send_.data(), send_w, wid);
         }
         if (recv_n > 0) widen_chunk(0, recv_w);
@@ -506,10 +539,10 @@ void DataPlane::RingAllgatherSegs(uint8_t* bytes,
     } else if (idx % 2 == 0) {
       SendCounted(peer(next), bytes + seg_off[send_seg] * el,
                   static_cast<size_t>(send_n) * el, WireCodec::RAW);
-      peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
+      peer(prev).Recv(bytes + seg_off[recv_seg] * el,
                          static_cast<size_t>(recv_n) * el);
     } else {
-      peer(prev).RecvAll(bytes + seg_off[recv_seg] * el,
+      peer(prev).Recv(bytes + seg_off[recv_seg] * el,
                          static_cast<size_t>(recv_n) * el);
       SendCounted(peer(next), bytes + seg_off[send_seg] * el,
                   static_cast<size_t>(send_n) * el, WireCodec::RAW);
@@ -592,9 +625,9 @@ void DataPlane::AllgathervGroup(const void* in, int64_t my_rows,
     } else if (idx % 2 == 0) {
       SendCounted(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
                   WireCodec::RAW);
-      peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
+      peer(prev).Recv(dst + offs[recv_blk] * row_bytes, recv_bytes);
     } else {
-      peer(prev).RecvAll(dst + offs[recv_blk] * row_bytes, recv_bytes);
+      peer(prev).Recv(dst + offs[recv_blk] * row_bytes, recv_bytes);
       SendCounted(peer(next), dst + offs[send_blk] * row_bytes, send_bytes,
                   WireCodec::RAW);
     }
@@ -619,7 +652,7 @@ void DataPlane::BroadcastGroup(void* buf, int64_t bytes, int root,
                   WireCodec::RAW);
     }
   } else {
-    peer(root).RecvAll(buf, static_cast<size_t>(bytes));
+    peer(root).Recv(buf, static_cast<size_t>(bytes));
   }
 }
 
@@ -663,9 +696,9 @@ void DataPlane::AlltoallvGroup(const void* in,
     } else if (idx < opos) {
       if (sb) SendCounted(peer(other), src + soff[opos] * row_bytes, sb,
                           WireCodec::RAW);
-      if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
+      if (rb) peer(other).Recv(dst + roff[opos] * row_bytes, rb);
     } else {
-      if (rb) peer(other).RecvAll(dst + roff[opos] * row_bytes, rb);
+      if (rb) peer(other).Recv(dst + roff[opos] * row_bytes, rb);
       if (sb) SendCounted(peer(other), src + soff[opos] * row_bytes, sb,
                           WireCodec::RAW);
     }

@@ -36,12 +36,14 @@
 #include <atomic>
 #include <cstdint>
 #include <functional>
+#include <memory>
 #include <string>
 #include <vector>
 
 #include "common.h"
 #include "events.h"
 #include "net.h"
+#include "transport.h"
 
 namespace hvt {
 
@@ -58,8 +60,12 @@ inline int GroupIndexOf(const std::vector<int>& group, int rank) {
 
 class DataPlane {
  public:
-  // peers: socket per rank (peers[self] unused/invalid).
-  DataPlane(int rank, int size, std::vector<Sock> peers);
+  // peers: one Transport per rank (peers[self] unused/null). The plane
+  // codes strictly against the Transport seam (transport.h) — the
+  // self-healing TcpLink is what the engine wires in today, and the
+  // io_uring/RDMA backends ROADMAP item 5 plans replace it here.
+  DataPlane(int rank, int size,
+            std::vector<std::unique_ptr<Transport>> peers);
 
   int rank() const { return rank_; }
   int size() const { return size_; }
@@ -120,14 +126,16 @@ class DataPlane {
                       const std::vector<int64_t>& recv_rows,
                       const std::vector<int>& group);
 
-  // Coordinated-abort fan-out: close every peer socket. shutdown(2)
-  // inside Sock::Close sends a FIN, so any peer blocked in a data-plane
-  // recv on this rank wakes immediately with PeerLostError instead of
-  // waiting out its own HVT_OP_TIMEOUT_MS deadline — survivors of a
-  // gang failure converge in one deadline, not N. Engine-thread only
-  // (called on the abort path after the collective in flight threw).
+  // Coordinated-abort fan-out: hard-close every peer link (DEAD — no
+  // reconnect). The close sends a FIN/RST, so any peer blocked in a
+  // data-plane recv on this rank wakes immediately with PeerLostError
+  // instead of waiting out its own HVT_OP_TIMEOUT_MS deadline —
+  // survivors of a gang failure converge in one deadline, not N.
+  // Engine-thread only (called on the abort path after the collective
+  // in flight threw).
   void Abort() {
-    for (auto& s : peers_) s.Close();
+    for (auto& s : peers_)
+      if (s) s->Abort();
   }
 
   // ---- wire telemetry (hvt_engine_stats → metrics plane) --------------
@@ -168,7 +176,7 @@ class DataPlane {
   }
 
  private:
-  Sock& peer(int r) { return peers_[static_cast<size_t>(r)]; }
+  Transport& peer(int r) { return *peers_[static_cast<size_t>(r)]; }
   void CountTx(size_t n, WireCodec codec) {
     if (!tx_sink_) return;
     tx_sink_[stat_op_].fetch_add(static_cast<int64_t>(n),
@@ -180,8 +188,9 @@ class DataPlane {
       codec_tx_sink_[static_cast<int>(codec) * kWireOps + stat_op_]
           .fetch_add(static_cast<int64_t>(n), std::memory_order_relaxed);
   }
-  void SendCounted(Sock& s, const void* data, size_t n, WireCodec codec) {
-    s.SendAll(data, n);
+  void SendCounted(Transport& s, const void* data, size_t n,
+                   WireCodec codec) {
+    s.Send(data, n);
     CountTx(n, codec);
   }
   // Full-duplex pump: stream send_n bytes to `out` while receiving
@@ -190,13 +199,13 @@ class DataPlane {
   // as each chunk_bytes-sized piece of the receive completes, letting
   // the reduce overlap the remaining transfer. `out` and `in` may be
   // the same socket (2-member rings).
-  void Duplex(Sock& out, const uint8_t* send_buf, size_t send_n, Sock& in,
-              uint8_t* recv_buf, size_t recv_n, size_t chunk_bytes,
-              WireCodec codec,
+  void Duplex(Transport& out, const uint8_t* send_buf, size_t send_n,
+              Transport& in, uint8_t* recv_buf, size_t recv_n,
+              size_t chunk_bytes, WireCodec codec,
               const std::function<void(size_t, size_t)>& on_chunk);
 
   int rank_, size_;
-  std::vector<Sock> peers_;
+  std::vector<std::unique_ptr<Transport>> peers_;
   bool pipeline_ = true;        // HVT_RING_PIPELINE
   int64_t chunk_bytes_ = 1 << 20;  // HVT_RING_CHUNK_BYTES
   int stat_op_ = 0;             // engine-thread-only (set_stat_op)

@@ -21,7 +21,7 @@
 // `hist.count`.
 #pragma once
 
-#define HVT_STATS_SLOT_COUNT 134
+#define HVT_STATS_SLOT_COUNT 138
 
 // X-macro: HVT_STATS_SLOT(index, "name")
 #define HVT_STATS_SLOTS(X)                  \
@@ -158,4 +158,8 @@
   X(130, "codec_tx_bytes[fp8][join]")      \
   X(131, "codec_tx_bytes[fp8][barrier]")   \
   X(132, "ef_residual_bytes")              \
-  X(133, "ef_residuals_dropped")          
+  X(133, "ef_residuals_dropped")           \
+  X(134, "link_reconnects[ctrl]")          \
+  X(135, "link_reconnects[data]")          \
+  X(136, "frames_replayed")                \
+  X(137, "replay_bytes")

@@ -1,0 +1,920 @@
+// Transport seam + self-healing TCP links.
+//
+// The narrow interface the data/control planes code against
+// (ROADMAP item 5: the io_uring/RDMA backends plug in HERE), plus the
+// one implementation this build ships: TcpLink, a session layer over
+// net.h's raw Sock that makes a transient connection drop a
+// RECOVERABLE event instead of a gang-wide abort.
+//
+// Wire-level sessions: every link counts the bytes it has ever sent
+// (tx) and consumed (rx) — per-direction stream sequence numbers — and
+// the sender keeps a bounded replay ring of the most recent tx bytes
+// (HVT_REPLAY_BUDGET_BYTES). When a connection drops (ECONNRESET /
+// FIN / EPIPE), the link transitions HEALTHY → RECONNECTING: the side
+// that originally dialed re-dials, the side that accepted re-accepts
+// on its listener, and a handshake exchanges (session epoch, rx
+// offset) in both directions. Each sender rewinds to the peer's rx
+// offset and replays the missing bytes from its ring, so the stream
+// resumes EXACTLY where the receiver left off — a collective in
+// flight completes bit-identically, with no renegotiation and no
+// tensor loss. Only an exhausted retry budget (HVT_LINK_RETRIES /
+// HVT_LINK_RETRY_WINDOW_MS), a replay gap the ring cannot cover, or a
+// deliberate Abort() escalates into the PR 4 containment path
+// (PeerLostError → EnterBroken), which is unchanged.
+//
+// Deadlines still mean what they meant: an OpTimeoutError (stalled but
+// CONNECTED peer, missed idle heartbeat) is NOT retried — reconnecting
+// to a wedged peer fixes nothing — so the heartbeat/timeout abort
+// classes behave exactly as PR 4 pinned them.
+//
+// Thread-safety: like Sock, links are engine-thread affine (see the
+// net.h contract). The per-link state/epoch/retry fields read by the
+// diagnostics snapshot are plain — UpdateDiag copies them ON the
+// engine thread; client threads read the snapshot, never the link.
+#pragma once
+
+#include <algorithm>
+#include <deque>
+#include <map>
+#include <memory>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "common.h"
+#include "events.h"
+#include "net.h"
+
+namespace hvt {
+
+// Link planes — the {plane} label of hvt_link_reconnects_total and the
+// index into EngineStats::link_reconnects. Wire ids (stats-slot ABI).
+enum class LinkPlane : int { CTRL = 0, DATA = 1 };
+constexpr int kLinkPlanes = 2;
+inline const char* LinkPlaneName(LinkPlane p) {
+  return p == LinkPlane::CTRL ? "ctrl" : "data";
+}
+
+enum class LinkState : int { HEALTHY = 0, RECONNECTING = 1, DEAD = 2 };
+inline const char* LinkStateName(LinkState s) {
+  switch (s) {
+    case LinkState::HEALTHY: return "healthy";
+    case LinkState::RECONNECTING: return "reconnecting";
+    case LinkState::DEAD: return "dead";
+  }
+  return "?";
+}
+
+// HVT_LINK_RECONNECT (default 1): 0 restores the PR 4 behavior — any
+// socket failure escalates straight to the coordinated abort.
+inline bool LinkReconnectEnabled() {
+  static const bool on = EnvInt("HVT_LINK_RECONNECT", 1) != 0;
+  return on;
+}
+// HVT_LINK_RETRIES (default 10): dial attempts per reconnect episode.
+// A dead peer's listener refuses instantly, so this bounds dead-peer
+// detection to ~seconds while a live-but-flapping peer gets the full
+// window below.
+inline int64_t LinkRetries() {
+  static const int64_t n = EnvInt("HVT_LINK_RETRIES", 10);
+  return n;
+}
+// HVT_LINK_RETRY_WINDOW_MS: wall-clock budget per reconnect episode.
+// Default = one op deadline capped at 10 s — so an abort that must
+// happen (peer truly dead) still converges on the PR 4 clock, and a
+// transparent heal always finishes before a HEALTHY neighbor's own
+// progress deadline fires.
+inline int64_t LinkRetryWindowMs() {
+  static const int64_t ms = [] {
+    int64_t v = EnvInt("HVT_LINK_RETRY_WINDOW_MS", 0);
+    if (v > 0) return v;
+    int64_t op = OpTimeoutMs();
+    return op > 0 && op < 10000 ? op : int64_t{10000};
+  }();
+  return ms;
+}
+// HVT_REPLAY_BUDGET_BYTES (default 8 MB, 0 disables replay): per-link
+// sender-side replay ring. Must cover the bytes a drop can lose —
+// both sockets' kernel buffers plus in-flight — or the reconnect
+// escalates with a budget-exhausted reason.
+inline int64_t ReplayBudgetBytes() {
+  static const int64_t b = EnvInt("HVT_REPLAY_BUDGET_BYTES", 8 << 20);
+  return b < 0 ? 0 : b;
+}
+
+// --------------------------------------------------------------------------
+// replay ring — a circular window over the sender's byte stream
+// --------------------------------------------------------------------------
+class ReplayRing {
+ public:
+  explicit ReplayRing(int64_t budget) : budget_(budget) {}
+
+  // Stream offsets currently covered: [start(), end()).
+  int64_t start() const { return end_ - size_; }
+  int64_t end() const { return end_; }
+  bool Covers(int64_t from) const {
+    return from >= start() && from <= end_;
+  }
+
+  // Append n freshly-sent bytes (stream position end()..end()+n),
+  // evicting the oldest bytes past the budget. The backing buffer
+  // grows geometrically up to the budget (a control link whose whole
+  // history is a few KB never pays the full 8 MiB — at fleet scale the
+  // per-link rings would otherwise cost O(ranks) x budget per rank).
+  void Append(const void* p, int64_t n) {
+    if (budget_ <= 0 || n <= 0) {
+      end_ += n > 0 ? n : 0;
+      size_ = 0;
+      return;
+    }
+    EnsureCap(std::min(size_ + n, budget_));
+    auto* src = static_cast<const uint8_t*>(p);
+    if (n >= cap_) {  // only the newest cap_ bytes survive
+      src += n - cap_;
+      end_ += n;
+      size_ = cap_;
+      head_ = 0;
+      memcpy(buf_.data(), src, static_cast<size_t>(cap_));
+      return;
+    }
+    int64_t w = (head_ + size_) % cap_;  // write cursor
+    int64_t first = std::min(n, cap_ - w);
+    memcpy(buf_.data() + w, src, static_cast<size_t>(first));
+    if (n > first)
+      memcpy(buf_.data(), src + first, static_cast<size_t>(n - first));
+    end_ += n;
+    size_ += n;
+    if (size_ > cap_) {  // evicted the oldest
+      head_ = (head_ + (size_ - cap_)) % cap_;
+      size_ = cap_;
+    }
+  }
+
+  // Contiguous view starting at stream offset `from` (must be covered
+  // and < end()): returns (ptr, len) of at most the bytes up to the
+  // ring's wraparound point — call again for the rest.
+  std::pair<const uint8_t*, int64_t> Peek(int64_t from) const {
+    int64_t off = from - start();          // offset into the window
+    int64_t pos = (head_ + off) % cap_;    // physical position
+    int64_t len = std::min(size_ - off, cap_ - pos);
+    return {buf_.data() + pos, len};
+  }
+
+ private:
+  // Grow the backing buffer (unwrapping the stored window) so at least
+  // `want` bytes fit: powers of two from 64 KiB, capped at the budget.
+  void EnsureCap(int64_t want) {
+    if (want <= cap_) return;
+    int64_t cap = cap_ > 0 ? cap_ : std::min<int64_t>(64 << 10, budget_);
+    while (cap < want && cap < budget_) cap *= 2;
+    if (cap > budget_) cap = budget_;
+    if (cap == cap_) return;
+    std::vector<uint8_t> nb(static_cast<size_t>(cap));
+    if (size_ > 0) {
+      int64_t first = std::min(size_, cap_ - head_);
+      memcpy(nb.data(), buf_.data() + head_,
+             static_cast<size_t>(first));
+      if (size_ > first)
+        memcpy(nb.data() + first, buf_.data(),
+               static_cast<size_t>(size_ - first));
+    }
+    buf_ = std::move(nb);
+    head_ = 0;
+    cap_ = cap;
+  }
+
+  std::vector<uint8_t> buf_;  // allocated lazily, grown geometrically
+  int64_t budget_;
+  int64_t cap_ = 0;   // current backing capacity (≤ budget_)
+  int64_t head_ = 0;  // physical index of stream offset start()
+  int64_t size_ = 0;  // bytes stored
+  int64_t end_ = 0;   // stream offset just past the newest byte
+};
+
+// --------------------------------------------------------------------------
+// Transport — the seam
+// --------------------------------------------------------------------------
+// What a data/control plane needs from a connection, and nothing else:
+// blocking deadline-bounded transfers, nonblocking best-effort moves
+// for the duplex pump (fd() feeds its poll set), length-prefixed
+// frames, and a hard Abort. A future io_uring/RDMA backend implements
+// exactly this.
+class Transport {
+ public:
+  virtual ~Transport() = default;
+  virtual bool valid() const = 0;
+  virtual int fd() const = 0;  // for poll(); may change across reconnects
+  virtual void Send(const void* p, size_t n, int64_t timeout_ms = -1) = 0;
+  virtual void Recv(void* p, size_t n, int64_t timeout_ms = -1) = 0;
+  // Nonblocking: bytes moved, 0 = would block; throws on escalation.
+  virtual size_t SendSome(const void* p, size_t n) = 0;
+  virtual size_t RecvSome(void* p, size_t n) = 0;
+  virtual void SendFrame(const std::vector<uint8_t>& b,
+                         int64_t timeout_ms = -1) = 0;
+  virtual std::vector<uint8_t> RecvFrame(int64_t timeout_ms = -1) = 0;
+  // Hard close: the link goes DEAD, no reconnect — the PR 4 abort path.
+  virtual void Abort() = 0;
+  // Called when a caller's wait on THIS transport times out with
+  // nothing ready: a hook for housekeeping only the (single) engine
+  // thread can do — TcpLink services the engine's other broken links
+  // here, because a peer stuck waiting for OUR dial can never be
+  // helped while we block on a different, healthy connection.
+  virtual void Idle() {}
+  // Monotonic heal counter (TcpLink: the session epoch). A caller
+  // whose nonblocking Some() call returned 0 can compare generations
+  // to tell "nothing happened" from "the link spent seconds healing
+  // underneath me" — the latter must re-arm progress deadlines, since
+  // the heal just proved the peer alive.
+  virtual int64_t Generation() const { return 0; }
+};
+
+class TcpLink;
+struct ReconnectHub;
+// While one link's reconnect episode waits, repair the engine's other
+// broken links (defined after TcpLink; see the full comment there).
+inline void ServiceSiblingLinks(ReconnectHub* hub, TcpLink* busy);
+
+// Shared reconnect state, owned by the engine (one per engine run):
+// the parking lot for accepted-but-not-mine reconnect dials, the
+// telemetry sinks (EngineStats fields — they outlive every link), and
+// the global gates (shutdown flag, containment close, partition hold).
+struct ReconnectHub {
+  // telemetry sinks, bound by the engine at Init (may be null in
+  // unit-test contexts): reconnects is an array of kLinkPlanes
+  std::atomic<int64_t>* reconnects = nullptr;
+  std::atomic<int64_t>* frames_replayed = nullptr;
+  std::atomic<int64_t>* replay_bytes = nullptr;
+  EventRing* events = nullptr;
+  // engine gates
+  std::atomic<bool>* stop = nullptr;    // engine shutdown_requested_
+  std::atomic<bool> closed{false};      // EnterBroken: reconnects refuse
+  int64_t hold_until_ms = 0;            // partition fault: heal no
+                                        // earlier than this
+  int my_rank = 0;
+  // Abort sniffing: the engine sets abort_flag to its control-frame
+  // abort bit (wire.h kAbortFrameFlag); sibling sweeps then PEEK
+  // queued control frames and set remote_abort_seen when one carries
+  // it — so a rank stuck in a reconnect episode learns the gang is
+  // already tearing down and escalates NOW instead of waiting out a
+  // retry window per hop of the abort cascade (the PR 4 "~one
+  // deadline" convergence clock).
+  uint8_t abort_flag = 0;
+  std::atomic<bool> remote_abort_seen{false};
+  // a reconnect dial whose HELLO names another link parks here until
+  // that link's own ReAccept adopts it (keyed (plane, peer rank))
+  struct Parked {
+    Sock sock;
+    int64_t peer_epoch = 0;
+    int64_t peer_rx = 0;
+  };
+  std::map<std::pair<int, int>, Parked> parked;
+  // live links (engine-thread registry) — the diagnostics snapshot and
+  // the chaos injector walk this instead of widening the Transport seam
+  std::vector<TcpLink*> links;
+
+  void Reset() {
+    closed.store(false);
+    hold_until_ms = 0;
+    remote_abort_seen.store(false);
+    parked.clear();
+    // links unregister themselves via ~TcpLink
+  }
+};
+
+// --------------------------------------------------------------------------
+// TcpLink — the self-healing TCP implementation
+// --------------------------------------------------------------------------
+constexpr int32_t kLinkHelloMagic = 0x4856524C;  // "HVRL"
+
+class TcpLink : public Transport {
+ public:
+  // dial_host empty → this side ACCEPTED the original connection and
+  // re-accepts on `listener` during a reconnect; otherwise this side
+  // re-dials dial_host:dial_port.
+  TcpLink(Sock sock, LinkPlane plane, int peer_rank, ReconnectHub* hub,
+          std::string dial_host = "", int dial_port = 0,
+          Listener* listener = nullptr)
+      : sock_(std::move(sock)),
+        plane_(plane),
+        peer_(peer_rank),
+        hub_(hub),
+        dial_host_(std::move(dial_host)),
+        dial_port_(dial_port),
+        listener_(listener),
+        ring_(ReplayBudgetBytes()),
+        state_since_(NowSec()) {
+    if (hub_) hub_->links.push_back(this);
+  }
+  ~TcpLink() override {
+    if (hub_)
+      for (size_t i = 0; i < hub_->links.size(); ++i)
+        if (hub_->links[i] == this) {
+          hub_->links.erase(hub_->links.begin() + static_cast<long>(i));
+          break;
+        }
+  }
+  TcpLink(const TcpLink&) = delete;
+  TcpLink& operator=(const TcpLink&) = delete;
+
+  bool valid() const override {
+    return state_ != LinkState::DEAD &&
+           (sock_.valid() || state_ == LinkState::RECONNECTING);
+  }
+  int fd() const override { return sock_.fd(); }
+  LinkPlane plane() const { return plane_; }
+  int peer_rank() const { return peer_; }
+  LinkState state() const { return state_; }
+  int64_t epoch() const { return epoch_; }
+  int retries() const { return retries_; }
+  double state_since_sec() const { return state_since_; }
+  // Reconnect opt-out for parked side channels (tree members' star
+  // socket): a failure throws immediately instead of healing, so the
+  // owner can retire the link without a coordinator on the other end.
+  void SetReconnect(bool on) { reconnect_ = on; }
+
+  // ---- chaos hooks (HVT_FAULT_INJECT) --------------------------------
+  // Close the socket after `more` additional tx bytes — a genuinely
+  // mid-transfer cut (flaky_conn); the next I/O heals it.
+  void InjectCutAfter(int64_t more) { cut_after_ = tx_ + more; }
+  // Close after `more` additional RX bytes: unread kernel-buffered
+  // data dies with the socket (RST), so the PEER must replay — the
+  // deterministic way to exercise the replay ring under chaos.
+  void InjectCutAfterRx(int64_t more) { cut_after_rx_ = rx_ + more; }
+  // Cut right now (partition / reset_storm). Transient: state stays
+  // HEALTHY, so the next I/O reconnects instead of escalating.
+  void InjectCutNow() {
+    cut_after_ = -1;
+    sock_.Close();
+  }
+
+  void Abort() override {
+    state_ = LinkState::DEAD;
+    state_since_ = NowSec();
+    sock_.Close();
+  }
+
+  void Idle() override { ServiceSiblingLinks(hub_, this); }
+  int64_t Generation() const override { return epoch_; }
+
+  // Sibling servicing (called while ANOTHER link's reconnect episode
+  // waits — see ServiceSiblingLinks): make remote breakage locally
+  // visible by peeking for an unread FIN/RST (never consumes data),
+  // then run a single dial+handshake attempt when this side holds the
+  // dial role. Never blocks beyond one bounded attempt; a repaired
+  // link goes straight back to HEALTHY with its replay armed.
+  void ProbeAndRepair() {
+    if (state_ == LinkState::DEAD || (hub_ && hub_->closed.load()))
+      return;
+    if (state_ == LinkState::HEALTHY && sock_.valid()) {
+      // peek far enough to sniff a queued control frame's flags byte
+      // (8-byte length prefix + 1): the engine consumes ctrl frames
+      // whole, so a non-busy link's stream always sits at a frame
+      // boundary and byte 8 IS the flags byte of the next frame
+      uint8_t hdr[9];
+      ssize_t k =
+          ::recv(sock_.fd(), hdr, sizeof(hdr), MSG_PEEK | MSG_DONTWAIT);
+      if (k > 0) {
+        if (plane_ == LinkPlane::CTRL && hub_ && hub_->abort_flag &&
+            k >= 9 && (hdr[8] & hub_->abort_flag))
+          hub_->remote_abort_seen.store(true);
+        return;  // live bytes pending — healthy
+      }
+      if (k < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                    errno == EINTR))
+        return;           // quiet and healthy
+      sock_.Close();      // FIN (k == 0) or RST — broken
+    }
+    if (!reconnect_ || !LinkReconnectEnabled() ||
+        (hub_ && NowMs() < hub_->hold_until_ms))
+      return;
+    if (sock_.valid() || dial_host_.empty()) return;
+    if (state_ != LinkState::RECONNECTING) {
+      state_ = LinkState::RECONNECTING;
+      state_since_ = NowSec();
+      retries_ = 0;
+    }
+    // Deliberately does NOT count toward retries_: probes are free
+    // attempts made while the engine waits elsewhere — the peer may
+    // simply not have noticed the break yet, and burning the owning
+    // episode's HVT_LINK_RETRIES budget here would turn a live peer
+    // into a spurious "peer is dead" escalation. Bounds are short
+    // (one probe must not starve the operation the engine actually
+    // blocks on): a ready peer pairs in ms, an unaware one costs
+    // ≤ ~0.65 s and is retried next idle round.
+    (void)TryDialHandshake(NowMs() + 400, state_since_, 250);
+  }
+
+  // ---- blocking deadline-bounded transfers ---------------------------
+  // Progress re-arms the deadline; so does a successful in-call heal
+  // (visible as an epoch bump) — a reconnect that consumed most of the
+  // remaining budget just proved the peer alive, and timing out right
+  // after it would turn a healed link into an abort (the Duplex pump
+  // re-arms for exactly the same reason).
+  void Send(const void* p, size_t n, int64_t timeout_ms = -1) override {
+    if (timeout_ms < 0) timeout_ms = OpTimeoutMs();
+    int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
+    auto* src = static_cast<const uint8_t*>(p);
+    size_t done = 0;
+    while (done < n) {
+      PollReady(POLLOUT, deadline, "send (HVT_OP_TIMEOUT_MS)");
+      int64_t e0 = epoch_;
+      size_t k = SendSome(src + done, n - done);
+      done += k;
+      if ((k || epoch_ != e0) && deadline >= 0)
+        deadline = NowMs() + timeout_ms;
+    }
+  }
+  void Recv(void* p, size_t n, int64_t timeout_ms = -1) override {
+    if (timeout_ms < 0) timeout_ms = OpTimeoutMs();
+    int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
+    auto* dst = static_cast<uint8_t*>(p);
+    size_t done = 0;
+    while (done < n) {
+      PollReady(POLLIN, deadline, "recv (HVT_OP_TIMEOUT_MS)");
+      int64_t e0 = epoch_;
+      size_t k = RecvSome(dst + done, n - done);
+      done += k;
+      if ((k || epoch_ != e0) && deadline >= 0)
+        deadline = NowMs() + timeout_ms;
+    }
+  }
+
+  // ---- nonblocking best-effort moves (the duplex pump) ---------------
+  size_t SendSome(const void* p, size_t n) override {
+    if (!EnsureUsable("send")) return 0;
+    // stream order: pending replay bytes precede any new payload
+    if (replay_from_ >= 0 && !FlushReplayOnce()) return 0;
+    if (replay_from_ >= 0) return 0;
+    ssize_t k = ::send(sock_.fd(), p, n, MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+        return 0;
+      HandleFailure("send");
+      return 0;
+    }
+    ring_.Append(p, k);
+    tx_ += k;
+    if (cut_after_ >= 0 && tx_ >= cut_after_) {
+      // chaos: flaky_conn armed a mid-transfer cut; both sides see the
+      // reset and heal through the replay handshake
+      cut_after_ = -1;
+      sock_.Close();
+    }
+    return static_cast<size_t>(k);
+  }
+  size_t RecvSome(void* p, size_t n) override {
+    if (!EnsureUsable("recv")) return 0;
+    ssize_t k = ::recv(sock_.fd(), p, n, MSG_DONTWAIT);
+    if (k > 0) {
+      rx_ += k;
+      if (cut_after_rx_ >= 0 && rx_ >= cut_after_rx_) {
+        cut_after_rx_ = -1;  // chaos: drop the link mid-receive
+        sock_.Close();
+      }
+      return static_cast<size_t>(k);
+    }
+    if (k < 0 &&
+        (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR))
+      return 0;
+    HandleFailure("recv");
+    return 0;
+  }
+
+  // ---- length-prefixed frames (control plane) ------------------------
+  void SendFrame(const std::vector<uint8_t>& b,
+                 int64_t timeout_ms = -1) override {
+    uint64_t n = b.size();
+    // one contiguous buffer (one syscall, like the old vectored
+    // sendmsg) and one ring append — control frames are small
+    frame_.resize(8 + b.size());
+    memcpy(frame_.data(), &n, 8);
+    if (n) memcpy(frame_.data() + 8, b.data(), b.size());
+    Send(frame_.data(), frame_.size(), timeout_ms);
+    // frame boundary bookkeeping for the frames_replayed counter
+    frame_ends_.push_back(tx_);
+    while (!frame_ends_.empty() && frame_ends_.front() < ring_.start())
+      frame_ends_.pop_front();
+  }
+  std::vector<uint8_t> RecvFrame(int64_t timeout_ms = -1) override {
+    uint64_t n = 0;
+    Recv(&n, 8, timeout_ms);
+    std::vector<uint8_t> b(n);
+    if (n) Recv(b.data(), n, timeout_ms);
+    return b;
+  }
+
+ private:
+  // poll for `events` on the current fd, also flushing pending replay
+  // whenever the socket turns writable; throws OpTimeoutError at the
+  // deadline (NOT retried — stalled-but-alive is a containment case).
+  // Idle poll rounds (≤200 ms each) service the engine's OTHER broken
+  // links: while this thread blocks here, it is the only actor that
+  // can repair them, and a peer may be stuck waiting on exactly that
+  // (e.g. rank 0 parked in a control recv while its broken data link
+  // is what the peer's reconnect-accept is waiting for).
+  void PollReady(short events, int64_t deadline, const char* what) {
+    while (true) {
+      if (!sock_.valid()) return;  // Some() path will reconnect
+      short ev = events;
+      if (replay_from_ >= 0) ev |= POLLOUT;
+      struct pollfd p {sock_.fd(), ev, 0};
+      int wait_ms = 200;
+      if (deadline >= 0) {
+        int64_t left = deadline - NowMs();
+        if (left <= 0)
+          throw OpTimeoutError(std::string("hvt: ") + what +
+                               " deadline exceeded");
+        if (left < wait_ms) wait_ms = static_cast<int>(left);
+      }
+      int rc = ::poll(&p, 1, wait_ms);
+      if (rc > 0) {
+        if ((p.revents & POLLOUT) && replay_from_ >= 0)
+          FlushReplayOnce();
+        return;
+      }
+      if (rc < 0 && errno != EINTR)
+        throw PeerLostError(std::string("hvt: poll failed during ") +
+                            what);
+      if (rc == 0) ServiceSiblingLinks(hub_, this);
+    }
+  }
+
+  // False → caller should return 0 (a reconnect just happened or is
+  // impossible without escalation, which throws).
+  bool EnsureUsable(const char* what) {
+    if (state_ == LinkState::DEAD)
+      throw PeerLostError("hvt: " + Describe() + " is dead");
+    if (!sock_.valid()) {
+      HandleFailure(what);
+      return false;
+    }
+    return true;
+  }
+
+  std::string Describe() const {
+    return std::string(LinkPlaneName(plane_)) + " link to rank " +
+           std::to_string(peer_);
+  }
+
+  // A transport-level failure: heal when allowed, escalate otherwise.
+  // Escalation throws PeerLostError — the engine maps it to the PR 4
+  // EnterBroken path unchanged.
+  void HandleFailure(const char* what) {
+    sock_.Close();
+    if (state_ == LinkState::DEAD || !reconnect_ ||
+        !LinkReconnectEnabled() || (hub_ && hub_->closed.load()))
+      throw PeerLostError("hvt: " + std::string(what) + " failed on " +
+                          Describe() + " (peer lost)");
+    Reconnect();
+  }
+
+  void Escalate(const std::string& why) {
+    state_ = LinkState::DEAD;
+    state_since_ = NowSec();
+    sock_.Close();
+    throw PeerLostError("hvt: " + Describe() + ": " + why);
+  }
+
+  void CheckGates() {
+    if (hub_ && hub_->stop && hub_->stop->load())
+      Escalate("engine shutdown requested during reconnect");
+    if (hub_ && hub_->closed.load())
+      Escalate("engine aborted during reconnect");
+    if (hub_ && hub_->remote_abort_seen.load())
+      Escalate("a peer broadcast a gang abort while this link was "
+               "reconnecting — joining the coordinated teardown");
+  }
+
+  // Heal the link: re-establish the socket (dial or accept, by the
+  // original role), handshake (epoch, rx offsets), arm the replay.
+  // Each wait iteration also services the engine's OTHER broken links
+  // (ServiceSiblingLinks) — see its comment for the deadlock it breaks.
+  void Reconnect() {
+    if (state_ != LinkState::RECONNECTING) {
+      state_ = LinkState::RECONNECTING;
+      state_since_ = NowSec();
+      retries_ = 0;
+    }
+    const double t0 = NowSec();
+    const int64_t window = LinkRetryWindowMs();
+    const int64_t deadline = NowMs() + window;
+    int64_t backoff = 10;
+    unsigned seed = static_cast<unsigned>(NowMs() ^ (peer_ << 8) ^
+                                          static_cast<int>(plane_));
+    while (true) {
+      CheckGates();
+      if (NowMs() >= deadline)
+        Escalate("reconnect budget exhausted (HVT_LINK_RETRIES=" +
+                 std::to_string(LinkRetries()) +
+                 ", HVT_LINK_RETRY_WINDOW_MS=" + std::to_string(window) +
+                 ") — peer is unreachable");
+      if (hub_ && NowMs() < hub_->hold_until_ms) {
+        // partition fault: the injector holds healing for its window
+        struct timespec ts {0, 20 * 1000000};
+        nanosleep(&ts, nullptr);
+        continue;
+      }
+      if (!dial_host_.empty()) {
+        if (retries_ >= LinkRetries())
+          Escalate("reconnect budget exhausted (HVT_LINK_RETRIES=" +
+                   std::to_string(LinkRetries()) +
+                   ", HVT_LINK_RETRY_WINDOW_MS=" +
+                   std::to_string(window) + ") — peer is dead");
+        ++retries_;
+        if (TryDialHandshake(deadline, t0)) return;
+        int64_t jitter = backoff / 4;
+        int64_t sleep_ms =
+            backoff - jitter +
+            (jitter > 0 ? static_cast<int64_t>(rand_r(&seed)) %
+                              (2 * jitter + 1)
+                        : 0);
+        struct timespec ts {sleep_ms / 1000,
+                            (sleep_ms % 1000) * 1000000};
+        nanosleep(&ts, nullptr);
+        backoff = backoff < 500 ? backoff * 2 : 500;
+      } else {
+        // acceptor: adopt a parked dial for this link, or accept a new
+        // one (a hello for another link parks there for its owner)
+        int64_t peer_epoch = 0, peer_rx = -1;
+        bool adopted = false;
+        if (hub_) {
+          auto it =
+              hub_->parked.find({static_cast<int>(plane_), peer_});
+          if (it != hub_->parked.end()) {
+            Sock s = std::move(it->second.sock);
+            peer_epoch = it->second.peer_epoch;
+            peer_rx = it->second.peer_rx;
+            hub_->parked.erase(it);
+            if (TryAck(s, peer_epoch)) sock_ = std::move(s);
+            adopted = true;
+          }
+        }
+        if (!adopted) {
+          if (!listener_)
+            Escalate("no listener to re-accept on (link was "
+                     "dial-less)");
+          Sock s = listener_->TryAccept(200);
+          if (s.valid()) {
+            int64_t pe = 0, prx = 0;
+            int prank = -1, pplane = -1;
+            if (ReadHello(s, &prank, &pplane, &pe, &prx)) {
+              if (prank == peer_ &&
+                  pplane == static_cast<int>(plane_)) {
+                peer_epoch = pe;
+                peer_rx = prx;
+                if (TryAck(s, peer_epoch)) sock_ = std::move(s);
+              } else if (hub_) {
+                ReconnectHub::Parked pk;
+                pk.sock = std::move(s);
+                pk.peer_epoch = pe;
+                pk.peer_rx = prx;
+                hub_->parked[{pplane, prank}] =
+                    std::move(pk);  // latest wins
+              }
+            }
+          }
+        }
+        if (sock_.valid()) {
+          FinishReconnect(peer_epoch, peer_rx, t0);
+          return;
+        }
+      }
+      ServiceSiblingLinks(hub_, this);
+    }
+  }
+
+  // One dial + handshake attempt; on success adopts the socket, arms
+  // the replay, and marks the link HEALTHY. Used by the dialer branch
+  // of Reconnect and by sibling servicing.
+  bool TryDialHandshake(int64_t ack_deadline_ms, double t0,
+                        int dial_ms = 1000) {
+    Sock s = Sock::DialOnce(dial_host_, dial_port_, dial_ms);
+    if (!s.valid()) return false;
+    int64_t peer_epoch = 0, peer_rx = -1;
+    try {
+      Writer w;
+      w.i32_raw(kLinkHelloMagic);
+      w.i32_raw(hub_ ? hub_->my_rank : -1);
+      w.u8_raw(static_cast<uint8_t>(plane_));
+      w.i64_raw(epoch_);
+      w.i64_raw(rx_);
+      s.SendFrame(w.buf, 2000);
+      auto ack = s.RecvFrame(std::min<int64_t>(
+          3000, std::max<int64_t>(100, ack_deadline_ms - NowMs())));
+      Reader2 rd(ack);
+      if (rd.i32() != kLinkHelloMagic) return false;
+      peer_epoch = rd.i64();
+      peer_rx = rd.i64();
+    } catch (const std::exception&) {
+      return false;  // handshake failed: retry within the budget
+    }
+    sock_ = std::move(s);
+    FinishReconnect(peer_epoch, peer_rx, t0);
+    return true;
+  }
+
+  // Post-handshake tail shared by every heal path: validate the peer's
+  // rx offset, arm the replay, count/record, go HEALTHY.
+  void FinishReconnect(int64_t peer_epoch, int64_t peer_rx, double t0) {
+    // arm the replay: the peer consumed peer_rx of our tx_ bytes
+    if (peer_rx > tx_ || peer_rx < 0)
+      Escalate("reconnect handshake is corrupt (peer claims rx=" +
+               std::to_string(peer_rx) + " of tx=" +
+               std::to_string(tx_) + ")");
+    int64_t gap = tx_ - peer_rx;
+    if (gap > 0 && !ring_.Covers(peer_rx))
+      Escalate("cannot replay " + std::to_string(gap) +
+               " lost bytes to rank " + std::to_string(peer_) +
+               " — replay budget exhausted (HVT_REPLAY_BUDGET_BYTES=" +
+               std::to_string(ReplayBudgetBytes()) + ")");
+    replay_from_ = gap > 0 ? peer_rx : -1;
+    int64_t frames = 0;
+    for (int64_t end : frame_ends_)
+      if (end > peer_rx) ++frames;
+    // The heal is complete only once the peer HAS the replayed bytes:
+    // this side's transfer counters may already be satisfied (the
+    // bytes were handed to the kernel before the drop), so the
+    // application might never touch this link again this phase — an
+    // unflushed replay would strand the peer waiting forever on data
+    // only we can re-send. The flush cannot deadlock: the gap is at
+    // most what was in flight when the link dropped, which by
+    // construction fits back into the (now empty) socket buffers
+    // without the peer consuming a byte.
+    {
+      const int64_t flush_deadline = NowMs() + LinkRetryWindowMs();
+      while (replay_from_ >= 0) {
+        if (NowMs() >= flush_deadline)
+          Escalate("replay flush stalled after reconnect (peer not "
+                   "draining)");
+        struct pollfd p {sock_.fd(), POLLOUT, 0};
+        if (::poll(&p, 1, 200) <= 0) continue;
+        if (!FlushReplayOnce()) return;  // dropped again mid-flush: the
+                                         // nested heal flushed its own
+                                         // (re-armed) replay
+      }
+    }
+    epoch_ = std::max(epoch_, peer_epoch);
+    if (dial_host_.empty()) ++epoch_;  // acceptor already bumped in ack
+    state_ = LinkState::HEALTHY;
+    double dur = NowSec() - t0;
+    state_since_ = NowSec();
+    if (hub_) {
+      if (hub_->reconnects)
+        hub_->reconnects[static_cast<int>(plane_)].fetch_add(
+            1, std::memory_order_relaxed);
+      if (gap > 0) {
+        if (hub_->replay_bytes)
+          hub_->replay_bytes->fetch_add(gap, std::memory_order_relaxed);
+        if (hub_->frames_replayed)
+          hub_->frames_replayed->fetch_add(frames,
+                                           std::memory_order_relaxed);
+      }
+      if (hub_->events) {
+        hub_->events->Record(EventKind::RECONNECT,
+                             "rank " + std::to_string(peer_),
+                             static_cast<int32_t>(plane_), retries_,
+                             static_cast<int64_t>(dur * 1e6));
+        if (gap > 0)
+          hub_->events->Record(EventKind::REPLAY,
+                               "rank " + std::to_string(peer_),
+                               static_cast<int32_t>(plane_),
+                               static_cast<int32_t>(frames), gap);
+      }
+    }
+  }
+
+  // Read a reconnect HELLO off a fresh acceptor-side socket.
+  bool ReadHello(Sock& s, int* rank, int* plane, int64_t* ep,
+                 int64_t* rx) {
+    try {
+      auto f = s.RecvFrame(2000);
+      Reader2 rd(f);
+      if (rd.i32() != kLinkHelloMagic) return false;
+      *rank = rd.i32();
+      *plane = rd.u8();
+      *ep = rd.i64();
+      *rx = rd.i64();
+      return true;
+    } catch (const std::exception&) {
+      return false;
+    }
+  }
+
+  bool TryAck(Sock& s, int64_t peer_epoch) {
+    try {
+      Writer w;
+      w.i32_raw(kLinkHelloMagic);
+      w.i64_raw(std::max(epoch_, peer_epoch) + 1);
+      w.i64_raw(rx_);
+      s.SendFrame(w.buf, 2000);
+      return true;
+    } catch (const std::exception&) {
+      return false;
+    }
+  }
+
+  // Push pending replay bytes nonblockingly; false when a reconnect
+  // happened underneath (caller restarts its operation).
+  bool FlushReplayOnce() {
+    while (replay_from_ >= 0) {
+      auto [ptr, len] = ring_.Peek(replay_from_);
+      if (len <= 0) {
+        replay_from_ = -1;
+        break;
+      }
+      ssize_t k =
+          ::send(sock_.fd(), ptr, static_cast<size_t>(len),
+                 MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (k < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR)
+          return true;  // socket full; flush resumes on next POLLOUT
+        HandleFailure("replay");
+        return false;
+      }
+      replay_from_ += k;
+      if (replay_from_ >= tx_) replay_from_ = -1;
+    }
+    return true;
+  }
+
+  // Minimal little-endian writer/reader for the handshake frames —
+  // wire.h's Writer/Reader live above the transport layer, so the
+  // link speaks its own 29-byte hello to avoid a dependency cycle.
+  struct Writer {
+    std::vector<uint8_t> buf;
+    void append(const void* p, size_t n) {
+      auto* b = static_cast<const uint8_t*>(p);
+      buf.insert(buf.end(), b, b + n);
+    }
+    void u8_raw(uint8_t v) { buf.push_back(v); }
+    void i32_raw(int32_t v) { append(&v, 4); }
+    void i64_raw(int64_t v) { append(&v, 8); }
+  };
+  struct Reader2 {
+    const std::vector<uint8_t>& b;
+    size_t pos = 0;
+    explicit Reader2(const std::vector<uint8_t>& v) : b(v) {}
+    void need(size_t n) {
+      if (b.size() - pos < n)
+        throw PeerLostError("hvt: truncated reconnect handshake");
+    }
+    uint8_t u8() {
+      need(1);
+      return b[pos++];
+    }
+    int32_t i32() {
+      need(4);
+      int32_t v;
+      memcpy(&v, b.data() + pos, 4);
+      pos += 4;
+      return v;
+    }
+    int64_t i64() {
+      need(8);
+      int64_t v;
+      memcpy(&v, b.data() + pos, 8);
+      pos += 8;
+      return v;
+    }
+  };
+
+  Sock sock_;
+  LinkPlane plane_;
+  int peer_;
+  ReconnectHub* hub_;
+  std::string dial_host_;
+  int dial_port_;
+  Listener* listener_;
+  ReplayRing ring_;
+  bool reconnect_ = true;
+  int64_t tx_ = 0;           // bytes ever handed to the kernel
+  int64_t rx_ = 0;           // bytes ever consumed by the app
+  int64_t replay_from_ = -1; // pending replay cursor (<0 → none)
+  int64_t cut_after_ = -1;   // chaos: close once tx_ crosses this
+  int64_t cut_after_rx_ = -1;  // chaos: close once rx_ crosses this
+  std::deque<int64_t> frame_ends_;  // SendFrame end offsets in-window
+  LinkState state_ = LinkState::HEALTHY;
+  int64_t epoch_ = 0;
+  int retries_ = 0;
+  double state_since_;
+  std::vector<uint8_t> frame_;  // SendFrame staging
+};
+
+// While one link's reconnect episode waits (dial backoff / accept
+// poll), repair every OTHER link the engine could fix meanwhile. This
+// breaks the cross-plane reconnect deadlock: two single-threaded
+// peers can each be waiting as the ACCEPTOR of a different broken
+// link (rank 0 re-accepting the control link while its peer
+// re-accepts the data link) — each waiting for a dial only the other
+// one's engine thread could make. Probing makes remotely-cut links
+// locally visible (an unread FIN/RST), and a single dial attempt per
+// wait iteration heals every link this side is the dialer of.
+inline void ServiceSiblingLinks(ReconnectHub* hub, TcpLink* busy) {
+  if (!hub) return;
+  for (TcpLink* l : hub->links)
+    if (l != busy) l->ProbeAndRepair();
+}
+
+using LinkPtr = std::unique_ptr<TcpLink>;
+
+}  // namespace hvt

@@ -189,6 +189,11 @@ WIRE_CODECS = ("none", "bf16", "int8", "fp8")
 # error-feedback scalars appended after the codec block (c_api.cc
 # kStatsEfScalars)
 STATS_EF_SCALARS = ("ef_residual_bytes", "ef_residuals_dropped")
+# self-healing link telemetry appended after the EF scalars
+# (csrc/transport.h): reconnect counters per link plane — the {plane}
+# label of hvt_link_reconnects_total — then the replay scalars
+STATS_LINK_PLANES = ("ctrl", "data")
+STATS_RECOVERY_SCALARS = ("frames_replayed", "replay_bytes")
 
 
 def engine_stats() -> dict:
@@ -242,6 +247,13 @@ def engine_stats() -> dict:
     for key in STATS_EF_SCALARS:
         out[key] = vals[lbase]
         lbase += 1
+    out["link_reconnects"] = dict(
+        zip(STATS_LINK_PLANES,
+            vals[lbase:lbase + len(STATS_LINK_PLANES)]))
+    lbase += len(STATS_LINK_PLANES)
+    for key in STATS_RECOVERY_SCALARS:
+        out[key] = vals[lbase]
+        lbase += 1
     return out
 
 
@@ -289,7 +301,7 @@ assert ctypes.sizeof(EngineEvent) == 96, "EngineEvent ABI drift"
 EVENT_KINDS = ("ENQUEUED", "NEGOTIATE_BEGIN", "NEGOTIATE_END",
                "RANK_READY", "FUSED", "EXEC_BEGIN", "EXEC_END", "DONE",
                "CYCLE", "STALL", "WAKEUP", "ABORT", "CTRL_BYTES",
-               "WIRE_BEGIN", "WIRE_END")
+               "WIRE_BEGIN", "WIRE_END", "RECONNECT", "REPLAY")
 
 # index == wire id (csrc/engine.h AbortCause) — the {cause} label of
 # hvt_engine_aborts_total and slots 70..74 of hvt_engine_stats
@@ -305,7 +317,9 @@ STATS_SLOT_COUNT = (len(STATS_SCALARS) + 4 * len(STATS_OPS)
                     + 1 + 3 * STATS_LANE_SLOTS
                     + len(STATS_TAIL_SCALARS)
                     + len(WIRE_CODECS) * len(STATS_OPS)
-                    + len(STATS_EF_SCALARS))
+                    + len(STATS_EF_SCALARS)
+                    + len(STATS_LINK_PLANES)
+                    + len(STATS_RECOVERY_SCALARS))
 
 
 def events_supported() -> bool:
@@ -331,9 +345,11 @@ def drain_events(max_events: int = 4096) -> list:
         kind_name = (EVENT_KINDS[kind]
                      if 0 <= kind < len(EVENT_KINDS) else "?")
         # CTRL_BYTES repurposes the op field as the rank's CtrlRole
-        # wire id (csrc/engine.h ↔ utils/timeline.CTRL_ROLES) — naming
-        # it as a collective op would mislabel every CTRL event
-        op_name = ("" if kind_name == "CTRL_BYTES"
+        # wire id (csrc/engine.h ↔ utils/timeline.CTRL_ROLES), and
+        # RECONNECT/REPLAY repurpose it as the LinkPlane — naming
+        # either as a collective op would mislabel the event
+        op_name = ("" if kind_name in ("CTRL_BYTES", "RECONNECT",
+                                       "REPLAY")
                    else STATS_OPS[op].upper()
                    if 0 <= op < len(STATS_OPS) else "")
         out.append({

@@ -267,6 +267,12 @@ def analyze(events):
     # the members it batches)
     ctrl_by_role = {r: {"instants": 0, "tx_bytes": 0, "rx_bytes": 0}
                     for r in CTRL_ROLES}
+    # self-healing link recovery (RECONNECT/REPLAY cycle-lane instants):
+    # reconnect count, replay volume, and the stall time spent in
+    # RECONNECTING — attributed per link plane
+    recovery = {"reconnects": 0, "frames_replayed": 0,
+                "replay_bytes": 0, "stall_us_total": 0.0,
+                "by_plane": {}}
     ranks = set()
 
     for (pid, tid), evs in sorted(by_lane.items()):
@@ -276,7 +282,27 @@ def analyze(events):
         evs.sort(key=lambda e: e.get("ts", 0))
         if name == "CYCLE":
             for ev in evs:
-                m = _CYCLE_RE.match(ev.get("name", ""))
+                nm = ev.get("name", "")
+                if nm.startswith("RECONNECT(") or nm.startswith("REPLAY("):
+                    args = ev.get("args") or {}
+                    plane = args.get("plane", "?")
+                    bp = recovery["by_plane"].setdefault(
+                        plane, {"reconnects": 0, "replay_bytes": 0,
+                                "stall_us": 0.0})
+                    if nm.startswith("RECONNECT("):
+                        recovery["reconnects"] += 1
+                        dur = float(args.get("duration_us", 0))
+                        recovery["stall_us_total"] += dur
+                        bp["reconnects"] += 1
+                        bp["stall_us"] += dur
+                    else:
+                        recovery["frames_replayed"] += int(
+                            args.get("frames", 0))
+                        recovery["replay_bytes"] += int(
+                            args.get("bytes", 0))
+                        bp["replay_bytes"] += int(args.get("bytes", 0))
+                    continue
+                m = _CYCLE_RE.match(nm)
                 if m:
                     cycles.append(int(m.group(1)))
                     continue
@@ -390,6 +416,10 @@ def analyze(events):
             "ctrl_by_role": {r: d for r, d in ctrl_by_role.items()
                              if d["instants"]},
         },
+        # self-healing links: 0s everywhere on a clean run; reconnects
+        # with zero aborts = a flaky fabric being absorbed; stall_us is
+        # the wall time spent in RECONNECTING across the gang
+        "recovery": recovery,
     }
     metrics = {}
     for p, st in report["phases"].items():
@@ -441,6 +471,16 @@ def print_report(rep, out=None):
         pairs = ", ".join(f"rank {r}: {v}" for r, v in
                           sorted(rep["overlap_efficiency"].items()))
         w(f"\ncompute/comm overlap efficiency: {pairs}\n")
+    rec = rep.get("recovery") or {}
+    if rec.get("reconnects"):
+        w(f"\nrecovery: {rec['reconnects']} link reconnects, "
+          f"{rec['frames_replayed']} frames / {rec['replay_bytes']} B "
+          f"replayed, {rec['stall_us_total'] / 1e3:.1f} ms in "
+          f"RECONNECTING\n")
+        for plane, d in sorted(rec.get("by_plane", {}).items()):
+            w(f"  {plane}: {d['reconnects']} reconnects, "
+              f"{d['replay_bytes']} B replayed, "
+              f"{d['stall_us'] / 1e3:.1f} ms stalled\n")
     cy = rep["cycles"]
     if cy["count"] or cy["ctrl_tx_bytes"]:
         w(f"\ncycles: {cy['count']} with responses, mean "

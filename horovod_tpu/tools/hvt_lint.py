@@ -266,7 +266,9 @@ def check_slots(root: Path):
                                    "STATS_LAT_BUCKETS", "ABORT_CAUSES",
                                    "STATS_LANE_SLOTS",
                                    "STATS_TAIL_SCALARS", "WIRE_CODECS",
-                                   "STATS_EF_SCALARS"})
+                                   "STATS_EF_SCALARS",
+                                   "STATS_LINK_PLANES",
+                                   "STATS_RECOVERY_SCALARS"})
     missing = [k for k in ("STATS_SCALARS", "STATS_OPS",
                            "STATS_LAT_BUCKETS", "ABORT_CAUSES")
                if k not in consts]
@@ -281,6 +283,10 @@ def check_slots(root: Path):
     # block (fixture mini-trees predate the codec registry)
     codecs = list(consts.get("WIRE_CODECS", ()) or ())
     ef = list(consts.get("STATS_EF_SCALARS", ()) or ())
+    # self-healing link block (appended after the EF scalars) —
+    # optional on the same both-sides terms as the codec block
+    planes = list(consts.get("STATS_LINK_PLANES", ()) or ())
+    recovery = list(consts.get("STATS_RECOVERY_SCALARS", ()) or ())
     expected = list(consts["STATS_SCALARS"])
     for grp in SLOT_OP_GROUPS:
         expected += [f"{grp}[{op}]" for op in consts["STATS_OPS"]]
@@ -298,6 +304,8 @@ def check_slots(root: Path):
         expected += [f"codec_tx_bytes[{codec}][{op}]"
                      for op in consts["STATS_OPS"]]
     expected += ef
+    expected += [f"link_reconnects[{p}]" for p in planes]
+    expected += recovery
     if names != expected:
         diffs = [i for i, (a, b) in enumerate(zip(names, expected))
                  if a != b]
@@ -321,6 +329,18 @@ def check_slots(root: Path):
         if (root / CODECS_H).exists() else ""
     c_codecs = _c_int_const(codecs_h, "kWireCodecCount") or 0
     c_ef = _c_int_const(c_api, "kStatsEfScalars") or 0
+    c_planes = _c_int_const(c_api, "kStatsLinkPlanes") or 0
+    c_recovery = _c_int_const(c_api, "kStatsRecoveryScalars") or 0
+    if c_planes != len(planes):
+        vios.append(
+            f"slots: {C_API_CC} kStatsLinkPlanes={c_planes} but "
+            f"{NATIVE_PY} STATS_LINK_PLANES has {len(planes)} entries — "
+            f"the link-reconnect block would decode shifted")
+    if c_recovery != len(recovery):
+        vios.append(
+            f"slots: {C_API_CC} kStatsRecoveryScalars={c_recovery} but "
+            f"{NATIVE_PY} STATS_RECOVERY_SCALARS has {len(recovery)} "
+            f"entries — the replay scalar block would decode shifted")
     if c_codecs != len(codecs):
         vios.append(
             f"slots: {CODECS_H} kWireCodecCount={c_codecs} but "
@@ -350,7 +370,7 @@ def check_slots(root: Path):
                    + len(SLOT_HISTS) * (lat + 1 + 2) + causes
                    + (1 + len(SLOT_LANE_GROUPS) * c_lanes
                       if c_lanes else 0) + c_tail
-                   + c_codecs * ops + c_ef)
+                   + c_codecs * ops + c_ef + c_planes + c_recovery)
         if declared is not None and c_count != declared:
             vios.append(
                 f"slots: {C_API_CC}: C++ layout emits {c_count} slots "
@@ -380,6 +400,9 @@ def check_slots(root: Path):
     if codecs:
         claimed += ["codec_tx_bytes"]
     claimed += ef
+    if planes:
+        claimed += ["link_reconnects"]
+    claimed += recovery
     for key in claimed:
         if f'"{key}"' not in basics:
             vios.append(

@@ -57,7 +57,7 @@ _clock_offset_us = 0.0
 # kind wire ids — must match csrc/events.h EventKind / native.EVENT_KINDS
 _ENQUEUED, _NEG_B, _NEG_E, _RANK_READY, _FUSED, _EXEC_B, _EXEC_E, \
     _DONE, _CYCLE, _STALL, _WAKEUP, _ABORT, _CTRL_BYTES, _WIRE_B, \
-    _WIRE_E = range(15)
+    _WIRE_E, _RECONNECT, _REPLAY = range(17)
 
 # control-plane role names by wire id — must match csrc/engine.h
 # CtrlRole (the CTRL_BYTES event stamps the recording rank's role into
@@ -247,6 +247,29 @@ class _TimelineState:
                         name=f"CTRL({ev['arg']} B tx, "
                              f"{ev['arg2']} B rx)",
                         ts=ts, args={"role": role})
+                continue
+            if kind == _RECONNECT or kind == _REPLAY:
+                # always recorded, like ABORT: link heals are rare
+                # headline events. The event's op field carries the
+                # LinkPlane (0 ctrl, 1 data); the name is the peer
+                # ("rank N"). RECONNECT: arg = dial retries, arg2 =
+                # time spent RECONNECTING (µs) — the stall the heal
+                # cost, which hvt_analyze's recovery section sums.
+                # REPLAY: arg = whole control frames re-sent, arg2 =
+                # bytes re-sent from the replay ring.
+                plane = "ctrl" if ev["op"] == 0 else "data"
+                if kind == _RECONNECT:
+                    args = {"plane": plane, "peer": name,
+                            "retries": ev["arg"],
+                            "duration_us": ev["arg2"]}
+                    label = f"RECONNECT({name}, {plane})"
+                else:
+                    args = {"plane": plane, "peer": name,
+                            "frames": ev["arg"], "bytes": ev["arg2"]}
+                    label = f"REPLAY({name}, {plane})"
+                self._emit({"ph": "i", "pid": self.pid,
+                            "tid": self._cycle_lane(), "name": label,
+                            "ts": ts, "s": "g", "args": args})
                 continue
             if kind == _ABORT:
                 # always recorded (mark_cycles or not): an abort is the

@@ -84,6 +84,7 @@ _SLOW_MODULES = {
     "test_serving",              # 4-proc serving gangs + loadgen replay
     "test_models",               # GPT/ResNet init + flash paths
     "test_sanitizers",           # TSAN/ASAN rebuilds
+    "test_self_healing",         # reconnect/replay chaos gangs
     "test_bench",                # full harness runs
     "test_integrations",         # real gang + HTTP-store suites
 }

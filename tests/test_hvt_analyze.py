@@ -157,6 +157,48 @@ def test_tree_mode_ctrl_role_breakdown():
         rep["cycles"]["ctrl_rx_bytes"] == 850
 
 
+def test_recovery_section_from_reconnect_events():
+    """RECONNECT/REPLAY cycle-lane instants (self-healing links) sum
+    into the recovery section: reconnect count, replay volume, and the
+    RECONNECTING stall time, attributed per plane."""
+    evs = [_meta(0, 9, "CYCLE"), _meta(1, 9, "CYCLE")]
+
+    def rec(pid, ts, plane, retries, dur_us):
+        return {"ph": "i", "pid": pid, "tid": 9, "ts": ts,
+                "name": f"RECONNECT(rank 1, {plane})", "s": "g",
+                "args": {"plane": plane, "peer": "rank 1",
+                         "retries": retries, "duration_us": dur_us}}
+
+    def rep_ev(pid, ts, plane, frames, nbytes):
+        return {"ph": "i", "pid": pid, "tid": 9, "ts": ts,
+                "name": f"REPLAY(rank 1, {plane})", "s": "g",
+                "args": {"plane": plane, "peer": "rank 1",
+                         "frames": frames, "bytes": nbytes}}
+
+    evs += [rec(0, 100, "data", 2, 4000),
+            rec(0, 9000, "ctrl", 1, 1500),
+            rep_ev(0, 150, "data", 0, 65536),
+            rec(1, 120, "data", 0, 3500),
+            rep_ev(1, 170, "ctrl", 3, 96)]
+    rep = A.analyze(evs)
+    rc = rep["recovery"]
+    assert rc["reconnects"] == 3
+    assert rc["frames_replayed"] == 3
+    assert rc["replay_bytes"] == 65536 + 96
+    assert rc["stall_us_total"] == 4000 + 1500 + 3500
+    assert rc["by_plane"]["data"] == {
+        "reconnects": 2, "replay_bytes": 65536, "stall_us": 7500}
+    assert rc["by_plane"]["ctrl"]["reconnects"] == 1
+    assert rc["by_plane"]["ctrl"]["replay_bytes"] == 96
+
+
+def test_recovery_section_zero_on_clean_trace():
+    rep = A.analyze(_synthetic_trace())
+    assert rep["recovery"]["reconnects"] == 0
+    assert rep["recovery"]["replay_bytes"] == 0
+    assert rep["recovery"]["by_plane"] == {}
+
+
 def test_overlap_efficiency_serial_vs_inflight():
     # serial instances → 0 overlap on both ranks
     rep = A.analyze(_synthetic_trace())

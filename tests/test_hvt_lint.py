@@ -260,6 +260,76 @@ def test_tail_scalar_count_mismatch_fails(tmp_path):
     assert any("kStatsTailScalars" in v for v in vios), vios
 
 
+def _add_link_slots(root: Path):
+    """Extend the clean fixture with the self-healing link appendix
+    (PR-10 shape): c_api.cc kStatsLinkPlanes/kStatsRecoveryScalars,
+    native.py STATS_LINK_PLANES/STATS_RECOVERY_SCALARS, manifest rows,
+    and the bridge reads."""
+    ca = root / hvt_lint.C_API_CC
+    ca.write_text(ca.read_text()
+                  .replace("constexpr int kStatsScalars = 2;",
+                           "constexpr int kStatsScalars = 2;\n"
+                           "constexpr int kStatsLinkPlanes = 2;\n"
+                           "constexpr int kStatsRecoveryScalars = 1;")
+                  .replace("static_assert(13 ==", "static_assert(16 =="))
+    np_ = root / hvt_lint.NATIVE_PY
+    np_.write_text('STATS_LINK_PLANES = ("ctrl", "data")\n'
+                   'STATS_RECOVERY_SCALARS = ("replay_z",)\n'
+                   + np_.read_text())
+    sl = root / hvt_lint.STATS_SLOTS_H
+    sl.write_text(sl.read_text()
+                  .replace("#define HVT_STATS_SLOT_COUNT 13",
+                           "#define HVT_STATS_SLOT_COUNT 16")
+                  .rstrip("\n") + ' \\\n  X(13, "link_reconnects[ctrl]")'
+                  ' \\\n  X(14, "link_reconnects[data]")'
+                  ' \\\n  X(15, "replay_z")\n')
+    bp = root / hvt_lint.BASICS_PY
+    bp.write_text(bp.read_text().replace(
+        '"aborts")', '"aborts", "link_reconnects", "replay_z")'))
+
+
+def test_link_slot_fixture_is_clean(tmp_path):
+    make_clean_tree(tmp_path)
+    _add_link_slots(tmp_path)
+    assert hvt_lint.check_slots(tmp_path) == []
+
+
+def test_link_plane_count_mismatch_fails(tmp_path):
+    """c_api.cc kStatsLinkPlanes drifting from native.py
+    STATS_LINK_PLANES would decode the reconnect block shifted."""
+    make_clean_tree(tmp_path)
+    _add_link_slots(tmp_path)
+    p = tmp_path / hvt_lint.C_API_CC
+    p.write_text(p.read_text().replace("kStatsLinkPlanes = 2",
+                                       "kStatsLinkPlanes = 3"))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any("kStatsLinkPlanes" in v for v in vios), vios
+
+
+def test_recovery_scalar_count_mismatch_fails(tmp_path):
+    """c_api.cc kStatsRecoveryScalars drifting from native.py
+    STATS_RECOVERY_SCALARS would decode the replay block shifted."""
+    make_clean_tree(tmp_path)
+    _add_link_slots(tmp_path)
+    p = tmp_path / hvt_lint.C_API_CC
+    p.write_text(p.read_text().replace("kStatsRecoveryScalars = 1",
+                                       "kStatsRecoveryScalars = 2"))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any("kStatsRecoveryScalars" in v for v in vios), vios
+
+
+def test_unread_link_slot_group_fails(tmp_path):
+    """A manifest slot group (link_reconnects) nobody reads in
+    poll_engine_stats is telemetry silently thrown away."""
+    make_clean_tree(tmp_path)
+    _add_link_slots(tmp_path)
+    bp = tmp_path / hvt_lint.BASICS_PY
+    bp.write_text(bp.read_text().replace('"link_reconnects"',
+                                         '"link_ignored"'))
+    vios = hvt_lint.check_slots(tmp_path)
+    assert any('never reads "link_reconnects"' in v for v in vios), vios
+
+
 def test_unread_slot_group_fails(tmp_path):
     make_clean_tree(tmp_path)
     p = tmp_path / hvt_lint.BASICS_PY
@@ -514,4 +584,4 @@ def test_stats_slot_count_matches_python_bridge():
 
     text = (REPO_ROOT / hvt_lint.STATS_SLOTS_H).read_text()
     m = hvt_lint._SLOT_COUNT_RE.search(text)
-    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 134
+    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 138
