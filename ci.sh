@@ -38,6 +38,12 @@
 #                      # gang suite): seeded randomized transient
 #                      # faults over a 4-proc gang, asserting
 #                      # bit-identical results and zero aborts
+#   ./ci.sh --obs      # build + the fleet-telemetry smoke: an 8-rank
+#                      # direct-vs-leader-aggregated push pair over a
+#                      # live /statusz rendezvous server, incl. the
+#                      # hvt_top --once --json round-trip, plus schema
+#                      # --check of the fresh AND committed
+#                      # benchmarks/r13_telemetry_scaling.json
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
@@ -62,6 +68,7 @@ REBASELINE=0
 SCALE=0
 CODEC=0
 SOAK=0
+OBS=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
@@ -71,6 +78,7 @@ SOAK=0
 [[ "${1:-}" == "--scale" ]] && SCALE=1
 [[ "${1:-}" == "--codec" ]] && CODEC=1
 [[ "${1:-}" == "--soak" ]] && SOAK=1
+[[ "${1:-}" == "--obs" ]] && OBS=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -166,6 +174,26 @@ if [[ "$PERFGATE" == "1" || "$REBASELINE" == "1" ]]; then
   python -m horovod_tpu.tools.hvt_analyze --diff \
     benchmarks/perf_baseline.json "$ART"
   echo "CI OK (perfgate; report kept at $ART)"
+  exit 0
+fi
+
+if [[ "$OBS" == "1" ]]; then
+  echo "=== [2/2] fleet-telemetry smoke (direct vs leader-aggregated) ==="
+  # 8-rank / 2-host pair over a live /statusz rendezvous server. Byte
+  # metrics are workload-determined, so the reduction claim is stable
+  # on a loaded box; the run itself asserts the hvt_top --once --json
+  # round-trip and the clean-gang (no-alerts) pin, and --check gates
+  # both on the fresh AND the committed artifact. The committed
+  # benchmarks/r13_telemetry_scaling.json comes from the full 64-rank
+  # --capture matrix — see BENCH_NOTES r13.
+  ART=$(mktemp /tmp/hvt_telemetry_XXXX.json)
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    python benchmarks/telemetry_scaling.py --smoke --out "$ART"
+  python benchmarks/telemetry_scaling.py --check "$ART"
+  python benchmarks/telemetry_scaling.py --check \
+    benchmarks/r13_telemetry_scaling.json
+  rm -f "$ART"
+  echo "CI OK (obs)"
   exit 0
 fi
 

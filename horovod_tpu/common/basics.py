@@ -692,20 +692,33 @@ def diagnostics() -> dict:
     return out
 
 
+def _telemetry_snapshot(rank: int):
+    """This worker's push payload: diagnostics + the compact telemetry
+    record + the mergeable counters frame (metrics/telemetry.py)."""
+    from horovod_tpu.engine import native
+    from horovod_tpu.metrics import telemetry as _telemetry
+
+    stats = native.engine_stats() if native.available() else {}
+    return _telemetry.build_snapshot(rank, _telemetry.host_name(),
+                                     diagnostics(), stats)
+
+
 def _debugz_push_loop(addr: str, rank: int, stop: "threading.Event",
-                      period_sec: float = 5.0):
-    """PUT this worker's diagnostics to ``/kv/debugz/<rank>`` until
-    stopped — the worker-side half of ``GET /debugz``. Best-effort: a
-    dead rendezvous server must never disturb training."""
-    import json as _json
+                      period_sec: float = None):
+    """Push this worker's telemetry until stopped — the worker-side
+    half of ``GET /debugz`` / ``GET /statusz``. Best-effort: a dead
+    rendezvous server must never disturb training.
 
-    from horovod_tpu.runner.http_client import put_bytes
+    The period is ``HVT_DEBUGZ_INTERVAL_MS`` (default 5000) with ±25%
+    jitter per tick — without the jitter every rank pushes on the same
+    phase, a thundering herd on the rendezvous server at 64+ ranks.
+    Under ``HVT_CTRL_TOPOLOGY=tree`` (or ``HVT_TELEMETRY_AGG=1``)
+    members push to their host leader, which PUTs one merged frame per
+    host (``/kv/telemetry/host/<host>``) so the driver's ingest cost is
+    O(hosts); star topology keeps the direct per-rank
+    ``/kv/debugz/<rank>`` pushes."""
+    from horovod_tpu.metrics import telemetry as _telemetry
 
-    while True:
-        try:
-            put_bytes(addr, f"/kv/debugz/{rank}",
-                      _json.dumps(diagnostics()).encode(), timeout=3)
-        except Exception:
-            pass
-        if stop.wait(period_sec):
-            return
+    _telemetry.TelemetryPusher(
+        addr, rank, lambda: _telemetry_snapshot(rank), stop,
+        period_sec=period_sec).run()

@@ -23,6 +23,16 @@ Consumption paths:
 - ``MetricsCallback`` (``hvt.jax.callbacks`` / ``hvt.keras``) folding
   training-loop metrics into the registry.
 
+Fleet-scale surfaces (PR 13):
+
+- :mod:`horovod_tpu.metrics.merge` — the associative snapshot-merge
+  algebra (counters summed, gauges maxed, histogram buckets added)
+  per-host telemetry leaders fold member snapshots with;
+- :mod:`horovod_tpu.metrics.telemetry` — the leader-aggregated push
+  plane, the ``/statusz`` gang rollup, and the health-rule engine
+  behind ``hvt_health_alerts_total`` (live monitor: ``python -m
+  horovod_tpu.tools.hvt_top``).
+
 Typical use::
 
     from horovod_tpu import metrics

@@ -1,0 +1,958 @@
+"""Fleet telemetry plane — leader-aggregated push, gang health rollup.
+
+Every observability surface before this module was per-rank and
+pull/post-hoc: all N workers PUT their debugz snapshots straight to the
+single rendezvous HTTP server (``common/basics.py`` push loop), and a
+human reads one rank at a time. That is an O(ranks) scrape hub — the
+same fan-in shape the hierarchical control plane (PR 8) removed from
+negotiation. This module applies the identical collapse to telemetry:
+
+- **Per-rank snapshots** (:func:`build_snapshot`): the existing
+  ``hvt.diagnostics()`` dict enriched with a fixed-size ``telemetry``
+  compact record and a ``metrics`` counter frame
+  (``horovod_tpu/metrics/merge.py``).
+- **Leader aggregation** (:class:`TelemetryPusher` +
+  :class:`HostAggregator`): members push snapshots to their *host
+  leader* over loopback; the leader merges them (counters summed,
+  gauges maxed, histogram buckets added — see ``merge.py``) and PUTs
+  ONE host frame to ``/kv/telemetry/host/<host>``, so the driver's
+  ingest cost is O(hosts). Leadership follows the control plane's
+  per-host-leader shape: the rank with local process id 0 on each
+  host. Star fallback: with ``HVT_CTRL_TOPOLOGY=star`` (or
+  ``HVT_TELEMETRY_AGG=0``) every rank PUTs directly to
+  ``/kv/debugz/<rank>`` exactly as before.
+- **Gang rollup** (:class:`StatuszBuilder` + :class:`HealthEngine`):
+  the driver-side fold behind ``GET /statusz``
+  (``runner/http_server.py``) — per-rank liveness, lane depths,
+  link states, straggler evidence from rank 0's arrival tables,
+  ctrl/wire/EF byte rates, active codecs, plus a rolling-window
+  health-rule engine emitting ``hvt_health_alerts_total{rule}`` and an
+  ``alerts`` list the elastic autoscaler consumes.
+
+The live monitor over ``/statusz`` is ``python -m
+horovod_tpu.tools.hvt_top``.
+
+Import-light by design (stdlib + ``metrics.registry``/``merge`` +
+a lazily-imported HTTP client): the simulated 64-rank harness
+(``benchmarks/telemetry_scaling.py``) loads it into featherweight
+MiniEngine workers with no jax/numpy in the process.
+
+Knobs (all rowed in ``docs/metrics.md``):
+
+- ``HVT_DEBUGZ_INTERVAL_MS`` — push period (default 5000), applied
+  with ±25% jitter per tick so 64+ ranks never phase-lock into a
+  thundering herd on the rendezvous server.
+- ``HVT_TELEMETRY_AGG`` — ``auto`` (default: leader aggregation iff
+  ``HVT_CTRL_TOPOLOGY=tree``), ``1`` force on, ``0`` force off.
+- ``HVT_TELEMETRY_ROLE`` — explicit ``leader``/``member``/``direct``
+  override (harnesses; normal jobs derive the role).
+- ``HVT_HEALTH_STRAGGLER_WINDOWS`` / ``HVT_HEALTH_RECONNECT_STORM`` /
+  ``HVT_HEALTH_STALE_INTERVALS`` / ``HVT_HEALTH_BACKLOG_WINDOWS`` —
+  health-rule thresholds (see :class:`HealthEngine`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import re
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional
+
+from horovod_tpu.metrics import merge as _merge
+
+TELEMETRY_SCHEMA = "hvt-telemetry-host-r1"
+STATUSZ_SCHEMA = "hvt-statusz-r1"
+TELEMETRY_SCOPE = "telemetry"
+
+# Only negotiations that have been waiting at least this long count as
+# straggler evidence: rank 0's arrival table is a point sample, and a
+# healthy gang always has µs-scale open negotiations in flight — without
+# the floor, a clean gang would trip the straggler rule on snapshot
+# timing alone (the false-positive pin in tests/test_telemetry.py runs
+# with the persistence threshold at its most trigger-happy setting).
+STRAGGLER_MIN_WAIT_SEC = 0.5
+
+# How many per-rank stall/negotiation entries a compact record keeps —
+# the host frame must stay O(1) per rank or the O(hosts) scrape-cost
+# claim quietly erodes.
+_COMPACT_CAP = 8
+
+
+# Env reads stay literal (no name indirection) so the env↔docs lint
+# pass sees every knob.
+def _as_float(raw, default: float) -> float:
+    try:
+        return float(raw) if raw not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def interval_sec() -> float:
+    """The debugz/telemetry push period (HVT_DEBUGZ_INTERVAL_MS)."""
+    return max(0.05, _as_float(
+        os.environ.get("HVT_DEBUGZ_INTERVAL_MS"), 5000.0) / 1e3)
+
+
+def jittered(period_sec: float) -> float:
+    """±25% full jitter: every rank pushing on the same 5 s phase is a
+    thundering herd at 64+ ranks; decorrelating the phases flattens the
+    rendezvous server's arrival process."""
+    return period_sec * (0.75 + 0.5 * random.random())
+
+
+def host_name() -> str:
+    """This rank's host identity — the leader-election and frame key.
+    ``HVT_TOPO_HOST`` (the same knob the engine's tree leaders key on,
+    letting harnesses fake multi-host layouts on loopback) wins over
+    the launcher's ``HVT_HOSTNAME`` and the kernel hostname."""
+    return (os.environ.get("HVT_TOPO_HOST")
+            or os.environ.get("HVT_HOSTNAME")
+            or socket.gethostname())
+
+
+def telemetry_role() -> str:
+    """``leader`` / ``member`` / ``direct`` for this rank.
+
+    Explicit ``HVT_TELEMETRY_ROLE`` wins. Otherwise leader aggregation
+    is active iff ``HVT_TELEMETRY_AGG`` is ``1``, or ``auto``/unset
+    with ``HVT_CTRL_TOPOLOGY=tree`` (telemetry reuses the control
+    plane's per-host-leader shape); under star topology every rank
+    pushes directly — the pre-aggregation behavior, bit-for-bit."""
+    explicit = os.environ.get("HVT_TELEMETRY_ROLE", "").strip().lower()
+    if explicit in ("leader", "member", "direct"):
+        return explicit
+    agg = os.environ.get("HVT_TELEMETRY_AGG", "auto").strip().lower()
+    if agg in ("0", "off", "false"):
+        return "direct"
+    if agg not in ("1", "on", "true"):
+        if os.environ.get("HVT_CTRL_TOPOLOGY", "star") != "tree":
+            return "direct"
+    local = os.environ.get("HVT_LOCAL_PROCESS_ID")
+    try:
+        local_id = int(local)
+    except (TypeError, ValueError):
+        # absent or malformed — cannot tell who leads this host, and a
+        # raise here would silently kill the daemon push thread;
+        # direct is always correct
+        return "direct"
+    return "leader" if local_id == 0 else "member"
+
+
+# ---------------------------------------------------------------------------
+# stats normalization + snapshot builders
+# ---------------------------------------------------------------------------
+
+_FLAT_RE = re.compile(r"^(\w+)\[(\w+)\]$")
+
+
+def _normalize_stats(stats: dict) -> dict:
+    """Accept either ``engine/native.py:engine_stats()``'s decoded form
+    or the flat ``stats_slots.h``-manifest form the MiniEngine harness
+    reads (``lane_depth[0]``, ``link_reconnects[ctrl]``, ...), and
+    return the decoded shape this module consumes."""
+    stats = stats or {}
+    if "lane_depth" in stats or not any(_FLAT_RE.match(k)
+                                        for k in stats):
+        return stats
+    out = dict(stats)
+    nested: Dict[str, dict] = {}
+    for k, v in stats.items():
+        m = _FLAT_RE.match(k)
+        if m:
+            nested.setdefault(m.group(1), {})[m.group(2)] = v
+    for key, sub in nested.items():
+        if all(s.isdigit() for s in sub):
+            out[key] = [sub.get(str(i), 0)
+                        for i in range(max(int(s) for s in sub) + 1)]
+        else:
+            out[key] = sub
+    return out
+
+
+def counters_frame(rank: int, stats: dict) -> dict:
+    """A small, fixed-schema metrics frame (``merge.frame``) carrying
+    the counters the gang rollup sums and rates: one frame per rank,
+    merged leader-side. Kept deliberately narrow — the full registry
+    snapshot is a per-rank scrape surface, not a push payload."""
+    stats = _normalize_stats(stats)
+    wire_total = sum((stats.get("wire_tx_bytes") or {}).values())
+    lr = stats.get("link_reconnects") or {}
+
+    def counter(value, help_=""):
+        return {"type": "counter", "help": help_,
+                "samples": [{"labels": {}, "value": float(value)}]}
+
+    def gauge(value, help_=""):
+        return {"type": "gauge", "help": help_,
+                "samples": [{"labels": {}, "value": float(value)}]}
+
+    metrics = {
+        "hvt_engine_cycles_total": counter(stats.get("cycles", 0)),
+        "hvt_cache_hits_total": counter(stats.get("cache_hits", 0)),
+        "hvt_ctrl_tx_bytes_total": counter(stats.get("ctrl_tx_bytes", 0)),
+        "hvt_ctrl_rx_bytes_total": counter(stats.get("ctrl_rx_bytes", 0)),
+        "hvt_wire_tx_bytes_total": counter(wire_total),
+        "hvt_frames_replayed_total": counter(
+            stats.get("frames_replayed", 0)),
+        "hvt_link_replay_bytes_total": counter(
+            stats.get("replay_bytes", 0)),
+        "hvt_link_reconnects_total": {
+            "type": "counter", "help": "",
+            "samples": [{"labels": {"plane": p}, "value": float(v)}
+                        for p, v in sorted(lr.items())]},
+        "hvt_ef_residual_bytes": gauge(stats.get("ef_residual_bytes", 0)),
+        "hvt_lane_depth": {
+            "type": "gauge", "help": "",
+            "samples": [{"labels": {"lane": str(i)}, "value": float(v)}
+                        for i, v in
+                        enumerate(stats.get("lane_depth") or ())]},
+    }
+    return _merge.frame(rank, metrics)
+
+
+def compact_rank(snap: dict) -> dict:
+    """The O(1)-size per-rank record a host frame carries (and the
+    record ``/statusz`` renders per rank): liveness-adjacent engine
+    state, lane depths, link health, byte totals, codecs, and the
+    worst stalls/negotiations — everything the "which rank/link/lane?"
+    question needs, nothing sized by tensor count."""
+    eng = snap.get("engine") or {}
+    stats = _normalize_stats(snap.get("stats") or {})
+    links = snap.get("links") or []
+    by_state: Dict[str, List[int]] = {}
+    for l in links:
+        by_state.setdefault(l.get("state", "?"), []).append(
+            l.get("peer", -1))
+
+    def trim(entries):
+        rows = [{"tensor": n.get("tensor", "?"),
+                 "waiting_sec": n.get("waiting_sec", 0.0),
+                 "missing_ranks": n.get("missing_ranks", [])}
+                for n in (entries or [])
+                if n.get("missing_ranks")]
+        rows.sort(key=lambda r: -r["waiting_sec"])
+        return rows[:_COMPACT_CAP]
+
+    lr = stats.get("link_reconnects") or {}
+    out = {
+        "rank": snap.get("rank", snap.get("process_rank", -1)),
+        "host": snap.get("host", "?"),
+        "running": bool(eng.get("running")),
+        "broken": bool(eng.get("broken")),
+        "cycles": eng.get("cycles", 0),
+        "queue_depth": eng.get("queue_depth", 0),
+        "pending": len(snap.get("pending") or ()),
+        "lane_depth": list(stats.get("lane_depth") or ()),
+        "links": {
+            "healthy": len(by_state.get("healthy", ())),
+            "reconnecting": sorted(by_state.get("reconnecting", ())),
+            "dead": sorted(by_state.get("dead", ())),
+        },
+        "reconnects": {"ctrl": lr.get("ctrl", 0),
+                       "data": lr.get("data", 0)},
+        "bytes": {
+            "ctrl_tx": stats.get("ctrl_tx_bytes", 0),
+            "ctrl_rx": stats.get("ctrl_rx_bytes", 0),
+            "wire_tx": sum((stats.get("wire_tx_bytes") or {}).values()),
+            "ef_residual": stats.get("ef_residual_bytes", 0),
+        },
+        "codecs": eng.get("wire") or {},
+        "stalls": trim(snap.get("stalls")),
+    }
+    negotiations = trim(snap.get("negotiations"))
+    if negotiations:
+        out["negotiations"] = negotiations
+    return out
+
+
+def build_snapshot(rank: int, host: str, diag: dict, stats: dict,
+                   serving: Optional[dict] = None) -> dict:
+    """The per-rank push payload: the raw diagnostics dict (back-compat
+    with every existing ``/debugz`` consumer) + ``host``/``stats`` +
+    the compact ``telemetry`` record + the mergeable ``metrics``
+    frame."""
+    snap = dict(diag or {})
+    snap["rank"] = rank
+    snap["host"] = host
+    snap["stats"] = _normalize_stats(stats)
+    if serving:
+        snap["serving"] = serving
+    snap["telemetry"] = compact_rank(snap)
+    snap["metrics"] = counters_frame(rank, snap["stats"])
+    # the full stats dict was only an input to the compact/metrics
+    # fold; shipping it would re-inflate the payload the fold exists
+    # to shrink (keep the normalized lane/link views via telemetry)
+    snap.pop("stats")
+    return snap
+
+
+def build_host_frame(host: str, leader_rank: int,
+                     members: Dict[int, dict],
+                     member_age_sec: Dict[int, float],
+                     period_sec: float) -> dict:
+    """Fold member snapshots into the ONE frame the leader PUTs to
+    ``/kv/telemetry/host/<host>``."""
+    ranks = {}
+    merged = _merge.merge()
+    for r, snap in sorted(members.items()):
+        ranks[str(r)] = snap.get("telemetry") or compact_rank(snap)
+        fr = snap.get("metrics")
+        if fr is None:
+            fr = counters_frame(r, snap.get("stats") or {})
+        try:
+            merged = _merge.merge(merged, fr)
+        except Exception:
+            # a malformed member frame (type/layout drift, wrong
+            # shapes) costs THAT member's counters, never the whole
+            # host frame — its compact record above still rides
+            continue
+    frame = {
+        "schema": TELEMETRY_SCHEMA,
+        "host": host,
+        "leader_rank": leader_rank,
+        "interval_sec": round(period_sec, 3),
+        "ranks": ranks,
+        "member_age_sec": {str(r): round(a, 3)
+                           for r, a in sorted(member_age_sec.items())},
+        "metrics": merged,
+    }
+    # rank-0's arrival table rides at frame top level too: the statusz
+    # straggler rules need it without walking every rank record
+    for snap in members.values():
+        neg = (snap.get("telemetry") or {}).get("negotiations")
+        if neg:
+            frame["negotiations"] = neg
+            break
+    return frame
+
+
+# ---------------------------------------------------------------------------
+# leader-side member aggregator
+# ---------------------------------------------------------------------------
+
+class HostAggregator:
+    """Loopback HTTP endpoint on the host leader: members PUT their
+    snapshots to ``/push/<rank>``; the leader's push tick folds the
+    latest copies into one host frame. Members and leader share a host
+    by construction, so the endpoint binds loopback-reachable and the
+    member→leader hop never crosses the fabric."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._members: Dict[int, tuple] = {}  # rank -> (snap, mono_sec)
+        self._server = None
+
+    def ingest(self, rank: int, snap: dict, now: Optional[float] = None):
+        with self._lock:
+            self._members[int(rank)] = (
+                snap, time.monotonic() if now is None else now)
+
+    def members(self, now: Optional[float] = None,
+                max_age_sec: Optional[float] = None):
+        """(snapshots, ages) — entries older than ``max_age_sec`` are
+        dropped from the fold (the driver-side TTL sweep handles the
+        frame level; this handles a member that died mid-job)."""
+        now = time.monotonic() if now is None else now
+        snaps, ages = {}, {}
+        with self._lock:
+            for r, (snap, t) in self._members.items():
+                age = max(0.0, now - t)
+                if max_age_sec is not None and age > max_age_sec:
+                    continue
+                snaps[r] = snap
+                ages[r] = age
+        return snaps, ages
+
+    def start(self, port: int = 0) -> int:
+        agg = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_PUT(self):
+                parts = self.path.strip("/").split("/")
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                if len(parts) == 2 and parts[0] == "push":
+                    try:
+                        agg.ingest(int(parts[1]), json.loads(body))
+                    except (ValueError, TypeError):
+                        self.send_response(400)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    self.send_response(200)
+                else:
+                    self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        # loopback-only on purpose: members share the leader's host by
+        # construction and dial 127.0.0.1, and this endpoint accepts
+        # unauthenticated PUTs that flow straight into the host frame —
+        # it must not be reachable off-host
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+        return self._server.server_address[1]
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._server.server_address[1] if self._server else None
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+# ---------------------------------------------------------------------------
+# the push loop (all roles)
+# ---------------------------------------------------------------------------
+
+class TelemetryPusher:
+    """One rank's telemetry push driver.
+
+    - ``direct``: PUT the full snapshot to ``/kv/debugz/<rank>`` (the
+      pre-aggregation wire surface, unchanged).
+    - ``leader``: run a :class:`HostAggregator`, publish its endpoint
+      under ``/kv/telemetry/ep/<host>``, and each tick fold own + member
+      snapshots into ``/kv/telemetry/host/<host>``.
+    - ``member``: discover the leader endpoint from the KV and PUT the
+      snapshot to the leader; after ``_FALLBACK_AFTER`` consecutive
+      failures fall back to direct pushes (re-probing the leader each
+      tick) so a dead leader degrades to the star shape instead of
+      going dark.
+
+    Best-effort everywhere: a dead rendezvous server or leader must
+    never disturb training.
+    """
+
+    _FALLBACK_AFTER = 3
+
+    def __init__(self, addr: str, rank: int,
+                 snapshot_fn: Callable[[], dict],
+                 stop: "threading.Event",
+                 host: Optional[str] = None,
+                 role: Optional[str] = None,
+                 period_sec: Optional[float] = None,
+                 timeout: float = 3.0):
+        self.addr = addr
+        self.rank = int(rank)
+        self.host = host or host_name()
+        self.role = role or telemetry_role()
+        self.period_sec = (period_sec if period_sec is not None
+                           else interval_sec())
+        self._snapshot_fn = snapshot_fn
+        self._stop = stop
+        self._timeout = timeout
+        self._agg: Optional[HostAggregator] = None
+        self._leader_ep: Optional[str] = None
+        self._member_failures = 0
+        self.pushes = 0  # introspection/tests
+
+    # ----------------------------------------------------------- plumbing
+    def _put(self, path: str, obj: dict) -> bool:
+        from horovod_tpu.runner.http_client import put_bytes
+
+        try:
+            put_bytes(self.addr, path, json.dumps(obj).encode(),
+                      timeout=self._timeout, retries=0)
+            return True
+        except Exception:
+            return False
+
+    def _discover_leader(self) -> Optional[str]:
+        from horovod_tpu.runner.http_client import get_json
+
+        try:
+            ep = get_json(self.addr,
+                          f"/kv/{TELEMETRY_SCOPE}/ep/{self.host}",
+                          timeout=self._timeout, retries=0)
+        except Exception:
+            return None
+        return ep.get("addr") if isinstance(ep, dict) else None
+
+    # -------------------------------------------------------------- roles
+    def _ensure_leader(self):
+        if self._agg is None:
+            self._agg = HostAggregator()
+            self._agg.start()
+
+    def step(self) -> bool:
+        """One push tick; returns True when the snapshot reached its
+        destination (server, leader, or fallback server)."""
+        try:
+            snap = self._snapshot_fn()
+        except Exception:
+            return False
+        ok = False
+        if self.role == "leader":
+            self._ensure_leader()
+            # re-published every tick: ~60 bytes of insurance against
+            # an elastic rendezvous restart losing the endpoint key
+            self._put(f"/kv/{TELEMETRY_SCOPE}/ep/{self.host}",
+                      {"addr": f"127.0.0.1:{self._agg.port}",
+                       "rank": self.rank})
+            self._agg.ingest(self.rank, snap)
+            members, ages = self._agg.members(
+                max_age_sec=max(10 * self.period_sec, 30.0))
+            frame = build_host_frame(self.host, self.rank, members,
+                                     ages, self.period_sec)
+            ok = self._put(f"/kv/{TELEMETRY_SCOPE}/host/{self.host}",
+                           frame)
+        elif self.role == "member":
+            ok = self._push_member(snap)
+        else:
+            ok = self._put(f"/kv/debugz/{self.rank}", snap)
+        if ok:
+            self.pushes += 1
+        return ok
+
+    def _push_member(self, snap: dict) -> bool:
+        from horovod_tpu.runner.http_client import put_bytes
+
+        if self._leader_ep is None:
+            self._leader_ep = self._discover_leader()
+        if self._leader_ep is not None:
+            try:
+                put_bytes(self._leader_ep, f"/push/{self.rank}",
+                          json.dumps(snap).encode(),
+                          timeout=self._timeout, retries=0)
+                self._member_failures = 0
+                return True
+            except Exception:
+                self._member_failures += 1
+                self._leader_ep = None  # re-discover next tick
+        else:
+            self._member_failures += 1
+        if self._member_failures >= self._FALLBACK_AFTER:
+            # leader gone: degrade to the star shape rather than dark
+            return self._put(f"/kv/debugz/{self.rank}", snap)
+        return False
+
+    def close(self):
+        """Tear down the leader-side aggregator endpoint (harnesses
+        that drive :meth:`step` manually call this at exit)."""
+        if self._agg is not None:
+            self._agg.stop()
+            self._agg = None
+
+    def run(self):
+        """The loop ``common/basics.py`` parks in a daemon thread:
+        jittered period, exits on the stop event, final aggregator
+        teardown on the way out. Best-effort to the letter: a raising
+        tick (a member PUTting a malformed snapshot that breaks the
+        leader's merge, a bind failure, ...) must cost ONE window, not
+        kill the thread and go dark for the rest of the job."""
+        try:
+            while True:
+                try:
+                    self.step()
+                except Exception:
+                    pass
+                if self._stop.wait(jittered(self.period_sec)):
+                    return
+        finally:
+            self.close()
+
+
+# ---------------------------------------------------------------------------
+# health rules
+# ---------------------------------------------------------------------------
+
+class HealthEngine:
+    """Rolling-window health rules over successive gang observations.
+
+    Rules (all thresholds env-tunable, defaults conservative):
+
+    - ``straggler`` — the same rank appears as straggler evidence
+      (missing from a negotiation waiting ≥ ``STRAGGLER_MIN_WAIT_SEC``)
+      in ``HVT_HEALTH_STRAGGLER_WINDOWS`` consecutive windows.
+    - ``reconnect_storm`` — ≥ ``HVT_HEALTH_RECONNECT_STORM`` link
+      reconnects summed over the last 3 windows (a link flapping
+      faster than it carries traffic).
+    - ``push_stale`` — a rank's last snapshot is older than
+      ``HVT_HEALTH_STALE_INTERVALS`` push intervals (the worker died,
+      wedged, or lost the rendezvous server).
+    - ``serving_backlog`` — the gang-wide serving backlog grew strictly
+      across ``HVT_HEALTH_BACKLOG_WINDOWS`` consecutive windows
+      (sustained overload, the autoscaler's scale-out cue).
+
+    ``observe()`` ingests at most once per half push-interval — the
+    rules advance with *pushed data*, not with scrape frequency, so a
+    dashboard polling ``/statusz`` at 10 Hz cannot fast-forward a
+    persistence window. Newly-firing rules increment
+    ``hvt_health_alerts_total{rule}``; an alert stays in the active
+    list while its condition holds.
+    """
+
+    RECONNECT_LOOKBACK = 3
+
+    def __init__(self, straggler_windows: Optional[int] = None,
+                 reconnect_storm: Optional[int] = None,
+                 stale_intervals: Optional[float] = None,
+                 backlog_windows: Optional[int] = None,
+                 alert_counter=None):
+        self.straggler_windows = int(
+            straggler_windows if straggler_windows is not None
+            else _as_float(
+                os.environ.get("HVT_HEALTH_STRAGGLER_WINDOWS"), 3))
+        self.reconnect_storm = int(
+            reconnect_storm if reconnect_storm is not None
+            else _as_float(
+                os.environ.get("HVT_HEALTH_RECONNECT_STORM"), 3))
+        self.stale_intervals = float(
+            stale_intervals if stale_intervals is not None
+            else _as_float(
+                os.environ.get("HVT_HEALTH_STALE_INTERVALS"), 3))
+        self.backlog_windows = int(
+            backlog_windows if backlog_windows is not None
+            else _as_float(
+                os.environ.get("HVT_HEALTH_BACKLOG_WINDOWS"), 3))
+        self._alert_counter = alert_counter
+        self._last_ingest: Optional[float] = None
+        self._straggler_consec: Dict[int, int] = {}
+        self._straggler_tensors: Dict[int, List[str]] = {}
+        self._straggler_windows_seen: Dict[int, int] = {}
+        self._reconnect_prev: Optional[float] = None
+        self._reconnect_deltas: List[float] = []
+        self._backlogs: List[float] = []
+        self._active: Dict[tuple, dict] = {}
+        self._alerts: List[dict] = []
+        self.windows = 0
+
+    # ------------------------------------------------------------ internals
+    def _counter(self):
+        if self._alert_counter is not None:
+            return self._alert_counter
+        try:
+            from horovod_tpu import metrics as _metrics
+
+            return _metrics.counter(
+                "hvt_health_alerts_total",
+                "gang health-rule activations by rule (statusz health "
+                "engine; incremented when a rule newly fires)", ("rule",))
+        except Exception:
+            return None
+
+    def _set_active(self, now: float, fired: Dict[tuple, dict]):
+        for key, alert in fired.items():
+            prev = self._active.get(key)
+            if prev is None:
+                alert["since_sec"] = 0.0
+                alert["_since"] = now
+                c = self._counter()
+                if c is not None:
+                    try:
+                        c.labels(rule=alert["rule"]).inc()
+                    except Exception:
+                        pass
+            else:
+                alert["_since"] = prev["_since"]
+                alert["since_sec"] = round(now - prev["_since"], 1)
+        self._active = fired
+        self._alerts = [
+            {k: v for k, v in a.items() if not k.startswith("_")}
+            for _, a in sorted(fired.items())]
+
+    # -------------------------------------------------------------- observe
+    def observe(self, obs: dict, now: Optional[float] = None) -> list:
+        """Ingest one gang observation; returns the active alerts.
+
+        ``obs`` keys: ``interval_sec``, ``stragglers`` ({rank:
+        [tensors]}), ``reconnect_total`` (gang-wide cumulative),
+        ``rank_ages`` ({rank: age_sec}), ``backlog`` (float),
+        ``ranks_expected`` / ``ranks_covered`` (ints, optional)."""
+        now = time.monotonic() if now is None else now
+        ival = float(obs.get("interval_sec") or interval_sec())
+        if (self._last_ingest is not None
+                and now - self._last_ingest < 0.5 * ival):
+            return self.alerts()
+        self._last_ingest = now
+        self.windows += 1
+
+        # straggler persistence
+        stragglers = {int(r): list(ts)
+                      for r, ts in (obs.get("stragglers") or {}).items()}
+        for r in list(self._straggler_consec):
+            if r not in stragglers:
+                self._straggler_consec[r] = 0
+        for r, tensors in stragglers.items():
+            self._straggler_consec[r] = self._straggler_consec.get(r, 0) + 1
+            self._straggler_windows_seen[r] = \
+                self._straggler_windows_seen.get(r, 0) + 1
+            self._straggler_tensors[r] = tensors[:4]
+
+        # reconnect storm (deltas of the gang-wide cumulative counter)
+        total = float(obs.get("reconnect_total") or 0)
+        if self._reconnect_prev is not None:
+            # an engine restart resets counters; a negative delta is a
+            # new epoch, not -N reconnects
+            self._reconnect_deltas.append(
+                max(0.0, total - self._reconnect_prev))
+            self._reconnect_deltas = \
+                self._reconnect_deltas[-self.RECONNECT_LOOKBACK:]
+        self._reconnect_prev = total
+
+        # serving backlog growth
+        self._backlogs.append(float(obs.get("backlog") or 0))
+        self._backlogs = self._backlogs[-(self.backlog_windows + 1):]
+
+        fired: Dict[tuple, dict] = {}
+        for r, n in self._straggler_consec.items():
+            if n >= self.straggler_windows:
+                fired[("straggler", r)] = {
+                    "rule": "straggler", "severity": "warn",
+                    "subject": f"rank {r}", "windows": n,
+                    "detail": (f"rank {r} missing from negotiations in "
+                               f"{n} consecutive window(s); tensors: "
+                               f"{self._straggler_tensors.get(r, [])}")}
+        storm = sum(self._reconnect_deltas)
+        if self.reconnect_storm > 0 and storm >= self.reconnect_storm:
+            fired[("reconnect_storm", 0)] = {
+                "rule": "reconnect_storm", "severity": "warn",
+                "subject": "links", "windows": len(self._reconnect_deltas),
+                "detail": (f"{storm:.0f} link reconnect(s) in the last "
+                           f"{len(self._reconnect_deltas)} window(s)")}
+        stale_after = self.stale_intervals * ival
+        for r, age in sorted((obs.get("rank_ages") or {}).items()):
+            if age is not None and age > stale_after:
+                fired[("push_stale", int(r))] = {
+                    "rule": "push_stale", "severity": "page",
+                    "subject": f"rank {r}", "windows": 1,
+                    "detail": (f"rank {r} last pushed {age:.1f}s ago "
+                               f"(> {stale_after:.1f}s = "
+                               f"{self.stale_intervals:g} intervals)")}
+        if (len(self._backlogs) >= self.backlog_windows + 1
+                and self._backlogs[-1] > 0
+                and all(b > a for a, b in zip(self._backlogs,
+                                              self._backlogs[1:]))):
+            fired[("serving_backlog", 0)] = {
+                "rule": "serving_backlog", "severity": "warn",
+                "subject": "serving", "windows": self.backlog_windows,
+                "detail": (f"serving backlog grew "
+                           f"{self._backlogs[0]:.0f} -> "
+                           f"{self._backlogs[-1]:.0f} over "
+                           f"{self.backlog_windows} window(s)")}
+        self._set_active(now, fired)
+        return self.alerts()
+
+    def alerts(self) -> list:
+        return list(self._alerts)
+
+    def straggler_ranking(self, top_k: int = 5) -> list:
+        """Ranks by how many windows they appeared as stragglers —
+        the /statusz ``stragglers`` section."""
+        rows = [{"rank": r, "windows": n,
+                 "consecutive": self._straggler_consec.get(r, 0),
+                 "tensors": self._straggler_tensors.get(r, [])}
+                for r, n in self._straggler_windows_seen.items() if n]
+        rows.sort(key=lambda d: (-d["windows"], d["rank"]))
+        return rows[:top_k]
+
+
+# ---------------------------------------------------------------------------
+# /statusz rollup
+# ---------------------------------------------------------------------------
+
+class StatuszBuilder:
+    """The driver-side gang rollup behind ``GET /statusz``.
+
+    Holds the rolling state one scrape surface needs: the
+    :class:`HealthEngine` and the previous byte totals for rate
+    computation. ``build()`` is pure over (store view, world, clock) —
+    tests drive it with fake stores and synthetic clocks."""
+
+    def __init__(self, health: Optional[HealthEngine] = None):
+        self.health = health or HealthEngine()
+        self._prev_totals = None  # (now, {metric: value})
+
+    # store duck-type: keys(scope), get(scope, key), age(scope, key)
+    def _rank_records(self, store, now):
+        """{rank: (compact_record, age_sec, source)} from host frames
+        (leader mode) and direct debugz keys (star mode); when a rank
+        appears in both, the fresher copy wins."""
+        records: Dict[int, tuple] = {}
+        interval = None
+        negotiations = []
+        hosts = {}
+        for key in store.keys(TELEMETRY_SCOPE):
+            if not key.startswith("host/"):
+                continue
+            raw = store.get(TELEMETRY_SCOPE, key)
+            try:
+                frame = json.loads(raw)
+            except (ValueError, TypeError):
+                continue
+            age = _store_age(store, TELEMETRY_SCOPE, key, now)
+            interval = frame.get("interval_sec") or interval
+            hosts[frame.get("host", key[5:])] = {
+                "leader_rank": frame.get("leader_rank"),
+                "age_sec": round(age, 1) if age is not None else None,
+                "ranks": sorted(int(r) for r in frame.get("ranks", {})),
+                "metrics": frame.get("metrics"),
+            }
+            negotiations.extend((n, age or 0.0)
+                                for n in frame.get("negotiations") or ())
+            for r_str, rec in (frame.get("ranks") or {}).items():
+                r = int(r_str)
+                r_age = (age or 0.0) + float(
+                    (frame.get("member_age_sec") or {}).get(r_str, 0.0))
+                prev = records.get(r)
+                if prev is None or r_age < prev[1]:
+                    records[r] = (rec, r_age, "leader")
+        for key in store.keys("debugz"):
+            raw = store.get("debugz", key)
+            try:
+                snap = json.loads(raw)
+                r = int(key)
+            except (ValueError, TypeError):
+                continue
+            if not isinstance(snap, dict):
+                continue
+            age = _store_age(store, "debugz", key, now) or 0.0
+            rec = snap.get("telemetry") or compact_rank(snap)
+            prev = records.get(r)
+            if prev is None or age < prev[1]:
+                records[r] = (rec, age, "direct")
+            negotiations.extend((n, age)
+                                for n in rec.get("negotiations") or ())
+        return records, hosts, negotiations, interval
+
+    def build(self, store, world: dict, round_: int,
+              now: Optional[float] = None,
+              server_stats: Optional[dict] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        records, hosts, negotiations, ival = self._rank_records(store, now)
+        ival = float(ival or interval_sec())
+        stale_after = self.health.stale_intervals * ival
+
+        ranks = {}
+        rank_ages = {}
+        mode_sources = set()
+        codecs_intra, codecs_inter = set(), set()
+        totals = {"ctrl_bytes": 0.0, "wire_bytes": 0.0,
+                  "ef_residual_bytes": 0.0}
+        reconnect_total = 0.0
+        for r, (rec, age, source) in sorted(records.items()):
+            mode_sources.add(source)
+            rank_ages[r] = age
+            b = rec.get("bytes") or {}
+            totals["ctrl_bytes"] += b.get("ctrl_tx", 0) + b.get("ctrl_rx", 0)
+            totals["wire_bytes"] += b.get("wire_tx", 0)
+            totals["ef_residual_bytes"] += b.get("ef_residual", 0)
+            rc = rec.get("reconnects") or {}
+            reconnect_total += rc.get("ctrl", 0) + rc.get("data", 0)
+            wire = rec.get("codecs") or {}
+            if wire.get("intra"):
+                codecs_intra.add(wire["intra"])
+            if wire.get("inter"):
+                codecs_inter.add(wire["inter"])
+            ranks[str(r)] = dict(rec, age_sec=round(age, 1),
+                                 stale=age > stale_after, source=source)
+
+        # serving scope: per-rank ReplicaGang snapshots (direct pushes)
+        serving = {"ranks": 0, "inflight_max": 0, "shed_total": 0}
+        for key in store.keys("serving"):
+            raw = store.get("serving", key)
+            try:
+                body = json.loads(raw)
+                serving["ranks"] += 1
+                serving["inflight_max"] = max(serving["inflight_max"],
+                                              int(body.get("inflight", 0)))
+                serving["shed_total"] += int(body.get("shed", 0))
+            except (ValueError, TypeError, AttributeError):
+                continue
+
+        expected = int(world.get("size") or 0)
+        covered = sorted(records)
+        missing = [r for r in range(expected) if r not in records]
+
+        # straggler evidence for the health engine: negotiations past
+        # the wait floor name their missing ranks. STALE sources are
+        # excluded — a dead pusher's frozen arrival table would
+        # otherwise re-feed the same transient negotiation every
+        # window and fire a false straggler alert against ranks that
+        # are perfectly healthy.
+        stragglers: Dict[int, List[str]] = {}
+        for n, n_age in negotiations:
+            if n_age > stale_after:
+                continue
+            if float(n.get("waiting_sec", 0)) < STRAGGLER_MIN_WAIT_SEC:
+                continue
+            for r in n.get("missing_ranks", ()):
+                stragglers.setdefault(int(r), []).append(
+                    n.get("tensor", "?"))
+
+        alerts = self.health.observe({
+            "interval_sec": ival,
+            "stragglers": stragglers,
+            "reconnect_total": reconnect_total,
+            "rank_ages": rank_ages,
+            "backlog": serving["inflight_max"],
+            "ranks_expected": expected,
+            "ranks_covered": len(covered),
+        }, now=now)
+
+        rates = {"window_sec": None, "ctrl_bytes_per_sec": None,
+                 "wire_bytes_per_sec": None}
+        if self._prev_totals is not None:
+            prev_now, prev = self._prev_totals
+            dt = now - prev_now
+            if dt > 0.05:
+                rates["window_sec"] = round(dt, 2)
+                rates["ctrl_bytes_per_sec"] = round(
+                    max(0.0, totals["ctrl_bytes"] - prev["ctrl_bytes"])
+                    / dt, 1)
+                rates["wire_bytes_per_sec"] = round(
+                    max(0.0, totals["wire_bytes"] - prev["wire_bytes"])
+                    / dt, 1)
+        self._prev_totals = (now, dict(totals))
+
+        mode = ("mixed" if len(mode_sources) > 1 else
+                "leader" if "leader" in mode_sources else "direct")
+        out = {
+            "schema": STATUSZ_SCHEMA,
+            "world": dict(world or {}),
+            "round": round_,
+            "mode": mode,
+            "interval_sec": round(ival, 3),
+            "ranks_expected": expected,
+            "ranks_covered": len(covered),
+            "missing_ranks": missing,
+            "hosts": hosts,
+            "ranks": ranks,
+            "stragglers": self.health.straggler_ranking(),
+            "rates": dict(rates,
+                          ef_residual_bytes=totals["ef_residual_bytes"]),
+            "totals": {k: int(v) for k, v in totals.items()},
+            "reconnect_total": int(reconnect_total),
+            "codecs": {"intra": sorted(codecs_intra),
+                       "inter": sorted(codecs_inter)},
+            "serving": serving,
+            "alerts": alerts,
+            "health_windows": self.health.windows,
+        }
+        if server_stats:
+            # scrape-cost self-accounting (put bytes per scope) — the
+            # telemetry-scaling benchmark reads its primary metric here
+            out["ingest"] = server_stats
+        return out
+
+
+def _store_age(store, scope, key, now):
+    age_fn = getattr(store, "age", None)
+    if age_fn is None:
+        return None
+    try:
+        return age_fn(scope, key, now)
+    except TypeError:
+        return age_fn(scope, key)

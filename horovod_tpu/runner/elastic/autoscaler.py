@@ -9,7 +9,14 @@ Signals, all read from the rendezvous KV the driver already hosts:
 - ``debugz`` scope (``/kv/debugz/<rank>``, pushed every 5 s by
   ``common/basics.py``): the engine's client queue depth;
 - ``failure`` scope (``/kv/failure/<host>/<slot>``, PUT by the elastic
-  ``@run`` wrapper when a collective dies): failed-rank attributions.
+  ``@run`` wrapper when a collective dies): failed-rank attributions;
+- ``telemetry`` scope (``/kv/telemetry/host/<host>``, one merged frame
+  per host leader under ``HVT_CTRL_TOPOLOGY=tree``): the same per-rank
+  queue depths, arriving O(hosts) instead of O(ranks);
+- the ``/statusz`` health engine's ``alerts`` list
+  (``metrics/telemetry.py``): a ``serving_backlog`` alert counts as a
+  sustained backlog, so the scale-out decision and the operator's
+  dashboard fire from one definition of "sustained".
 
 Decisions:
 
@@ -168,6 +175,25 @@ class Autoscaler:
                     # AttributeError: valid JSON that is not an object
                     # (a buggy/old pusher) — skip it, never abort step()
                     continue
+        # leader-aggregated gangs (HVT_CTRL_TOPOLOGY=tree): per-rank
+        # queue depths arrive inside ONE host frame per host instead of
+        # per-rank debugz keys — the autoscaler reads both shapes so a
+        # topology switch never blinds the backlog signal
+        for key in store.keys("telemetry"):
+            if not key.startswith("host/"):
+                continue
+            try:
+                raw = store.get("telemetry", key)
+                if not self._fresh("telemetry", key, raw, mono_now):
+                    continue
+                for r_str, rec in (json.loads(raw).get("ranks")
+                                   or {}).items():
+                    if world is not None and int(r_str) >= world:
+                        continue
+                    worst = max(worst,
+                                float(rec.get("queue_depth", 0)))
+            except (ValueError, TypeError, AttributeError):
+                continue
         return worst
 
     def read_failed_ranks(self) -> dict:
@@ -212,6 +238,22 @@ class Autoscaler:
             handler(key, raw)
         except Exception as e:
             self._log_error(f"failure-report handoff failed: {e!r}")
+
+    def read_health_alerts(self) -> list:
+        """Active health alerts from the rendezvous server's /statusz
+        health engine (``metrics/telemetry.py``), or [] when the
+        rendezvous has no statusz surface (tests with bare fakes).
+        Building the snapshot also advances the health windows — the
+        engine self-gates ingestion to the push interval, so the 2 s
+        policy loop cannot fast-forward persistence rules."""
+        snap_fn = getattr(self._rendezvous, "statusz_snapshot", None)
+        if snap_fn is None:
+            return []
+        try:
+            return list((snap_fn() or {}).get("alerts") or [])
+        except Exception as e:
+            self._log_error(f"statusz read failed: {e!r}")
+            return []
 
     def spare_slots(self) -> int:
         hm = getattr(self._driver, "host_manager", None)
@@ -276,8 +318,19 @@ class Autoscaler:
         if self._backlog_since is None:
             self._backlog_since = now
         sustained = now - self._backlog_since
+        # a serving_backlog health alert already encodes persistence
+        # (strict growth over HVT_HEALTH_BACKLOG_WINDOWS push windows),
+        # so it satisfies the sustain requirement directly — the
+        # statusz health engine and this loop agree on "sustained"
+        # instead of each waiting out the other. Checked ONLY when the
+        # time-based test alone would block: building the statusz
+        # snapshot parses every pushed blob, which is not a
+        # per-2s-tick cost to pay when the answer cannot change the
+        # decision.
         if sustained < self.policy.sustain_sec:
-            return
+            if not any(a.get("rule") == "serving_backlog"
+                       for a in self.read_health_alerts()):
+                return
         if now - self._last_action_t < self.policy.cooldown_sec:
             return
         if spare <= 0:

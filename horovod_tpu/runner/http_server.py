@@ -13,18 +13,45 @@ coordination surface.
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# KV scopes that survive round resets AND are worker-telemetry streams:
+# these are the only scopes the TTL sweep prunes — a blacklisted/shed
+# rank's final snapshot must eventually leave the rollup (reported as
+# "stale" first, dropped after HVT_KV_TTL_SEC), while `workers`
+# (notification registrations) and `timeline` (shards merged at job
+# end) are never aged out.
+SWEEP_SCOPES = ("serving", "debugz", "telemetry")
+
+# scopes kept across elastic round resets (init / DELETE /rendezvous)
+KEEP_SCOPES = ("workers", "timeline", "debugz", "serving", "telemetry")
 
 
 class _Store:
     def __init__(self):
         self.lock = threading.Lock()
         self.scopes = {}
+        # last-write monotonic timestamps per (scope, key): the /statusz
+        # liveness source — SERVER-side stamps, so worker clock skew
+        # can never fake freshness
+        self.meta = {}
+        # cumulative ingest accounting per scope (bytes, puts): the
+        # telemetry-scaling benchmark's primary metric, and the
+        # /statusz "ingest" self-accounting block
+        self.put_bytes = {}
+        self.put_count = {}
 
-    def put(self, scope, key, value: bytes):
+    def put(self, scope, key, value: bytes, now=None):
+        now = time.monotonic() if now is None else now
         with self.lock:
             self.scopes.setdefault(scope, {})[key] = value
+            self.meta.setdefault(scope, {})[key] = now
+            self.put_bytes[scope] = (self.put_bytes.get(scope, 0)
+                                     + len(value))
+            self.put_count[scope] = self.put_count.get(scope, 0) + 1
 
     def get(self, scope, key):
         with self.lock:
@@ -34,11 +61,53 @@ class _Store:
         with self.lock:
             return list(self.scopes.get(scope, {}).keys())
 
+    def age(self, scope, key, now=None):
+        """Seconds since the key was last written (None = never)."""
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            t = self.meta.get(scope, {}).get(key)
+        return None if t is None else max(0.0, now - t)
+
+    def ages(self, scope, now=None):
+        now = time.monotonic() if now is None else now
+        with self.lock:
+            return {k: max(0.0, now - t)
+                    for k, t in self.meta.get(scope, {}).items()}
+
+    def ingest_stats(self):
+        with self.lock:
+            return {"put_bytes": dict(self.put_bytes),
+                    "put_count": dict(self.put_count)}
+
+    def sweep(self, ttl_sec, scopes=SWEEP_SCOPES, now=None):
+        """Drop entries not rewritten for ``ttl_sec`` from the
+        telemetry-stream scopes; returns the removed (scope, key)
+        pairs. The staleness-hygiene half of /statusz: without it the
+        kept-across-rounds scopes replay a dead rank's final snapshot
+        forever (the footgun the autoscaler's change-detection had to
+        work around)."""
+        if not ttl_sec or ttl_sec <= 0:
+            return []
+        now = time.monotonic() if now is None else now
+        removed = []
+        with self.lock:
+            for scope in scopes:
+                meta = self.meta.get(scope)
+                if not meta:
+                    continue
+                for key, t in list(meta.items()):
+                    if now - t > ttl_sec:
+                        meta.pop(key, None)
+                        self.scopes.get(scope, {}).pop(key, None)
+                        removed.append((scope, key))
+        return removed
+
     def clear(self, keep_scopes=()):
         with self.lock:
-            kept = {s: v for s, v in self.scopes.items()
-                    if s in keep_scopes}
-            self.scopes = kept
+            self.scopes = {s: v for s, v in self.scopes.items()
+                           if s in keep_scopes}
+            self.meta = {s: v for s, v in self.meta.items()
+                         if s in keep_scopes}
 
 
 class RendezvousServer:
@@ -58,7 +127,18 @@ class RendezvousServer:
                                           world info + every worker's
                                           last hvt.diagnostics() report
                                           (pushed to /kv/debugz/<rank>)
+      GET     /statusz                  — gang health rollup: per-rank
+                                          liveness/lanes/links, host
+                                          frames, straggler ranking,
+                                          byte rates, health alerts
+                                          (metrics/telemetry.py; the
+                                          hvt_top data source)
       DELETE  /rendezvous               — finalize round (elastic)
+
+    Worker-telemetry scopes (``serving``/``debugz``/``telemetry``) are
+    server-timestamped on every PUT and TTL-swept after
+    ``HVT_KV_TTL_SEC`` (default 120 s, 0 = off): a dead rank's final
+    snapshot reads as "stale" in /statusz, then leaves the store.
     """
 
     def __init__(self, verbose=False, on_put=None):
@@ -69,6 +149,8 @@ class RendezvousServer:
         self._verbose = verbose
         self._round = 0
         self._on_put = on_put
+        self._statusz = None  # lazy StatuszBuilder (metrics/telemetry)
+        self._statusz_lock = threading.Lock()
         # optional fn(slots, round) -> int: the engine control-star port
         # for this round, published in world info so every worker (fresh
         # spawn or survivor re-syncing) agrees on it
@@ -88,11 +170,11 @@ class RendezvousServer:
         round's."""
         # timeline/debugz survive re-rendezvous: shards from workers
         # torn down in round N must still be mergeable at job end
-        # serving joins debugz as a kept scope: worker-pushed stats
-        # streams must survive round resets or the autoscaler would go
-        # blind at exactly the rendezvous it caused
-        self._store.clear(keep_scopes=("workers", "timeline", "debugz",
-                                       "serving"))
+        # serving/telemetry join debugz as kept scopes: worker-pushed
+        # stats streams must survive round resets or the autoscaler and
+        # /statusz would go blind at exactly the rendezvous they caused
+        # (the TTL sweep, not the round reset, is what ages them out)
+        self._store.clear(keep_scopes=KEEP_SCOPES)
         self._round += 1
         self._slots = {
             f"{s.hostname}/{s.local_rank}": {
@@ -113,6 +195,29 @@ class RendezvousServer:
     @property
     def round(self):
         return self._round
+
+    def kv_ttl_sec(self) -> float:
+        """TTL for the worker-telemetry scopes (HVT_KV_TTL_SEC; 0
+        disables the sweep)."""
+        try:
+            return float(os.environ.get("HVT_KV_TTL_SEC", "") or 120.0)
+        except ValueError:
+            return 120.0
+
+    def statusz_snapshot(self, now=None) -> dict:
+        """The gang health rollup served at ``GET /statusz`` — also the
+        autoscaler's alert feed. Sweeps expired telemetry entries
+        first, so a dead rank reads as stale/absent rather than
+        replaying its final snapshot."""
+        from horovod_tpu.metrics import telemetry as _telemetry
+
+        self._store.sweep(self.kv_ttl_sec(), now=now)
+        with self._statusz_lock:
+            if self._statusz is None:
+                self._statusz = _telemetry.StatuszBuilder()
+            return self._statusz.build(
+                self._store, self._world, self._round, now=now,
+                server_stats=self._store.ingest_stats())
 
     @property
     def world(self):
@@ -194,6 +299,7 @@ class RendezvousServer:
                     # stall-diagnostics endpoint: aggregate the per-rank
                     # hvt.diagnostics() snapshots workers push to
                     # /kv/debugz/<rank> (see common/basics.py _DebugzPusher)
+                    server_ref._store.sweep(server_ref.kv_ttl_sec())
                     ranks = {}
                     for key in store.keys("debugz"):
                         v = store.get("debugz", key)
@@ -205,7 +311,26 @@ class RendezvousServer:
                             "round": server_ref._round,
                             "timeline_shards":
                                 sorted(store.keys("timeline")),
+                            # leader-aggregated gangs push host frames
+                            # instead of per-rank debugz; point the
+                            # reader at them (full rollup: /statusz)
+                            "telemetry_hosts": sorted(
+                                k[5:] for k in store.keys("telemetry")
+                                if k.startswith("host/")),
                             "ranks": ranks}
+                    self._send(200, json.dumps(body).encode(),
+                               "application/json")
+                elif parts == ["statusz"]:
+                    # gang health rollup (metrics/telemetry.py): the
+                    # one-view answer to "is the gang healthy, and if
+                    # not, which rank/link/lane?" — hvt_top's feed
+                    try:
+                        body = server_ref.statusz_snapshot()
+                    except Exception as e:
+                        self._send(500, json.dumps(
+                            {"error": repr(e)}).encode(),
+                            "application/json")
+                        return
                     self._send(200, json.dumps(body).encode(),
                                "application/json")
                 elif parts in (["metrics"], ["metrics.json"]):
@@ -230,8 +355,7 @@ class RendezvousServer:
 
             def do_DELETE(self):
                 if self.path.strip("/") == "rendezvous":
-                    store.clear(keep_scopes=("workers", "timeline",
-                                             "debugz", "serving"))
+                    store.clear(keep_scopes=KEEP_SCOPES)
                     self._send(200)
                 else:
                     self._send(404)

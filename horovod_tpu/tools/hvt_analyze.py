@@ -37,7 +37,9 @@ with ratio-based tolerance bands and exits 1 on regression — the
 ``ci.sh --perfgate`` verdict. Only ``p50`` keys gate (p99 on a shared
 CI box is noise); baselines below ``--min-base-us`` are skipped for the
 same reason. ``HVT_PERFGATE_MAX_RATIO`` overrides the default 2.0x
-band.
+band. Traces with reconnects also emit ``recovery_stall_us_p50`` into
+the gated set, so a chaos/soak baseline fails the diff (MISSING gated
+key) if a change silently stops recording RECONNECT/REPLAY events.
 
 Import-light by design (stdlib + ``utils/timeline.py``): usable on a
 login node with no jax/numpy, and fully covered by the ``hvt_lint`` env
@@ -273,6 +275,7 @@ def analyze(events):
     recovery = {"reconnects": 0, "frames_replayed": 0,
                 "replay_bytes": 0, "stall_us_total": 0.0,
                 "by_plane": {}}
+    reconnect_durs = []  # per-reconnect RECONNECTING time, µs
     ranks = set()
 
     for (pid, tid), evs in sorted(by_lane.items()):
@@ -293,6 +296,7 @@ def analyze(events):
                         recovery["reconnects"] += 1
                         dur = float(args.get("duration_us", 0))
                         recovery["stall_us_total"] += dur
+                        reconnect_durs.append(dur)
                         bp["reconnects"] += 1
                         bp["stall_us"] += dur
                     else:
@@ -421,11 +425,20 @@ def analyze(events):
         # the wall time spent in RECONNECTING across the gang
         "recovery": recovery,
     }
+    if reconnect_durs:
+        recovery["stall_us"] = _stats(reconnect_durs)
     metrics = {}
     for p, st in report["phases"].items():
         metrics[f"{p}_us_p50"] = st["p50"]
     for lane, st in report["lanes"].items():
         metrics[f"lane{lane}_exec_us_p50"] = st["p50"]
+    # recovery p50s gate too (PR 10 → PR 13): a chaos/soak baseline
+    # carrying these keys fails --diff if a later change silently stops
+    # recording RECONNECT/REPLAY events — the MISSING-gated-key rule
+    # catches the vanished section instead of the key intersection
+    # quietly shrinking past it
+    if reconnect_durs:
+        metrics["recovery_stall_us_p50"] = recovery["stall_us"]["p50"]
     report["metrics"] = metrics
     return report
 
@@ -473,10 +486,12 @@ def print_report(rep, out=None):
         w(f"\ncompute/comm overlap efficiency: {pairs}\n")
     rec = rep.get("recovery") or {}
     if rec.get("reconnects"):
+        st = rec.get("stall_us") or {}
+        per = (f" (p50 {st['p50']} µs/reconnect)" if st else "")
         w(f"\nrecovery: {rec['reconnects']} link reconnects, "
           f"{rec['frames_replayed']} frames / {rec['replay_bytes']} B "
           f"replayed, {rec['stall_us_total'] / 1e3:.1f} ms in "
-          f"RECONNECTING\n")
+          f"RECONNECTING{per}\n")
         for plane, d in sorted(rec.get("by_plane", {}).items()):
             w(f"  {plane}: {d['reconnects']} reconnects, "
               f"{d['replay_bytes']} B replayed, "

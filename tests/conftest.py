@@ -85,6 +85,7 @@ _SLOW_MODULES = {
     "test_models",               # GPT/ResNet init + flash paths
     "test_sanitizers",           # TSAN/ASAN rebuilds
     "test_self_healing",         # reconnect/replay chaos gangs
+    "test_telemetry",            # fault-injected telemetry gangs
     "test_bench",                # full harness runs
     "test_integrations",         # real gang + HTTP-store suites
 }

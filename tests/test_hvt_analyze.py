@@ -353,3 +353,47 @@ def test_cli_usage_errors(tmp_path):
     trace.write_text("[]")
     with pytest.raises(SystemExit):
         A.main(["--diff", "a", "b", str(trace)])  # diff + traces
+
+
+# ------------------------------------------- recovery p50 gating (PR 13)
+
+def _reconnect_trace(durs_us):
+    evs = [_meta(0, 9, "CYCLE")]
+    for i, d in enumerate(durs_us):
+        evs.append({"ph": "i", "pid": 0, "tid": 9, "ts": 100 + i * 1000,
+                    "name": "RECONNECT(rank 1, data)", "s": "g",
+                    "args": {"plane": "data", "peer": "rank 1",
+                             "retries": 0, "duration_us": d}})
+    return evs
+
+
+def test_recovery_stall_p50_in_gated_metrics():
+    """Traces with reconnects emit recovery_stall_us_p50 into the
+    gated metrics block (PR 10 recovery section joins the perf-gate
+    set); clean traces emit no recovery keys, so the standard perfgate
+    baseline is unaffected."""
+    rep = A.analyze(_reconnect_trace([4000, 1000, 9000]))
+    assert rep["recovery"]["stall_us"]["p50"] == 4000
+    assert rep["metrics"]["recovery_stall_us_p50"] == 4000
+    clean = A.analyze(_synthetic_trace())
+    assert "recovery_stall_us_p50" not in clean["metrics"]
+    assert "stall_us" not in clean["recovery"]
+
+
+def test_diff_fails_when_recovery_section_vanishes(tmp_path, capsys):
+    """The satellite pin: a chaos/soak baseline carrying the recovery
+    p50 must FAIL --diff against a report that silently stopped
+    recording RECONNECT events — not pass by key-intersection
+    shrink."""
+    base = tmp_path / "base.json"
+    cur = tmp_path / "cur.json"
+    base.write_text(json.dumps(
+        {"metrics": {"recovery_stall_us_p50": 4000.0}}))
+    cur.write_text(json.dumps({"metrics": {}}))
+    assert A.run_diff(str(base), str(cur), 2.0, 200.0) == 1
+    assert "MISSING    recovery_stall_us_p50" in capsys.readouterr().out
+    # same recovery shape in both → clean
+    cur.write_text(json.dumps(
+        {"metrics": {"recovery_stall_us_p50": 5000.0}}))
+    assert A.run_diff(str(base), str(cur), 2.0, 200.0) == 0
+    capsys.readouterr()

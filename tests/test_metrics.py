@@ -372,3 +372,151 @@ def test_observe_overhead_bound():
         c.labels(op="allreduce", process_set="global").inc(1024)
     per_call = (time.perf_counter() - t0) / n
     assert per_call < 20e-6, f"observe+inc cost {per_call * 1e6:.1f} µs"
+
+
+# ------------------------------------------- exposition conformance (PR 13)
+# The fleet telemetry plane ships merged host frames to external scrape
+# agents, so the text format must stay strictly Prometheus-0.0.4
+# conformant: HELP/TYPE per family, ascending `le` bounds with +Inf
+# last, cumulative bucket counts, escaped label values.
+
+def _conformance_registry():
+    reg = MetricRegistry()
+    reg.counter("conf_total", "a counter", ("op",)).labels(
+        op="allreduce").inc(2)
+    h = reg.histogram("conf_seconds", "a histogram")
+    for v in (1e-6, 1e-3, 5.0, 1e9):
+        h.observe(v)
+    reg.gauge("conf_gauge", "line1\nline2").set(1)
+    return reg
+
+
+def test_exposition_help_and_type_for_every_family():
+    reg = _conformance_registry()
+    text = exposition.prometheus_text(reg)
+    for name, mtype in (("conf_total", "counter"),
+                        ("conf_seconds", "histogram"),
+                        ("conf_gauge", "gauge")):
+        assert f"# TYPE {name} {mtype}" in text
+        assert f"# HELP {name} " in text
+        # HELP must precede TYPE which must precede the samples
+        assert text.index(f"# HELP {name}") < text.index(
+            f"# TYPE {name}") < text.index(f"\n{name}")
+    # newlines in help text are escaped, never literal
+    assert r"line1\nline2" in text and "line1\nline2" not in text
+
+
+def test_exposition_bucket_ordering_and_inf():
+    text = exposition.prometheus_text(_conformance_registry())
+    bounds, counts = [], []
+    for line in text.splitlines():
+        if line.startswith("conf_seconds_bucket"):
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            bounds.append(le)
+            counts.append(int(line.rsplit(" ", 1)[1]))
+    assert bounds[-1] == "+Inf"
+    finite = [float(b) for b in bounds[:-1]]
+    assert finite == sorted(finite), "le bounds must ascend"
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == 4  # +Inf covers every observation
+    # sum/count close the series
+    assert "conf_seconds_sum" in text and "conf_seconds_count 4" in text
+
+
+def test_exposition_label_escaping_roundtrip_chars():
+    reg = MetricRegistry()
+    reg.counter("esc_total", "h", ("k",)).labels(
+        k='q"uote\\slash\nnl').inc()
+    text = exposition.prometheus_text(reg)
+    assert 'esc_total{k="q\\"uote\\\\slash\\nnl"} 1' in text
+
+
+# ------------------------------------------------------ merge algebra (PR 13)
+
+def _frame_of(rank, ctrl=0, depth=0, lat=()):
+    """A rank's snapshot frame via the real json_snapshot path — merge
+    is pinned against the exposition format, not a hand-rolled dict."""
+    from horovod_tpu.metrics import merge as M
+
+    reg = MetricRegistry()
+    reg.counter("m_ctrl_total", "c").inc(ctrl)
+    reg.gauge("m_depth", "g", ("lane",)).labels(lane="0").set(depth)
+    h = reg.histogram("m_lat_seconds", "h", buckets=(0.001, 1.0))
+    for v in lat:
+        h.observe(v)
+    return M.frame(rank, exposition.json_snapshot(reg))
+
+
+def test_merge_semantics_per_type():
+    from horovod_tpu.metrics import merge as M
+
+    a = _frame_of(0, ctrl=100, depth=3, lat=(0.0005,))
+    b = _frame_of(1, ctrl=40, depth=7, lat=(0.5, 2.0))
+    m = M.merge(a, b)
+    assert m["ranks"] == [0, 1]
+    assert M.counter_total(m, "m_ctrl_total") == 140  # counters sum
+    depth = m["metrics"]["m_depth"]["samples"][0]
+    assert depth["labels"] == {"lane": "0"} and depth["value"] == 7
+    hist = m["metrics"]["m_lat_seconds"]["samples"][0]
+    # bucket-wise ADD of the (cumulative) per-rank snapshots
+    assert hist["buckets"] == {"0.001": 1, "1": 2, "+Inf": 3}
+    assert hist["count"] == 3
+
+
+def test_merge_histogram_associativity():
+    # binary-exact observation values: float addition is associative
+    # only up to rounding, and the pin is about the ALGEBRA (bucket
+    # unions, sample keying), not about fp arithmetic
+    from horovod_tpu.metrics import merge as M
+
+    a = _frame_of(0, ctrl=1, lat=(0.0009765625, 0.5))
+    b = _frame_of(1, ctrl=2, lat=(2.0,))
+    c = _frame_of(2, ctrl=4, lat=(0.5, 0.5, 8.0))
+    assert M.merge(a, M.merge(b, c)) == M.merge(M.merge(a, b), c)
+    # and commutative
+    assert M.merge(a, b) == M.merge(b, a)
+
+
+def test_merge_histogram_layout_mismatch_raises():
+    # snapshot buckets are CUMULATIVE: unioning different bound sets
+    # would credit counts to the wrong bounds (le=1 missing an
+    # observation at 0.5 counted only under a coarser layout) — a
+    # layout mismatch must refuse, like a type mismatch
+    from horovod_tpu.metrics import merge as M
+
+    def hist_frame(rank, bounds, obs):
+        reg = MetricRegistry()
+        h = reg.histogram("hm_seconds", "h", buckets=bounds)
+        for v in obs:
+            h.observe(v)
+        return M.frame(rank, exposition.json_snapshot(reg))
+
+    a = hist_frame(0, (0.001, 1.0), (0.5,))
+    b = hist_frame(1, (1.0,), (0.5,))
+    with pytest.raises(MetricError):
+        M.merge(a, b)
+    # identical layouts still fold
+    c = hist_frame(2, (0.001, 1.0), (2.0,))
+    assert M.merge(a, c)["metrics"]["hm_seconds"]["samples"][0][
+        "buckets"] == {"0.001": 0, "1": 1, "+Inf": 2}
+
+
+def test_merge_type_conflict_raises():
+    from horovod_tpu.metrics import merge as M
+
+    a = M.frame(0, {"x": {"type": "counter", "help": "",
+                          "samples": [{"labels": {}, "value": 1}]}})
+    b = M.frame(1, {"x": {"type": "gauge", "help": "",
+                          "samples": [{"labels": {}, "value": 1}]}})
+    with pytest.raises(MetricError):
+        M.merge(a, b)
+
+
+def test_merge_does_not_mutate_inputs():
+    from horovod_tpu.metrics import merge as M
+
+    a = _frame_of(0, ctrl=5, lat=(0.5,))
+    b = _frame_of(1, ctrl=7, lat=(0.5,))
+    a_before = json.dumps(a, sort_keys=True)
+    M.merge(a, b)
+    assert json.dumps(a, sort_keys=True) == a_before

@@ -1,0 +1,725 @@
+"""Fleet telemetry plane (PR 13): merge/push/rollup units, the
+rendezvous KV staleness hygiene, the /statusz health rules, hvt_top,
+and real fault-injected MiniEngine gangs.
+
+The gang tests drive the acceptance pins: an injected straggler
+(``delay_ms``) and a ``flaky_conn`` flap each surface in ``/statusz``
+alerts within one push window; ``hvt_top --once --json`` round-trips
+the same view; and a clean gang raises NO alerts with the rules at
+their most trigger-happy thresholds (the false-positive pin). Workers
+are the featherweight ctypes MiniEngines of
+``benchmarks/telemetry_scaling.py`` (no jax/numpy per worker), so a
+4-rank gang costs seconds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "horovod_tpu", "csrc", "build",
+                   "libhvt_core.so")
+
+sys.path.insert(0, REPO)
+from benchmarks import ctrl_plane_scaling as cps  # noqa: E402
+from benchmarks import telemetry_scaling as ts  # noqa: E402
+
+from horovod_tpu.metrics import telemetry as T  # noqa: E402
+from horovod_tpu.runner.http_server import RendezvousServer  # noqa: E402,F401
+
+# module-wide: the gang tests need the engine .so; the units share the
+# mark for uniformity with test_ctrl_plane (conftest builds it anyway)
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(LIB),
+    reason="C++ engine not built (make -C horovod_tpu/csrc)")
+
+
+# ------------------------------------------------------------------- units
+
+def test_interval_env_and_jitter(monkeypatch):
+    monkeypatch.delenv("HVT_DEBUGZ_INTERVAL_MS", raising=False)
+    assert T.interval_sec() == 5.0
+    monkeypatch.setenv("HVT_DEBUGZ_INTERVAL_MS", "800")
+    assert T.interval_sec() == 0.8
+    vals = {T.jittered(4.0) for _ in range(200)}
+    assert all(3.0 <= v <= 5.0 for v in vals), "±25% jitter band"
+    assert len(vals) > 100, "jitter must actually vary"
+
+
+def test_role_matrix(monkeypatch):
+    for var in ("HVT_TELEMETRY_ROLE", "HVT_TELEMETRY_AGG",
+                "HVT_CTRL_TOPOLOGY", "HVT_LOCAL_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    # star topology (default) → direct, regardless of local id
+    monkeypatch.setenv("HVT_LOCAL_PROCESS_ID", "0")
+    assert T.telemetry_role() == "direct"
+    # tree topology → leader/member split by local process id
+    monkeypatch.setenv("HVT_CTRL_TOPOLOGY", "tree")
+    assert T.telemetry_role() == "leader"
+    monkeypatch.setenv("HVT_LOCAL_PROCESS_ID", "2")
+    assert T.telemetry_role() == "member"
+    # forced off under tree → star fallback
+    monkeypatch.setenv("HVT_TELEMETRY_AGG", "0")
+    assert T.telemetry_role() == "direct"
+    # forced on under star
+    monkeypatch.setenv("HVT_TELEMETRY_AGG", "1")
+    monkeypatch.setenv("HVT_CTRL_TOPOLOGY", "star")
+    assert T.telemetry_role() == "member"
+    # unknown or malformed local id → direct is the only safe answer
+    # (a raise here would silently kill the daemon push thread)
+    monkeypatch.delenv("HVT_LOCAL_PROCESS_ID")
+    assert T.telemetry_role() == "direct"
+    monkeypatch.setenv("HVT_LOCAL_PROCESS_ID", "not-a-number")
+    assert T.telemetry_role() == "direct"
+    # explicit override beats everything
+    monkeypatch.setenv("HVT_TELEMETRY_ROLE", "leader")
+    assert T.telemetry_role() == "leader"
+
+
+def test_normalize_stats_flat_manifest_form():
+    flat = {"cycles": 7, "ctrl_tx_bytes": 9,
+            "lane_depth[0]": 1, "lane_depth[2]": 5,
+            "wire_tx_bytes[allreduce]": 10,
+            "wire_tx_bytes[allgather]": 3,
+            "link_reconnects[ctrl]": 1, "link_reconnects[data]": 2}
+    out = T._normalize_stats(flat)
+    assert out["lane_depth"] == [1, 0, 5]
+    assert out["wire_tx_bytes"] == {"allreduce": 10, "allgather": 3}
+    assert out["link_reconnects"] == {"ctrl": 1, "data": 2}
+    assert out["cycles"] == 7
+    # decoded input passes through untouched
+    dec = {"lane_depth": [1, 2], "cycles": 3}
+    assert T._normalize_stats(dec) == dec
+
+
+def _diag(rank=0, queue=2, negotiations=None, links=None):
+    return {
+        "engine": {"running": True, "rank": rank, "size": 4,
+                   "cycles": 10, "queue_depth": queue,
+                   "wire": {"intra": "none", "inter": "int8",
+                            "auto": False},
+                   "broken": False},
+        "pending": [{"tensor": "t", "age_sec": 0.1, "lane": 0}],
+        "links": links or [],
+        "negotiations": negotiations or [],
+        "stalls": [],
+    }
+
+
+def test_build_snapshot_compact_and_counters():
+    stats = {"cycles": 10, "cache_hits": 1, "ctrl_tx_bytes": 100,
+             "ctrl_rx_bytes": 60, "wire_tx_bytes": {"allreduce": 40},
+             "lane_depth": [2, 0, 0, 0, 0, 0, 0, 0],
+             "link_reconnects": {"ctrl": 0, "data": 1},
+             "ef_residual_bytes": 8}
+    links = [{"peer": 1, "plane": "data", "state": "healthy",
+              "retries": 0, "epoch": 0, "in_state_sec": 1.0},
+             {"peer": 2, "plane": "data", "state": "reconnecting",
+              "retries": 1, "epoch": 1, "in_state_sec": 0.2}]
+    neg = [{"tensor": "x", "waiting_sec": 0.9, "missing_ranks": [3],
+            "arrived_ranks": [0, 1, 2]},
+           {"tensor": "y", "waiting_sec": 0.1, "missing_ranks": [],
+            "arrived_ranks": [0, 1, 2, 3]}]
+    snap = T.build_snapshot(0, "h0", _diag(negotiations=neg,
+                                           links=links), stats)
+    tel = snap["telemetry"]
+    assert tel["queue_depth"] == 2 and tel["pending"] == 1
+    assert tel["links"]["reconnecting"] == [2]
+    assert tel["links"]["healthy"] == 1
+    assert tel["bytes"] == {"ctrl_tx": 100, "ctrl_rx": 60,
+                            "wire_tx": 40, "ef_residual": 8}
+    # only negotiations with missing ranks are straggler evidence
+    assert [n["tensor"] for n in tel["negotiations"]] == ["x"]
+    assert "stats" not in snap  # raw stats never ride the wire
+    from horovod_tpu.metrics import merge as M
+    assert M.counter_total(snap["metrics"],
+                           "hvt_ctrl_tx_bytes_total") == 100
+
+
+def test_host_frame_merge_is_sum_identical():
+    from horovod_tpu.metrics import merge as M
+
+    members, ages = {}, {}
+    for r, ctrl in ((0, 100), (1, 250), (2, 13)):
+        members[r] = T.build_snapshot(
+            r, "h0", _diag(rank=r), {"ctrl_tx_bytes": ctrl})
+        ages[r] = 0.1 * r
+    frame = T.build_host_frame("h0", 0, members, ages, 5.0)
+    assert sorted(int(r) for r in frame["ranks"]) == [0, 1, 2]
+    assert M.counter_total(frame["metrics"],
+                           "hvt_ctrl_tx_bytes_total") == 363
+    assert frame["metrics"]["ranks"] == [0, 1, 2]
+
+
+def test_host_aggregator_http_ingest():
+    agg = T.HostAggregator()
+    port = agg.start()
+    try:
+        from horovod_tpu.runner.http_client import put_bytes
+        put_bytes(f"127.0.0.1:{port}", "/push/3",
+                  json.dumps({"rank": 3, "x": 1}).encode(), retries=0)
+        snaps, ages = agg.members()
+        assert snaps[3]["x"] == 1 and ages[3] < 5
+        # garbage body → 400, not a crash
+        import urllib.request, urllib.error
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/push/4", data=b"{nope",
+            method="PUT")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req, timeout=5)
+        # stale members drop out of the fold
+        snaps, _ = agg.members(now=time.monotonic() + 1e4,
+                               max_age_sec=60)
+        assert snaps == {}
+    finally:
+        agg.stop()
+
+
+def _mk_server(np_=2, hosts=1):
+    server, addr = ts.start_driver(np_, hosts)
+    return server, addr
+
+
+def test_pusher_direct_and_leader_member_roundtrip():
+    server, addr = _mk_server(np_=3)
+    stop = threading.Event()
+    try:
+        snap_of = lambda r: (lambda: T.build_snapshot(
+            r, "h0", _diag(rank=r), {"ctrl_tx_bytes": 10 * (r + 1)}))
+        # leader on h0
+        leader = T.TelemetryPusher(addr, 0, snap_of(0), stop,
+                                   host="h0", role="leader",
+                                   period_sec=0.2)
+        assert leader.step()
+        ep = json.loads(server.store.get("telemetry", "ep/h0"))
+        assert ep["rank"] == 0 and ep["addr"].startswith("127.0.0.1:")
+        # member discovers the endpoint from the KV and lands in the
+        # leader's next frame
+        member = T.TelemetryPusher(addr, 1, snap_of(1), stop,
+                                   host="h0", role="member",
+                                   period_sec=0.2)
+        assert member.step()
+        assert leader.step()
+        frame = json.loads(server.store.get("telemetry", "host/h0"))
+        assert sorted(frame["ranks"]) == ["0", "1"]
+        from horovod_tpu.metrics import merge as M
+        assert M.counter_total(frame["metrics"],
+                               "hvt_ctrl_tx_bytes_total") == 30
+        # direct role writes the legacy per-rank key
+        direct = T.TelemetryPusher(addr, 2, snap_of(2), stop,
+                                   host="h0", role="direct",
+                                   period_sec=0.2)
+        assert direct.step()
+        assert server.store.get("debugz", "2") is not None
+    finally:
+        stop.set()
+        leader.close()
+        server.stop()
+
+
+def test_member_falls_back_to_direct_when_leader_dies():
+    server, addr = _mk_server(np_=2)
+    stop = threading.Event()
+    try:
+        member = T.TelemetryPusher(
+            addr, 1, lambda: T.build_snapshot(1, "h0", _diag(rank=1),
+                                              {}),
+            stop, host="h0", role="member", period_sec=0.1)
+        # no leader endpoint exists at all → discovery fails, and after
+        # _FALLBACK_AFTER ticks the push degrades to the direct key
+        for _ in range(member._FALLBACK_AFTER - 1):
+            assert not member.step()
+        assert member.step()  # fallback push succeeded
+        assert server.store.get("debugz", "1") is not None
+    finally:
+        stop.set()
+        server.stop()
+
+
+# ------------------------------------------------- KV staleness (satellite)
+
+def test_store_timestamps_and_ttl_sweep():
+    from horovod_tpu.runner.http_server import _Store
+
+    st = _Store()
+    st.put("debugz", "0", b"x" * 10, now=100.0)
+    st.put("telemetry", "host/h0", b"y" * 20, now=150.0)
+    st.put("timeline", "0", b"shard", now=0.0)
+    assert st.age("debugz", "0", now=103.0) == 3.0
+    assert st.age("debugz", "missing") is None
+    assert st.ingest_stats()["put_bytes"] == {
+        "debugz": 10, "telemetry": 20, "timeline": 5}
+    # sweep prunes only expired telemetry-stream entries...
+    removed = st.sweep(60.0, now=170.0)
+    assert removed == [("debugz", "0")]
+    assert st.get("debugz", "0") is None
+    assert st.get("telemetry", "host/h0") is not None
+    # ...and NEVER timeline/workers scopes, however old
+    assert st.sweep(0.001, now=1e9,
+                    scopes=("serving", "debugz", "telemetry")) == [
+        ("telemetry", "host/h0")]
+    assert st.get("timeline", "0") == b"shard"
+    # ttl 0 disables
+    st.put("debugz", "1", b"z", now=0.0)
+    assert st.sweep(0, now=1e9) == []
+
+
+def test_clear_keeps_meta_in_sync():
+    from horovod_tpu.runner.http_server import _Store
+
+    st = _Store()
+    st.put("debugz", "0", b"x", now=1.0)
+    st.put("scratch", "k", b"y", now=1.0)
+    st.clear(keep_scopes=("debugz",))
+    assert st.age("debugz", "0", now=2.0) == 1.0
+    assert st.age("scratch", "k") is None
+
+
+def test_statusz_stale_records_feed_no_straggler_evidence(monkeypatch):
+    """A dead pusher's frozen arrival table must NOT re-feed the same
+    transient negotiation every build — stale sources are excluded
+    from straggler evidence, so a healthy rank can't accumulate a
+    false persistence alert off one frozen snapshot."""
+    monkeypatch.setenv("HVT_KV_TTL_SEC", "1000")
+    server, addr = _mk_server(np_=2)
+    try:
+        neg = [{"tensor": "x", "waiting_sec": 0.9,
+                "missing_ranks": [1], "arrived_ranks": [0]}]
+        snap = T.build_snapshot(0, "h0", _diag(rank=0,
+                                               negotiations=neg), {})
+        base = 1000.0  # synthetic clock shared by puts and builds
+        server.store.put("debugz", "0", json.dumps(snap).encode(),
+                         now=base - 100)  # long dead
+        builder = T.StatuszBuilder(T.HealthEngine(
+            straggler_windows=1, alert_counter=False))
+        for i in range(3):
+            doc = builder.build(server.store, {"size": 2}, 1,
+                                now=base + 10 * i)
+        assert doc["stragglers"] == []
+        assert not any(a["rule"] == "straggler" for a in doc["alerts"])
+        # the same blob, FRESH, is evidence (control case)
+        server.store.put("debugz", "0", json.dumps(snap).encode(),
+                         now=base + 30)
+        doc = builder.build(server.store, {"size": 2}, 1,
+                            now=base + 30)
+        assert any(a["rule"] == "straggler" for a in doc["alerts"])
+    finally:
+        server.stop()
+
+
+def test_statusz_marks_stale_before_ttl_drops(monkeypatch):
+    monkeypatch.setenv("HVT_KV_TTL_SEC", "1000")
+    server, addr = _mk_server(np_=1)
+    try:
+        snap = T.build_snapshot(0, "h0", _diag(rank=0), {})
+        server.store.put("debugz", "0", json.dumps(snap).encode(),
+                         now=time.monotonic() - 100)
+        doc = server.statusz_snapshot()
+        assert doc["ranks"]["0"]["stale"] is True
+        assert any(a["rule"] == "push_stale" for a in doc["alerts"])
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------- health engine
+
+def test_health_rules_fire_and_clear():
+    he = T.HealthEngine(straggler_windows=2, reconnect_storm=2,
+                        stale_intervals=3, backlog_windows=2,
+                        alert_counter=False)
+    base = {"interval_sec": 1.0, "reconnect_total": 0,
+            "rank_ages": {0: 0.1}, "backlog": 0}
+    assert he.observe(dict(base), now=0.0) == []
+    a = he.observe(dict(base, reconnect_total=3, backlog=1,
+                        stragglers={2: ["t"]}), now=1.0)
+    assert [x["rule"] for x in a] == ["reconnect_storm"]
+    a = he.observe(dict(base, reconnect_total=3, backlog=2,
+                        rank_ages={0: 0.1, 1: 9.9},
+                        stragglers={2: ["t"]}), now=2.0)
+    assert sorted(x["rule"] for x in a) == [
+        "push_stale", "reconnect_storm", "serving_backlog",
+        "straggler"]
+    straggler = next(x for x in a if x["rule"] == "straggler")
+    assert straggler["subject"] == "rank 2" and straggler["windows"] == 2
+    # a 10 Hz dashboard cannot fast-forward the windows
+    a2 = he.observe(dict(base, reconnect_total=99), now=2.05)
+    assert a2 == a and he.windows == 3
+    # conditions clear → alerts clear (storm drains its lookback)
+    for i in range(4):
+        a = he.observe(dict(base, reconnect_total=3), now=3.0 + i)
+    assert a == []
+    assert he.straggler_ranking()[0] == {
+        "rank": 2, "windows": 2, "consecutive": 0, "tensors": ["t"]}
+
+
+def test_health_alert_counter_increments_once_per_activation():
+    fired = []
+
+    class FakeCounter:
+        def labels(self, rule):
+            fired.append(rule)
+            return self
+
+        def inc(self):
+            pass
+
+    he = T.HealthEngine(straggler_windows=1, reconnect_storm=1,
+                        stale_intervals=3, backlog_windows=2,
+                        alert_counter=FakeCounter())
+    he.observe({"interval_sec": 1.0, "stragglers": {1: ["t"]}},
+               now=0.0)
+    he.observe({"interval_sec": 1.0, "stragglers": {1: ["t"]}},
+               now=1.0)
+    assert fired == ["straggler"], "active alert must not re-count"
+
+
+# ----------------------------------------------------------- statusz modes
+
+def _put_frame(server, host, members, now=None):
+    frame = T.build_host_frame(
+        host, min(members),
+        {r: T.build_snapshot(r, host, _diag(rank=r),
+                             {"ctrl_tx_bytes": 100})
+         for r in members},
+        {r: 0.0 for r in members}, 1.0)
+    server.store.put("telemetry", f"host/{host}",
+                     json.dumps(frame).encode(),
+                     now=now if now is not None else time.monotonic())
+
+
+def test_statusz_leader_direct_and_mixed_modes():
+    server, addr = _mk_server(np_=5, hosts=2)
+    try:
+        _put_frame(server, "h0", [0, 1])
+        doc = server.statusz_snapshot()
+        assert doc["mode"] == "leader"
+        assert doc["ranks_covered"] == 2
+        assert doc["missing_ranks"] == [2, 3, 4]
+        # a direct rank joins → mixed
+        snap = T.build_snapshot(4, "h1", _diag(rank=4), {})
+        server.store.put("debugz", "4", json.dumps(snap).encode())
+        doc = server.statusz_snapshot()
+        assert doc["mode"] == "mixed"
+        assert doc["ranks_covered"] == 3
+        assert doc["hosts"]["h0"]["ranks"] == [0, 1]
+        assert doc["totals"]["ctrl_bytes"] == 200  # leader ranks only
+    finally:
+        server.stop()
+
+
+def test_statusz_rates_from_successive_builds():
+    server, addr = _mk_server(np_=2, hosts=1)
+    try:
+        now = time.monotonic()
+        _put_frame(server, "h0", [0, 1], now=now)
+        server.statusz_snapshot(now=now)
+
+        def frame_with(ctrl):
+            return T.build_host_frame(
+                "h0", 0,
+                {r: T.build_snapshot(r, "h0", _diag(rank=r),
+                                     {"ctrl_tx_bytes": ctrl})
+                 for r in (0, 1)}, {0: 0.0, 1: 0.0}, 1.0)
+
+        server.store.put("telemetry", "host/h0",
+                         json.dumps(frame_with(600)).encode(),
+                         now=now + 10)
+        doc = server.statusz_snapshot(now=now + 10)
+        # 2 ranks × (600-100) ctrl_tx over 10 s = 100 B/s
+        assert doc["rates"]["window_sec"] == 10.0
+        assert doc["rates"]["ctrl_bytes_per_sec"] == 100.0
+    finally:
+        server.stop()
+
+
+def test_statusz_http_route_and_ingest_accounting():
+    server, addr = _mk_server(np_=1)
+    try:
+        snap = T.build_snapshot(0, "h0", _diag(rank=0), {})
+        from horovod_tpu.runner.http_client import put_bytes, get_json
+        put_bytes(addr, "/kv/debugz/0", json.dumps(snap).encode(),
+                  retries=0)
+        doc = get_json(addr, "/statusz", retries=0)
+        assert doc["schema"] == "hvt-statusz-r1"
+        assert doc["ranks_covered"] == 1 and doc["mode"] == "direct"
+        assert doc["ingest"]["put_count"]["debugz"] == 1
+        assert doc["ingest"]["put_bytes"]["debugz"] == len(
+            json.dumps(snap).encode())
+        # /debugz still serves and now names telemetry hosts
+        dz = get_json(addr, "/debugz", retries=0)
+        assert "telemetry_hosts" in dz and dz["ranks"]["0"]
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------ autoscaler feeds
+
+def test_autoscaler_reads_backlog_from_host_frames():
+    from horovod_tpu.runner.elastic.autoscaler import (Autoscaler,
+                                                       AutoscalePolicy)
+
+    server, addr = _mk_server(np_=4, hosts=1)
+    try:
+        frame = T.build_host_frame(
+            "h0", 0,
+            {r: T.build_snapshot(r, "h0", _diag(rank=r, queue=5 + r),
+                                 {}) for r in range(4)},
+            {r: 0.0 for r in range(4)}, 1.0)
+        server.store.put("telemetry", "host/h0",
+                         json.dumps(frame).encode())
+
+        class Driver:
+            def world_size(self):
+                return 4
+
+        sc = Autoscaler(Driver(), server, policy=AutoscalePolicy(
+            backlog_threshold=8, sustain_sec=10, cooldown_sec=0,
+            interval_sec=1))
+        assert sc.read_backlog() == 8.0  # max queue_depth across ranks
+        # out-of-world ranks in a stale frame are ignored
+        class SmallDriver:
+            def world_size(self):
+                return 2
+
+        sc2 = Autoscaler(SmallDriver(), server)
+        assert sc2.read_backlog() == 6.0
+    finally:
+        server.stop()
+
+
+def test_autoscaler_serving_backlog_alert_bypasses_sustain():
+    from horovod_tpu.runner.elastic.autoscaler import (Autoscaler,
+                                                       AutoscalePolicy)
+
+    notified = []
+
+    class Driver:
+        def world_size(self):
+            return 2
+
+        def _worker_notify_addrs(self):
+            return ["w0"]
+
+        def _notify_workers_host_changes(self):
+            notified.append(1)
+
+        class host_manager:
+            class current_hosts:
+                @staticmethod
+                def count_available_slots():
+                    return 4
+
+    class Rdv:
+        def __init__(self, store, alerts):
+            self.store = store
+            self._alerts = alerts
+
+        def statusz_snapshot(self):
+            return {"alerts": self._alerts}
+
+    from horovod_tpu.runner.http_server import _Store
+
+    st = _Store()
+    st.put("serving", "0", json.dumps({"inflight": 99}).encode())
+    alert = [{"rule": "serving_backlog", "severity": "warn",
+              "detail": "grew"}]
+    policy = AutoscalePolicy(backlog_threshold=8, sustain_sec=1e6,
+                             cooldown_sec=0, interval_sec=1)
+    sc = Autoscaler(Driver(), Rdv(st, alert), policy=policy)
+    sc.step(now=0.0)
+    assert notified, "alert-sustained backlog must scale out"
+    # without the alert, the absurd sustain window blocks
+    notified.clear()
+    sc2 = Autoscaler(Driver(), Rdv(st, []), policy=policy)
+    sc2.step(now=0.0)
+    assert not notified
+
+
+# ------------------------------------------------------------------ hvt_top
+
+def test_hvt_top_render_and_grid():
+    from horovod_tpu.tools import hvt_top
+
+    doc = {"schema": "hvt-statusz-r1", "world": {"size": 4},
+           "round": 1, "mode": "leader", "ranks_expected": 4,
+           "ranks_covered": 3, "missing_ranks": [3],
+           "hosts": {"h0": {"ranks": [0, 1, 2]}},
+           "ranks": {"0": {"queue_depth": 0, "pending": 0,
+                           "links": {}},
+                     "1": {"queue_depth": 3, "pending": 1,
+                           "links": {}},
+                     "2": {"stale": True, "links": {}}},
+           "stragglers": [{"rank": 1, "windows": 2}],
+           "rates": {"window_sec": 5.0, "ctrl_bytes_per_sec": 2048,
+                     "wire_bytes_per_sec": 0, "ef_residual_bytes": 0},
+           "codecs": {"intra": ["none"], "inter": ["int8"]},
+           "serving": {"ranks": 1, "inflight_max": 2, "shed_total": 0},
+           "alerts": [{"rule": "straggler", "severity": "warn",
+                       "subject": "rank 1", "detail": "rank 1 late"}]}
+    text = hvt_top.render(doc)
+    assert "3/4 ranks" in text
+    assert "!" in text and "s" in text and "_" in text  # grid states
+    assert "[warn] straggler: rank 1 late" in text
+    assert "stragglers: rank 1 (2 win)" in text
+    assert "2.0 KB/s" in text
+    assert "missing ranks: 3" in text
+
+
+def test_hvt_top_once_json_roundtrip_in_process(capsys):
+    from horovod_tpu.tools import hvt_top
+
+    server, addr = _mk_server(np_=1)
+    try:
+        snap = T.build_snapshot(0, "h0", _diag(rank=0), {})
+        server.store.put("debugz", "0", json.dumps(snap).encode())
+        assert hvt_top.main(["--addr", addr, "--once", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert ts.check_statusz_doc(doc, 1) == []
+        # human frame renders from the same endpoint
+        assert hvt_top.main(["--addr", addr, "--once"]) == 0
+        assert "hvt_top" in capsys.readouterr().out
+    finally:
+        server.stop()
+    assert hvt_top.main(["--addr", "127.0.0.1:1", "--once"]) == 2
+    capsys.readouterr()
+
+
+# --------------------------------------------------------- fault gangs
+
+# trigger-happy thresholds for BOTH the fault gangs and the clean pin:
+# the pin is only meaningful if the clean gang survives the same
+# hair-trigger settings that let the fault surface within one window
+_GANG_HEALTH_ENV = {
+    "HVT_HEALTH_STRAGGLER_WINDOWS": "1",
+    "HVT_HEALTH_RECONNECT_STORM": "1",
+    "HVT_HEALTH_STALE_INTERVALS": "8",
+    "HVT_KV_TTL_SEC": "300",
+    # the driver's statusz must use the gang's real push interval for
+    # its window/staleness math in direct mode too (leader frames
+    # carry it; direct snapshots don't)
+    "HVT_DEBUGZ_INTERVAL_MS": "700",
+}
+
+_GANG_SPEC = {"interval_sec": 0.7, "work_sec": 18.0, "tensors": 2,
+              "numel": 16, "step_sleep": 0.25, "cycle_ms": 2}
+
+
+def _poll_gang(np_, hosts, mode, fault_env, predicate, on_hit=None,
+               timeout=60, health_env=_GANG_HEALTH_ENV,
+               spec=_GANG_SPEC):
+    """Spawn a MiniEngine gang with live telemetry pushers, poll the
+    in-process /statusz until ``predicate(doc)`` or timeout, run
+    ``on_hit(server, addr, doc)`` while the gang is still alive, then
+    tear everything down. Returns (hit_doc_or_None, last_doc,
+    on_hit_result)."""
+    old = {k: os.environ.get(k) for k in health_env}
+    os.environ.update(health_env)
+    server, kv = ts.start_driver(np_, hosts)
+    procs = []
+    hit = last = extra = None
+    try:
+        procs = ts.spawn_workers(
+            np_, hosts, mode, spec, cps._next_port(), kv,
+            extra_env=dict(health_env, **(fault_env or {})))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            last = server.statusz_snapshot()
+            if predicate(last):
+                hit = last
+                if on_hit is not None:
+                    extra = on_hit(server, kv, hit)
+                break
+            time.sleep(0.35)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.stop()
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return hit, last, extra
+
+
+def test_gang_straggler_alert_and_hvt_top_roundtrip(capsys):
+    """Acceptance: an injected straggler surfaces in /statusz alerts
+    within one push window of the evidence, naming the rank — and
+    hvt_top --once --json round-trips the same view.
+
+    Rank 3 carries BOTH a delay_ms engine fault and a submit-side lag.
+    The announce-visible evidence comes from the submit lag: an engine
+    delay_ms alone sleeps between negotiation and the (gang-
+    synchronous) ring transfer, so it slows every rank in lockstep and
+    never skews rank 0's arrival table — which is itself a finding
+    about what a straggler *is* at this layer."""
+    def has_straggler(doc):
+        return any(a["rule"] == "straggler" and a["subject"] == "rank 3"
+                   for a in doc.get("alerts") or ())
+
+    def roundtrip(server, addr, doc):
+        from horovod_tpu.tools import hvt_top
+
+        capsys.readouterr()
+        assert hvt_top.main(["--addr", addr, "--once", "--json"]) == 0
+        return json.loads(capsys.readouterr().out)
+
+    hit, last, top_doc = _poll_gang(
+        4, 2, "direct",
+        {"HVT_FAULT_INJECT": "delay_ms:rank=3:200"}, has_straggler,
+        on_hit=roundtrip,
+        spec=dict(_GANG_SPEC, straggler_rank=3,
+                  straggler_sleep_sec=1.5, steps=60))
+    assert hit is not None, f"no straggler alert; last={last}"
+    assert hit["ranks_covered"] == 4
+    assert any(s["rank"] == 3 for s in hit["stragglers"])
+    # the tool saw the same live view: schema-valid, alert present
+    assert ts.check_statusz_doc(top_doc, 4) == []
+    assert has_straggler(top_doc), "tool view must carry the alert"
+
+
+def test_gang_flaky_conn_reconnect_storm_alert():
+    """Acceptance: a flaky_conn flap surfaces as a reconnect_storm
+    alert (and in the gang-wide reconnect counter) within one push
+    window of the reconnect delta — through LEADER-aggregated frames."""
+    def has_storm(doc):
+        return any(a["rule"] == "reconnect_storm"
+                   for a in doc.get("alerts") or ())
+
+    hit, last, _ = _poll_gang(
+        4, 2, "leader",
+        {"HVT_FAULT_INJECT": "flaky_conn:rank=1:count=2:after_ops=6"},
+        has_storm)
+    assert hit is not None, f"no reconnect_storm alert; last={last}"
+    assert hit["reconnect_total"] >= 1
+    assert hit["mode"] == "leader"
+
+
+def test_gang_clean_no_alerts_false_positive_pin():
+    """Acceptance: NO alerts on a clean gang across several push
+    windows — with the same hair-trigger thresholds the fault tests
+    use — and the leader-merged counters stay sum-identical to the
+    per-rank records."""
+    seen = []
+
+    def four_quiet_windows(doc):
+        if doc.get("alerts"):
+            seen.append(doc["alerts"])
+            return True  # bail out: the pin already failed
+        return (doc.get("health_windows", 0) >= 4
+                and doc.get("ranks_covered") == 4)
+
+    hit, last, _ = _poll_gang(4, 2, "leader", None, four_quiet_windows)
+    assert hit is not None, f"gang never reached 4 windows: {last}"
+    assert not seen, f"alerts on a clean gang: {seen}"
+    assert hit["alerts"] == []
+    assert hit["ranks_covered"] == 4
+    assert hit["mode"] == "leader"
+    cons = ts._consistency(hit)
+    assert cons["identical"], cons
