@@ -38,6 +38,12 @@
 #                      # gang suite): seeded randomized transient
 #                      # faults over a 4-proc gang, asserting
 #                      # bit-identical results and zero aborts
+#   ./ci.sh --elastic  # build + the checkpointless-recovery gangs
+#                      # (kill-a-rank peer rebuild + restart-from-
+#                      # checkpoint baseline over a REAL ElasticDriver)
+#                      # + a 16-rank kill-a-host smoke capture and
+#                      # schema --check of the fresh AND committed
+#                      # benchmarks/r14_elastic_recovery.json
 #   ./ci.sh --obs      # build + the fleet-telemetry smoke: an 8-rank
 #                      # direct-vs-leader-aggregated push pair over a
 #                      # live /statusz rendezvous server, incl. the
@@ -69,6 +75,7 @@ SCALE=0
 CODEC=0
 SOAK=0
 OBS=0
+ELASTIC=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
@@ -79,6 +86,7 @@ OBS=0
 [[ "${1:-}" == "--codec" ]] && CODEC=1
 [[ "${1:-}" == "--soak" ]] && SOAK=1
 [[ "${1:-}" == "--obs" ]] && OBS=1
+[[ "${1:-}" == "--elastic" ]] && ELASTIC=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -174,6 +182,25 @@ if [[ "$PERFGATE" == "1" || "$REBASELINE" == "1" ]]; then
   python -m horovod_tpu.tools.hvt_analyze --diff \
     benchmarks/perf_baseline.json "$ART"
   echo "CI OK (perfgate; report kept at $ART)"
+  exit 0
+fi
+
+if [[ "$ELASTIC" == "1" ]]; then
+  echo "=== [2/3] checkpointless-recovery gang suite ==="
+  # 4-proc fault-injected kill + respawn-rebuild, the restore
+  # baseline, and the artifact gates — real ElasticDriver + rendezvous,
+  # featherweight MiniEngine workers
+  run_pytest tests/test_elastic_recovery.py -q -m "not slow"
+  echo "=== [3/3] 16-rank kill-a-host smoke capture + artifact checks ==="
+  ART=$(mktemp /tmp/hvt_elastic_XXXX.json)
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    python benchmarks/elastic_recovery.py --smoke --out "$ART"
+  python benchmarks/elastic_recovery.py --check "$ART"
+  # the committed 128-rank artifact must stay schema-valid too
+  python benchmarks/elastic_recovery.py --check \
+    benchmarks/r14_elastic_recovery.json
+  rm -f "$ART"
+  echo "CI OK (elastic)"
   exit 0
 fi
 

@@ -15,11 +15,21 @@ using hvt::TensorTableEntry;
 
 extern "C" {
 
+// forward decl: the thread-local error buffer lives with the wait
+// surface below; init failures land there too so hvt_error_message
+// can explain a refused rendezvous (previously the status reason was
+// silently dropped and callers saw an empty message)
+static void set_last_error(const std::string& reason);
+
 int hvt_init(int rank, int size, const char* master_addr, int master_port,
              int cycle_ms) {
   auto s = Engine::Get().Init(rank, size, master_addr ? master_addr : "",
                               master_port, cycle_ms);
-  return s.ok() ? 0 : -1;
+  if (!s.ok()) {
+    set_last_error(s.reason);
+    return -1;
+  }
+  return 0;
 }
 
 void hvt_shutdown() { Engine::Get().Shutdown(); }
@@ -62,6 +72,10 @@ int hvt_poll(int handle) { return Engine::Get().Poll(handle) ? 1 : 0; }
 // via hvt_error_message into caller buffer).
 static thread_local std::string g_last_error;
 static thread_local hvt::HandleState g_last_state;
+
+static void set_last_error(const std::string& reason) {
+  g_last_error = reason;
+}
 
 int hvt_wait(int handle) {
   g_last_state = Engine::Get().Wait(handle);
@@ -388,6 +402,25 @@ int hvt_events_drain(void* buf, int max_n) {
 // Events overwritten before anyone drained them (ring capacity 8192).
 long long hvt_events_dropped() {
   return static_cast<long long>(Engine::Get().events().dropped());
+}
+
+// Record one event into the flight-recorder ring from the host
+// language. The elastic recovery path lives in Python and spans a
+// Shutdown/Init cycle, so its RECOVERY phase markers cannot be stamped
+// by any engine code path — this is the narrow door in. kind must be a
+// known EventKind wire id (unknown ids are dropped: a drained ring must
+// never carry kinds the drainer cannot name); returns 0 on record, -1
+// on a rejected kind. Safe whether or not the engine is initialized
+// (the ring, like the drain, outlives Shutdown).
+int hvt_record_event(int kind, const char* name, int op, int arg,
+                     long long arg2) {
+  if (kind < 0 || kind > static_cast<int>(hvt::EventKind::RECOVERY)) {
+    return -1;
+  }
+  Engine::Get().events().Record(
+      static_cast<hvt::EventKind>(kind), name ? name : "", op, arg,
+      static_cast<int64_t>(arg2));
+  return 0;
 }
 
 // JSON diagnostics snapshot: engine queue depth, pending tensors with

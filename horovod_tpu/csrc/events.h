@@ -71,6 +71,14 @@ enum class EventKind : int32_t {
   REPLAY = 16,          // frames/bytes re-sent after a reconnect:
                         // name/op as RECONNECT, arg = whole control
                         // frames replayed, arg2 = bytes replayed
+  RECOVERY = 17,        // elastic recovery phase marker, recorded from
+                        // Python via hvt_record_event (the engine is
+                        // down for most of a recovery, so phases are
+                        // stamped after re-init with their measured
+                        // durations): name = phase ("restore",
+                        // "rendezvous", "rebuild", ...), op = -1,
+                        // arg = outcome (0 ok, 1 fallback, 2 failed),
+                        // arg2 = phase duration (µs)
 };
 
 // POD view of one event — mirrored field-for-field by the ctypes

@@ -13,7 +13,13 @@ the wrapper restores the last committed state, re-initializes the runtime
 (new rendezvous → new mesh shape), and re-enters the train function.
 """
 
-from horovod_tpu.elastic.state import State, ObjectState, JaxState
+from horovod_tpu.elastic.state import (State, ObjectState, JaxState,
+                                       ReplicatedState,
+                                       ReplicatedJaxState,
+                                       ReplicaUnavailableError,
+                                       ShardCorruptError)
 from horovod_tpu.elastic.run import run
 
-__all__ = ["State", "ObjectState", "JaxState", "run"]
+__all__ = ["State", "ObjectState", "JaxState", "ReplicatedState",
+           "ReplicatedJaxState", "ReplicaUnavailableError",
+           "ShardCorruptError", "run"]

@@ -118,9 +118,18 @@ class PreemptionWatcher:
     # ------------------------------------------------------------ trigger
 
     def trigger(self, reason: str = "preemption"):
-        """Deliver a preemption notice: flag every watched state (next
-        ``commit()`` raises HostsUpdatedInterrupt) and tell the elastic
-        driver so all peers converge to their commit points."""
+        """Deliver a preemption notice: flag every watched state (the
+        next ``commit()`` replicates its shards — ReplicatedState
+        exchanges BEFORE the host-update check raises, so peers hold
+        the final version when the chips vanish — then raises
+        HostsUpdatedInterrupt) and tell the elastic driver so all peers
+        converge to their commit points. The driver hears it twice, on
+        purpose: ``/kv/preempt/<host>/<slot>`` broadcasts the
+        host-update to every worker, and the ``/kv/failure/<host>/
+        preempt`` notice marks this host as GRACEFULLY draining — the
+        driver drops it from the next assignment up front, so a
+        preempted host never has to look like a crash (no abort storm,
+        no failure-report attribution) before it leaves."""
         self._triggered.set()
         now = time.time()
         with self._state_lock:
@@ -149,15 +158,23 @@ class PreemptionWatcher:
         addr = os.environ.get("HVT_RENDEZVOUS_ADDR")
         if not addr:
             return
-        from horovod_tpu.runner.http_client import put_json
-
         host = os.environ.get("HVT_HOSTNAME") or socket.gethostname()
         slot = os.environ.get("HVT_LOCAL_PROCESS_ID", "0")
         try:
-            put_json(addr, f"/kv/preempt/{host}/{slot}",
-                     {"reason": reason, "timestamp": time.time()},
-                     timeout=2)
-        except OSError:
+            from horovod_tpu.metrics.telemetry import relay_put
+
+            relay_put(addr, "preempt", f"{host}/{slot}",
+                      {"reason": reason, "timestamp": time.time()},
+                      urgent=True, timeout=2)
+            # graceful-drain notice: one per HOST (the preemption takes
+            # the whole host's chips), keyed `<host>/preempt` so the
+            # driver's failure hook can tell a drain from a crash and
+            # drop the host from the next round without blaming anyone
+            relay_put(addr, "failure", f"{host}/preempt",
+                      {"reason": reason, "graceful": True,
+                       "timestamp": time.time()},
+                      urgent=True, timeout=2)
+        except Exception:
             pass
 
 

@@ -45,30 +45,109 @@ def run(func):
         round_ = _sync_slot_from_rendezvous(0)
         reset_required = False
         skip_sync = False
+        recovery = None  # _Recovery while a failure/update is in flight
         while True:
             if reset_required:
-                round_ = _reset(round_)
+                round_ = _reset(round_, recovery)
                 state.on_reset()
             try:
                 if not skip_sync:
+                    t0 = time.monotonic()
                     state.sync()
+                    if recovery is not None:
+                        recovery.phase("rebuild",
+                                       time.monotonic() - t0,
+                                       outcome=_sync_outcome(state))
+                if recovery is not None:
+                    recovery.finish(round_)
+                    recovery = None
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
                 # a collective failed (peer lost / deadline / abort):
                 # tell the driver which peer we believe died so it can
                 # blacklist the host before the next round, then roll
                 # back to the last commit and re-rendezvous
+                recovery = _Recovery("failure")
                 _report_failure(round_, e)
+                t0 = time.monotonic()
                 state.restore()
+                recovery.phase("restore", time.monotonic() - t0)
                 skip_sync = False
             except HostsUpdatedInterrupt as e:
+                if recovery is None:
+                    recovery = _Recovery("host_update")
                 skip_sync = e.skip_sync
             reset_required = True
 
     return wrapper
 
 
-def _reset(last_round: int) -> int:
+class _Recovery:
+    """One recovery episode's clock + reporting: phase durations land
+    as RECOVERY flight-recorder events (stamped once the engine is back
+    up), ``hvt_recovery_*`` metrics, and ``/kv/recovery/<host>/<slot>``
+    reports the driver's ``/statusz`` renders as recovery rows."""
+
+    def __init__(self, trigger: str):
+        self.trigger = trigger
+        self.t0 = time.monotonic()
+        self.phases = []  # (phase, seconds, outcome)
+
+    def phase(self, name: str, seconds: float, outcome: str = "ok"):
+        self.phases.append((name, seconds, outcome))
+        _report_recovery({"phase": name, "outcome": outcome,
+                          "seconds": round(seconds, 4),
+                          "trigger": self.trigger})
+
+    def finish(self, round_: int):
+        total = time.monotonic() - self.t0
+        _report_recovery({"phase": "recovered", "outcome": "ok",
+                          "seconds": round(total, 4), "round": round_,
+                          "trigger": self.trigger,
+                          "phases": {n: round(s, 4)
+                                     for n, s, _ in self.phases}})
+        try:
+            from horovod_tpu.engine import native
+
+            # the engine was down for most of the episode; stamp every
+            # phase into the ring now so one timeline/hvt_analyze drain
+            # shows the whole recovery next to the engine's own events.
+            # Outcome wire codes (events.h): only fallback(1)/failed(2)
+            # are non-ok — peer/rollback/bootstrap are SUCCESSFUL
+            # rebuild flavors and must stamp 0
+            for name, seconds, outcome in self.phases:
+                native.record_event(
+                    "RECOVERY", name,
+                    arg=1 if outcome == "fallback" else
+                    2 if outcome == "failed" else 0,
+                    arg2=int(seconds * 1e6))
+            native.record_event("RECOVERY", "recovered", arg=0,
+                                arg2=int(total * 1e6))
+        except Exception:
+            pass
+        try:
+            from horovod_tpu import metrics
+
+            metrics.counter(
+                "hvt_recovery_rounds_total",
+                "completed elastic recovery episodes by trigger",
+                ("trigger",)).labels(trigger=self.trigger).inc()
+            metrics.gauge(
+                "hvt_recovery_end_to_end_seconds",
+                "duration of the last recovery episode (failure/update "
+                "detection to training resumed)").set(total)
+        except Exception:
+            pass
+
+
+def _sync_outcome(state) -> str:
+    last = getattr(state, "last_recovery", None)
+    if isinstance(last, dict):
+        return str(last.get("outcome", "ok"))
+    return "ok"
+
+
+def _reset(last_round: int, recovery=None) -> int:
     """Re-initialize the runtime after a world change: report READY, wait
     for the new round's slot assignment, then shutdown + init gives a
     fresh rendezvous and a fresh mesh (the analog of the reference's
@@ -77,8 +156,13 @@ def _reset(last_round: int) -> int:
 
     _report_state("READY", last_round)
     basics.shutdown()
+    t0 = time.monotonic()
     new_round = _sync_slot_from_rendezvous(last_round)
+    t1 = time.monotonic()
     basics.init()
+    if recovery is not None:
+        recovery.phase("rendezvous", t1 - t0)
+        recovery.phase("reinit", time.monotonic() - t1)
     return new_round
 
 
@@ -131,40 +215,65 @@ def _failed_ranks_from_engine() -> list:
     return sorted({int(m) for m in re.findall(r"\brank (\d+)\b", info)})
 
 
+def _relay_report(scope: str, key: str, obj: dict, urgent: bool,
+                  timeout: float = 5.0):
+    """Leader-routed, direct-falling-back PUT of a worker report
+    (``metrics/telemetry.py relay_put``): routed gangs fold the
+    per-round report storm through one per-host ``/kvbulk`` request;
+    everyone else PUTs exactly as before. Always best-effort with
+    retries=0 underneath — these sit on the recovery path and the
+    driver may itself be down."""
+    addr = _elastic_addr()
+    if not addr:
+        return False
+    try:
+        from horovod_tpu.metrics.telemetry import relay_put
+
+        return relay_put(addr, scope, key, obj, urgent=urgent,
+                         timeout=timeout)
+    except Exception:
+        return False
+
+
 def _report_failure(round_: int, err: Exception):
     """PUT a failure report to the driver (``/kv/failure/<host>/<slot>``)
     so it can blacklist the failed peer's host ahead of the worker-exit
     signal. Best-effort — recovery proceeds regardless."""
-    addr = _elastic_addr()
-    if not addr:
-        return
-    from horovod_tpu.runner.http_client import put_json
-
     host, slot = _my_identity()
-    try:
-        # retries=0: this sits on the recovery path and the driver may
-        # itself be down (e.g. the lost host was the driver's) — a
-        # backoff here would stall every survivor's re-rendezvous
-        put_json(addr, f"/kv/failure/{host}/{slot}",
-                 {"round": round_, "error": str(err)[:2048],
-                  "failed_ranks": _failed_ranks_from_engine()},
-                 timeout=5, retries=0)
-    except OSError:
-        pass
+    _relay_report("failure", f"{host}/{slot}",
+                  {"round": round_, "error": str(err)[:2048],
+                   "failed_ranks": _failed_ranks_from_engine()},
+                  urgent=True)
 
 
 def _report_state(state_name: str, round_: int):
+    host, slot = _my_identity()
+    body = {"state": state_name, "round": round_}
+    if _relay_report("state", f"{host}/{slot}", body, urgent=True):
+        return
+    # the driver's round barrier counts READY reports — unlike the
+    # observability scopes this one is worth a retried direct PUT when
+    # the relay AND its direct fallback both failed (server restarting)
     addr = _elastic_addr()
     if not addr:
         return
     from horovod_tpu.runner.http_client import put_json
 
-    host, slot = _my_identity()
     try:
-        put_json(addr, f"/kv/state/{host}/{slot}",
-                 {"state": state_name, "round": round_}, timeout=5)
+        put_json(addr, f"/kv/state/{host}/{slot}", body, timeout=5)
     except OSError:
         pass
+
+
+def _report_recovery(body: dict):
+    """One recovery-phase report (``/kv/recovery/<host>/<slot>``) — the
+    /statusz recovery rows' source. Non-urgent: phase rows are
+    observability, not control flow, so they may ride the next relay
+    tick."""
+    host, slot = _my_identity()
+    _relay_report("recovery", f"{host}/{slot}",
+                  dict(body, host=host, slot=slot, ts=time.time()),
+                  urgent=False, timeout=3.0)
 
 
 def _sync_slot_from_rendezvous(last_round: int,
@@ -180,11 +289,30 @@ def _sync_slot_from_rendezvous(last_round: int,
     addr = _elastic_addr()
     if not addr:
         return last_round
+    import random
+
     from horovod_tpu.runner.http_client import get_json
 
     host, slot = _my_identity()
     deadline = time.time() + timeout
+    # jittered exponential poll backoff (0.1 s → 2 s cap): a fixed
+    # 0.25 s poll is ~8 requests/s PER RANK against the one rendezvous
+    # server, and during a recovery round at 100+ ranks that steady
+    # storm starves the very failure/READY reports the round is
+    # waiting on (every PUT times out behind the pollers — found live
+    # at 128 simulated ranks). Workers poll fast right after READY,
+    # then back off; activation lands within one current interval.
+    delay = 0.1
+    last_ready = time.time()
     while time.time() < deadline:
+        # self-healing READY: the report may have been queued on a
+        # host leader that died before flushing (relay success means
+        # queued, not landed). If no new round shows up for a while,
+        # re-report — the driver's barrier dedupes repeats, and a
+        # re-report after the leader's death takes the direct path.
+        if time.time() - last_ready > 7.5:
+            _report_state("READY", last_round)
+            last_ready = time.time()
         info = world = None
         try:
             world = get_json(addr, "/world")
@@ -202,7 +330,8 @@ def _sync_slot_from_rendezvous(last_round: int,
             else:
                 _apply_slot_env(info, world)
                 return world["round"]
-        time.sleep(0.25)
+        time.sleep(delay * (0.5 + 0.5 * random.random()))
+        delay = min(delay * 1.5, 2.0)
     raise TimeoutError(
         f"elastic worker {host}/{slot} timed out waiting for round "
         f"> {last_round} from rendezvous {addr}")

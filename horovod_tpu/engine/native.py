@@ -96,6 +96,12 @@ def _load():
                                              ctypes.c_int]
             lib.hvt_events_dropped.restype = ctypes.c_longlong
             lib.hvt_diagnostics.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        if getattr(lib, "hvt_record_event", None) is not None:
+            # host-language event recording (elastic RECOVERY phase
+            # markers); absent in a stale .so — record_event() no-ops
+            lib.hvt_record_event.argtypes = [
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_int, ctypes.c_longlong]
         if getattr(lib, "hvt_wait_timeout", None) is not None:
             # failure-containment surface (PR 4); a stale .so degrades
             # to the blocking wait + poll fallback
@@ -301,7 +307,8 @@ assert ctypes.sizeof(EngineEvent) == 96, "EngineEvent ABI drift"
 EVENT_KINDS = ("ENQUEUED", "NEGOTIATE_BEGIN", "NEGOTIATE_END",
                "RANK_READY", "FUSED", "EXEC_BEGIN", "EXEC_END", "DONE",
                "CYCLE", "STALL", "WAKEUP", "ABORT", "CTRL_BYTES",
-               "WIRE_BEGIN", "WIRE_END", "RECONNECT", "REPLAY")
+               "WIRE_BEGIN", "WIRE_END", "RECONNECT", "REPLAY",
+               "RECOVERY")
 
 # index == wire id (csrc/engine.h AbortCause) — the {cause} label of
 # hvt_engine_aborts_total and slots 70..74 of hvt_engine_stats
@@ -371,6 +378,26 @@ def events_dropped() -> int:
     if not events_supported():
         return 0
     return int(_lib.hvt_events_dropped())
+
+
+def record_event(kind_name: str, name: str, arg: int = 0,
+                 arg2: int = 0, op: int = -1) -> bool:
+    """Record one flight-recorder event from Python
+    (``hvt_record_event``). Used by the elastic recovery path to stamp
+    RECOVERY phase markers — those phases span a shutdown/init cycle no
+    engine code path sees. No-op (False) on a stale .so or an unknown
+    kind name; the ring outlives Shutdown, so recording right after
+    re-init lands in the same drained stream as the engine's own
+    events."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_record_event", None) is None:
+        return False
+    if kind_name not in EVENT_KINDS:
+        return False
+    rc = lib.hvt_record_event(
+        EVENT_KINDS.index(kind_name), name.encode()[:63], int(op),
+        int(arg), int(arg2))
+    return rc == 0
 
 
 def diagnostics() -> dict:

@@ -69,6 +69,17 @@ TELEMETRY_SCHEMA = "hvt-telemetry-host-r1"
 STATUSZ_SCHEMA = "hvt-statusz-r1"
 TELEMETRY_SCOPE = "telemetry"
 
+# KV scopes eligible for leader routing (the PR 8/PR 13 per-host-leader
+# shape applied to the remaining O(ranks) PUT streams): recovery-path
+# worker reports (failure/state/preempt/recovery), serving stats, and
+# timeline shards. Members hand envelopes to their host leader, which
+# batches them into ONE driver request (``PUT /kvbulk``) — per-round
+# driver fan-in becomes O(hosts). The driver's storage layout is
+# unchanged: a relayed PUT lands under the same (scope, key) as a
+# direct one, so every existing hook/reader sees identical data.
+RELAY_SCOPES = ("failure", "state", "preempt", "recovery", "serving",
+                "timeline")
+
 # Only negotiations that have been waiting at least this long count as
 # straggler evidence: rank 0's arrival table is a point sample, and a
 # healthy gang always has µs-scale open negotiations in flight — without
@@ -141,6 +152,111 @@ def telemetry_role() -> str:
         # direct is always correct
         return "direct"
     return "leader" if local_id == 0 else "member"
+
+
+def kv_relay_enabled() -> bool:
+    """``HVT_KV_RELAY`` gate for leader-routed KV scopes: ``0`` forces
+    every PUT direct (the pre-r14 wire shape), ``1`` forces routing,
+    ``auto`` (default) routes iff this rank's telemetry role is not
+    ``direct`` — the relay rides the same per-host leader the telemetry
+    plane already elects."""
+    raw = os.environ.get("HVT_KV_RELAY", "auto").strip().lower()
+    if raw in ("0", "off", "false"):
+        return False
+    if raw in ("1", "on", "true"):
+        return True
+    return telemetry_role() != "direct"
+
+
+_relay_ep_cache: Dict[str, str] = {}
+_relay_ep_miss: Dict[str, float] = {}  # host -> monotonic retry-after
+_RELAY_MAX_PAYLOAD = 256 << 10  # bigger blobs go direct (see relay_put)
+_RELAY_MISS_TTL = 5.0
+# the leader process's own aggregator (set by TelemetryPusher while a
+# leader role is active): its relay_put envelopes enqueue in-process —
+# no loopback HTTP hop to itself, which matters exactly when the box
+# is saturated by a gang-wide failure storm
+_local_aggregator = None
+
+
+def relay_put(addr: str, scope: str, key: str, obj=None,
+              data: Optional[bytes] = None, urgent: bool = False,
+              timeout: float = 3.0) -> bool:
+    """PUT one KV entry, leader-routed when the relay is active.
+
+    The envelope goes to this host's aggregator endpoint over loopback
+    (leaders and members alike — the leader's own reports queue through
+    the same door); the leader batches queued envelopes into one driver
+    ``/kvbulk`` request per push tick, flushing immediately when an
+    envelope is ``urgent`` (failure/preempt notices sit on the recovery
+    path and cannot wait a tick). ANY relay failure — no leader
+    endpoint published, the leader's host just died, a refused
+    connection — falls back to the direct PUT, so routing can delay a
+    report by at most one short timeout, never lose it."""
+    from horovod_tpu.runner.http_client import put_bytes
+
+    payload = data if data is not None else json.dumps(obj).encode()
+    # large blobs (multi-MB timeline shards) skip the relay: the
+    # base64+JSON envelope costs +33% and a full buffered copy on the
+    # leader AND the driver, where a raw direct PUT streams — batching
+    # only pays for the small, frequent report scopes
+    if len(payload) <= _RELAY_MAX_PAYLOAD and kv_relay_enabled() \
+            and scope in RELAY_SCOPES:
+        env = {"scope": scope, "key": key, "urgent": bool(urgent)}
+        import base64
+
+        env["value_b64"] = base64.b64encode(payload).decode()
+        if _local_aggregator is not None:
+            try:
+                _local_aggregator.relay([env])
+                return True
+            except Exception:
+                pass
+        host = host_name()
+        ep = _relay_ep_cache.get(host) or _discover_relay_ep(addr, host)
+        if ep is not None:
+            try:
+                put_bytes(ep, "/relay", json.dumps([env]).encode(),
+                          timeout=min(timeout, 2.0), retries=0)
+                return True
+            except Exception:
+                _relay_ep_cache.pop(host, None)
+    try:
+        put_bytes(addr, f"/kv/{scope}/{key}", payload,
+                  timeout=timeout, retries=0)
+        return True
+    except Exception:
+        return False
+
+
+def _discover_relay_ep(addr: str, host: str, timeout: float = 2.0,
+                       use_miss_cache: bool = True) -> Optional[str]:
+    """Resolve (and cache) the host leader's aggregator endpoint from
+    the KV — the ONE spelling of endpoint discovery, shared by
+    relay_put and the member pusher. relay_put honors a short negative
+    cache: with no leader published, every relayed report would
+    otherwise pay a discovery GET against the driver on exactly the
+    storm the relay exists to suppress. The pusher probes UNCACHED —
+    its whole job is noticing the leader appear."""
+    import time as _time
+
+    if use_miss_cache and \
+            _time.monotonic() < _relay_ep_miss.get(host, 0.0):
+        return None
+    from horovod_tpu.runner.http_client import get_json
+
+    try:
+        ep = get_json(addr, f"/kv/{TELEMETRY_SCOPE}/ep/{host}",
+                      timeout=timeout, retries=0)
+    except Exception:
+        ep = None
+    ep = ep.get("addr") if isinstance(ep, dict) else None
+    if ep:
+        _relay_ep_cache[host] = ep
+        _relay_ep_miss.pop(host, None)
+    else:
+        _relay_ep_miss[host] = _time.monotonic() + _RELAY_MISS_TTL
+    return ep
 
 
 # ---------------------------------------------------------------------------
@@ -346,11 +462,90 @@ class HostAggregator:
         self._lock = threading.Lock()
         self._members: Dict[int, tuple] = {}  # rank -> (snap, mono_sec)
         self._server = None
+        self._relay_q: List[dict] = []
+        self._flush_timer: Optional[threading.Timer] = None
+        # fn(envelopes) -> bool: the leader's driver-side /kvbulk flush
+        # (TelemetryPusher wires it); urgent envelopes flush after a
+        # short debounce so a failure report never waits a full tick
+        # but a same-instant burst still folds into one request
+        self.relay_sink: Optional[Callable[[list], bool]] = None
 
     def ingest(self, rank: int, snap: dict, now: Optional[float] = None):
         with self._lock:
             self._members[int(rank)] = (
                 snap, time.monotonic() if now is None else now)
+
+    @staticmethod
+    def urgent_flush_sec() -> float:
+        """Seconds an urgent envelope waits before the flush fires
+        (``HVT_RELAY_FLUSH_MS``, default 250): a host losing a peer
+        produces one failure/READY report per local rank, skewed by
+        each rank's detection path (RST vs abort-frame vs deadline —
+        sub-second, not sub-millisecond), and the debounce folds that
+        burst into a couple of driver requests per host (the O(hosts)
+        fan-in claim) while staying far below any recovery-path
+        timescale."""
+        return max(0.01, _as_float(
+            os.environ.get("HVT_RELAY_FLUSH_MS"), 250.0) / 1e3)
+
+    def relay(self, envelopes: list):
+        """Queue KV envelopes from host members (``PUT /relay``); an
+        urgent envelope arms a short debounce timer that drains the
+        whole queue through the sink."""
+        urgent = any(e.get("urgent") for e in envelopes)
+        with self._lock:
+            self._relay_q.extend(envelopes)
+            if not (urgent and self.relay_sink is not None):
+                return
+            if self._flush_timer is not None:
+                return  # a flush is already armed; this burst rides it
+            self._flush_timer = threading.Timer(
+                self.urgent_flush_sec(), self._urgent_flush)
+            self._flush_timer.daemon = True
+            self._flush_timer.start()
+
+    # requeued-envelope cap: bounds leader memory when the driver is
+    # down for a long stretch (oldest envelopes drop first — staler
+    # telemetry loses to fresher reports)
+    RELAY_QUEUE_CAP = 4096
+
+    def _urgent_flush(self):
+        with self._lock:
+            self._flush_timer = None
+        self.flush(self.relay_sink)
+
+    def flush(self, sink) -> bool:
+        """Drain the queue through ``sink``; a failed flush REQUEUES
+        the batch (capped) — an envelope relay_put already claimed as
+        delivered must survive a transiently-unreachable driver, or
+        the 'delayed, never lost' contract breaks for exactly the
+        READY/failure reports the recovery round waits on."""
+        with self._lock:
+            batch, self._relay_q = self._relay_q, []
+        if not batch or sink is None:
+            return True
+        if sink(batch):
+            return True
+        with self._lock:
+            self._relay_q[:0] = batch
+            overflow = len(self._relay_q) - self.RELAY_QUEUE_CAP
+            if overflow > 0:
+                # oldest NON-urgent drop first; urgent envelopes
+                # (failure/READY — the reports a recovery round blocks
+                # on) are never evicted by telemetry backlog
+                keep, dropped = [], 0
+                for env in self._relay_q:
+                    if dropped < overflow and not env.get("urgent"):
+                        dropped += 1
+                        continue
+                    keep.append(env)
+                self._relay_q = keep
+        return False
+
+    def take_relay(self) -> list:
+        with self._lock:
+            batch, self._relay_q = self._relay_q, []
+        return batch
 
     def members(self, now: Optional[float] = None,
                 max_age_sec: Optional[float] = None):
@@ -385,6 +580,22 @@ class HostAggregator:
                         self.end_headers()
                         return
                     self.send_response(200)
+                elif parts == ["relay"]:
+                    # leader-routed KV envelopes (relay_put): a JSON
+                    # list of {scope, key, value_b64, urgent}
+                    try:
+                        envs = json.loads(body)
+                        if isinstance(envs, dict):
+                            envs = [envs]
+                        assert all(isinstance(e, dict) and "scope" in e
+                                   and "key" in e for e in envs)
+                    except (ValueError, TypeError, AssertionError):
+                        self.send_response(400)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    agg.relay(envs)
+                    self.send_response(200)
                 else:
                     self.send_response(404)
                 self.send_header("Content-Length", "0")
@@ -407,10 +618,14 @@ class HostAggregator:
         return self._server.server_address[1] if self._server else None
 
     def stop(self):
-        if self._server is not None:
-            self._server.shutdown()
-            self._server.server_close()
-            self._server = None
+        with self._lock:
+            if self._flush_timer is not None:
+                self._flush_timer.cancel()
+                self._flush_timer = None
+            server, self._server = self._server, None
+        if server is not None:  # idempotent under concurrent close()
+            server.shutdown()
+            server.server_close()
 
 
 # ---------------------------------------------------------------------------
@@ -470,21 +685,56 @@ class TelemetryPusher:
             return False
 
     def _discover_leader(self) -> Optional[str]:
-        from horovod_tpu.runner.http_client import get_json
-
-        try:
-            ep = get_json(self.addr,
-                          f"/kv/{TELEMETRY_SCOPE}/ep/{self.host}",
-                          timeout=self._timeout, retries=0)
-        except Exception:
-            return None
-        return ep.get("addr") if isinstance(ep, dict) else None
+        # shares _discover_relay_ep, which also primes the relay's
+        # endpoint cache: relay_put must reach the leader WITHOUT a
+        # discovery GET at failure time — 100+ ranks discovering
+        # simultaneously against a server already fielding the report
+        # storm is what the relay exists to prevent (found live at 128
+        # simulated ranks)
+        return _discover_relay_ep(self.addr, self.host, self._timeout,
+                                  use_miss_cache=False)
 
     # -------------------------------------------------------------- roles
     def _ensure_leader(self):
+        global _local_aggregator
         if self._agg is None:
             self._agg = HostAggregator()
-            self._agg.start()
+            self._agg.relay_sink = self._flush_relay
+            port = self._agg.start()
+            # the leader's own relay_put enqueues in-process, and the
+            # endpoint cache is seeded so members never need the
+            # discovery GET mid-storm
+            _local_aggregator = self._agg
+            _relay_ep_cache[self.host] = f"127.0.0.1:{port}"
+
+    def _flush_relay(self, envelopes: list) -> bool:
+        """Batch queued member KV envelopes into ONE driver request
+        (``PUT /kvbulk``). On a bulk failure, degrade to per-entry
+        direct PUTs — a failure report may cost extra requests in that
+        corner, but is never dropped."""
+        if not envelopes:
+            return True
+        from horovod_tpu.runner.http_client import put_bytes
+
+        try:
+            put_bytes(self.addr, "/kvbulk",
+                      json.dumps(envelopes).encode(),
+                      timeout=self._timeout, retries=0)
+            return True
+        except Exception:
+            pass
+        import base64
+
+        ok = True
+        for env in envelopes:
+            try:
+                put_bytes(self.addr,
+                          f"/kv/{env['scope']}/{env['key']}",
+                          base64.b64decode(env.get("value_b64") or ""),
+                          timeout=self._timeout, retries=0)
+            except Exception:
+                ok = False
+        return ok
 
     def step(self) -> bool:
         """One push tick; returns True when the snapshot reached its
@@ -508,6 +758,10 @@ class TelemetryPusher:
                                      ages, self.period_sec)
             ok = self._put(f"/kv/{TELEMETRY_SCOPE}/host/{self.host}",
                            frame)
+            # drain the leader-routed KV envelopes members queued since
+            # the last tick (urgent ones already debounce-flushed);
+            # a failed flush requeues so no report is ever dropped
+            self._agg.flush(self._flush_relay)
         elif self.role == "member":
             ok = self._push_member(snap)
         else:
@@ -540,8 +794,16 @@ class TelemetryPusher:
 
     def close(self):
         """Tear down the leader-side aggregator endpoint (harnesses
-        that drive :meth:`step` manually call this at exit)."""
+        that drive :meth:`step` manually call this at exit). Queued
+        relay envelopes flush first — teardown must not eat a report."""
+        global _local_aggregator
         if self._agg is not None:
+            try:
+                self._agg.flush(self._flush_relay)
+            except Exception:
+                pass
+            if _local_aggregator is self._agg:
+                _local_aggregator = None
             self._agg.stop()
             self._agg = None
 
@@ -859,6 +1121,38 @@ class StatuszBuilder:
             ranks[str(r)] = dict(rec, age_sec=round(age, 1),
                                  stale=age > stale_after, source=source)
 
+        # recovery scope: worker recovery-phase reports (elastic/run.py
+        # PUTs one per phase transition) — the "where is the gang in
+        # its recovery?" rows. Kept across round resets and TTL-swept,
+        # so a finished recovery ages out instead of reading forever.
+        recovery = {"reports": 0, "by_phase": {}, "by_outcome": {},
+                    "ranks": {}, "max_seconds": 0.0}
+        for key in store.keys("recovery"):
+            raw = store.get("recovery", key)
+            try:
+                body = json.loads(raw)
+                assert isinstance(body, dict)
+            except (ValueError, TypeError, AssertionError):
+                continue
+            age = _store_age(store, "recovery", key, now)
+            phase = str(body.get("phase", "?"))
+            outcome = str(body.get("outcome", "?"))
+            recovery["reports"] += 1
+            recovery["by_phase"][phase] = \
+                recovery["by_phase"].get(phase, 0) + 1
+            recovery["by_outcome"][outcome] = \
+                recovery["by_outcome"].get(outcome, 0) + 1
+            recovery["max_seconds"] = max(
+                recovery["max_seconds"],
+                float(body.get("seconds") or 0.0))
+            if len(recovery["ranks"]) < 32:
+                recovery["ranks"][key] = {
+                    "phase": phase, "outcome": outcome,
+                    "round": body.get("round"),
+                    "seconds": body.get("seconds"),
+                    "age_sec": round(age, 1) if age is not None
+                    else None}
+
         # serving scope: per-rank ReplicaGang snapshots (direct pushes)
         serving = {"ranks": 0, "inflight_max": 0, "shed_total": 0}
         for key in store.keys("serving"):
@@ -938,6 +1232,7 @@ class StatuszBuilder:
             "codecs": {"intra": sorted(codecs_intra),
                        "inter": sorted(codecs_inter)},
             "serving": serving,
+            "recovery": recovery,
             "alerts": alerts,
             "health_windows": self.health.windows,
         }

@@ -49,6 +49,13 @@ def _elastic_metrics():
                       "distinct hosts in the current assignment"),
         metrics.gauge("hvt_elastic_blacklisted_hosts",
                       "hosts currently blacklisted by the host manager"),
+        metrics.counter("hvt_elastic_preemptions_total",
+                        "hosts drained gracefully on a preemption "
+                        "notice (/kv/failure/<host>/preempt)"),
+        metrics.counter("hvt_elastic_folded_rounds_total",
+                        "host changes folded into an in-flight "
+                        "re-rendezvous instead of costing their own "
+                        "restart round"),
     )
 
 
@@ -66,6 +73,20 @@ class ElasticDriver:
             verbose=settings.verbose)
         self._create_worker_fn = create_worker_fn
         self._lock = threading.Lock()
+        # re-rendezvous coalescing (see resume()): a host blacklisted
+        # while a round activation is already in flight folds into that
+        # activation's loop instead of buying its own restart round
+        self._resume_lock = threading.Lock()
+        self._resuming = False
+        self._resume_pending = False
+        self._last_round_view = None
+        # hosts gracefully draining on a preemption notice: host ->
+        # monotonic expiry. SOFT exclusion — a draining host leaves the
+        # next assignment only while the remaining capacity still
+        # covers min_np (the platform may give the notice and then not
+        # follow through; hard-blacklisting would kill thin jobs), and
+        # the mark expires so an un-preempted host can rejoin.
+        self._draining: Dict[str, float] = {}
         self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
         self._workers: Dict[Tuple[str, int], threading.Thread] = {}
         self._results: Dict[int, int] = {}     # rank → exit code
@@ -103,20 +124,85 @@ class ElasticDriver:
         self._discovery_thread.start()
 
     def resume(self):
-        """Start a new rendezvous round after a failure or host update."""
+        """Start a new rendezvous round after a failure or host update.
+
+        Coalescing: only one activation loop runs at a time. A second
+        ``resume()`` — or a host blacklisted via
+        :meth:`_note_host_change` — while an activation is in flight
+        sets the pending flag and returns; the in-flight loop picks the
+        change up and re-activates with the updated host view before
+        any worker has invested in the superseded assignment. Two
+        near-simultaneous failure reports therefore cost the workers
+        ONE restart, not two back-to-back rounds."""
         if self._shutdown.is_set():
             return
-        # take a fresh discovery snapshot so the new assignment reflects
-        # hosts that died/joined since the last poll
+        with self._resume_lock:
+            self._resume_pending = True
+            if self._resuming:
+                return  # folded into the in-flight activation loop
+            self._resuming = True
+        folded = -1  # first pass is the round itself, not a fold
+        released = False  # did the normal exit already clear _resuming?
         try:
-            self._host_manager.update_available_hosts()
-        except Exception:
-            pass
-        try:
-            self._activate_round(self._preferred_np())
-        except RuntimeError:
-            # stop(error=True) was already called with the reason
-            pass
+            while True:
+                with self._resume_lock:
+                    if not self._resume_pending:
+                        # clearing _resuming must be atomic with the
+                        # final pending check: a concurrent resume()
+                        # between "no pending -> return" and a
+                        # later-cleared flag would see _resuming still
+                        # True, queue its change on the exiting loop,
+                        # and lose the wakeup
+                        self._resuming = False
+                        released = True
+                        return
+                    self._resume_pending = False
+                if self._shutdown.is_set():
+                    return
+                # fresh discovery snapshot so the new assignment
+                # reflects hosts that died/joined since the last poll
+                try:
+                    self._host_manager.update_available_hosts()
+                except Exception:
+                    pass
+                # a FOLD pass re-activates only when the usable host
+                # view actually moved: redundant notifications (a host
+                # blacklisted twice, late duplicate failure reports)
+                # must not bump the round out from under workers that
+                # are already rendezvousing on the one just published
+                if folded >= 0 and self._host_view() == \
+                        self._last_round_view:
+                    continue
+                folded += 1
+                try:
+                    # _update_host_assignments records the view the
+                    # assignment actually consumed as _last_round_view
+                    self._activate_round(self._preferred_np())
+                except RuntimeError:
+                    # stop(error=True) was already called with the reason
+                    return
+        finally:
+            if not released:
+                # exception paths only: a normal exit already released
+                # ownership under the lock, and a NEW activation loop
+                # may have legitimately taken it since — clobbering
+                # the flag here would let two loops run concurrently
+                with self._resume_lock:
+                    self._resuming = False
+            if folded > 0:
+                try:
+                    _elastic_metrics()[6].inc(folded)
+                except Exception:
+                    pass
+
+    def _note_host_change(self):
+        """A host left/joined outside the barrier path (failure report,
+        preemption drain, late worker exit). If a round activation is
+        in flight, fold the change into it — the assignment it was
+        about to publish is already stale."""
+        with self._resume_lock:
+            if self._resuming:
+                self._resume_pending = True
 
     def stop(self, error: bool = False, reason: Optional[str] = None):
         if error:
@@ -222,12 +308,34 @@ class ElasticDriver:
         a lost host (the worker-exit path applies the per-host policy
         there); this also keeps single-host jobs recoverable. Reports
         that name no rank (data-plane failures carry no attribution)
-        blacklist nothing — the dead worker's exit handles that."""
+        blacklist nothing — the dead worker's exit handles that.
+
+        A ``<host>/preempt`` key is a GRACEFUL drain notice from the
+        preemption watcher, not a crash: the named host leaves the next
+        assignment up front and workers get the host-update broadcast,
+        so the whole job converges to commit points and re-forms
+        without that host ever aborting a collective."""
         try:
-            reporter_host = key.rsplit("/", 1)[0]
+            reporter_host, tail = key.rsplit("/", 1)
             body = json.loads(value)
-            ranks = [int(r) for r in body.get("failed_ranks") or []]
         except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            return
+        if tail == "preempt" or (isinstance(body, dict)
+                                 and body.get("graceful")):
+            if self._settings.verbose:
+                print(f"[elastic driver] host {reporter_host} draining "
+                      f"on a preemption notice")
+            self._mark_draining(reporter_host)
+            self._note_host_change()
+            try:
+                _elastic_metrics()[5].inc()
+            except Exception:
+                pass
+            self._notify_workers_host_changes()
+            return
+        try:
+            ranks = [int(r) for r in body.get("failed_ranks") or []]
+        except (ValueError, TypeError, AttributeError):
             return
         if not ranks:
             return
@@ -241,6 +349,32 @@ class ElasticDriver:
                     print(f"[elastic driver] failure report names rank "
                           f"{r} ({host}); blacklisting")
                 self._host_manager.blacklist(host)
+                self._note_host_change()
+
+    def _host_view(self):
+        """The inputs an assignment depends on — the fold loop's
+        change detector."""
+        hosts = self._host_manager.current_hosts
+        return (tuple(sorted(hosts.host_slots.items())),
+                tuple(sorted(self._active_draining())))
+
+    def _mark_draining(self, host: str):
+        import os
+
+        try:
+            ttl = float(os.environ.get("HVT_PREEMPT_DRAIN_SEC", "")
+                        or 300.0)
+        except ValueError:
+            ttl = 300.0
+        with self._lock:
+            self._draining[host] = time.monotonic() + ttl
+
+    def _active_draining(self) -> set:
+        now = time.monotonic()
+        with self._lock:
+            self._draining = {h: t for h, t in self._draining.items()
+                              if t > now}
+            return set(self._draining)
 
     def _rendezvous_round(self) -> int:
         return getattr(self._rendezvous, "round", -1)
@@ -258,6 +392,7 @@ class ElasticDriver:
         if slot_info is None:
             if exit_code != 0 and not self._shutdown.is_set():
                 self._host_manager.blacklist(host)
+                self._note_host_change()
             return
         if exit_code == 0:
             self._registry.record_success(host, slot)
@@ -281,7 +416,8 @@ class ElasticDriver:
             # round must not make a successfully recovered job exit 1
             self._results = {}
         try:
-            rounds, resets, world, alive, blacklisted = _elastic_metrics()
+            rounds, resets, world, alive, blacklisted = \
+                _elastic_metrics()[:5]
             rounds.inc()
             if rounds.value > 1:
                 resets.inc()
@@ -301,6 +437,21 @@ class ElasticDriver:
         hosts_snapshot = self._host_manager.current_hosts
         host_list = [HostInfo(h, hosts_snapshot.host_slots[h])
                      for h in hosts_snapshot.host_assignment_order]
+        draining = self._active_draining()
+        # the change-detector baseline for resume()'s fold loop: the
+        # exact inputs THIS assignment consumed — a blacklist landing
+        # after this line must trigger a re-activation
+        self._last_round_view = (
+            tuple(sorted(hosts_snapshot.host_slots.items())),
+            tuple(sorted(draining)))
+        if draining:
+            kept = [h for h in host_list if h.hostname not in draining]
+            # soft drain: preempted hosts leave the assignment only
+            # while the survivors still cover min_np — a thin job keeps
+            # its draining host (and simply re-rendezvouses) rather
+            # than dying on a notice the platform may not honor
+            if sum(h.slots for h in kept) >= self._settings.min_np:
+                host_list = kept
         avail = sum(h.slots for h in host_list)
         np = min(np, avail)
         if self._settings.max_np is not None:

@@ -24,10 +24,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 # "stale" first, dropped after HVT_KV_TTL_SEC), while `workers`
 # (notification registrations) and `timeline` (shards merged at job
 # end) are never aged out.
-SWEEP_SCOPES = ("serving", "debugz", "telemetry")
+SWEEP_SCOPES = ("serving", "debugz", "telemetry", "recovery")
 
-# scopes kept across elastic round resets (init / DELETE /rendezvous)
-KEEP_SCOPES = ("workers", "timeline", "debugz", "serving", "telemetry")
+# scopes kept across elastic round resets (init / DELETE /rendezvous).
+# `recovery` (worker recovery-phase reports) is written *between*
+# rounds — clearing it at init would erase exactly the reports the
+# /statusz recovery rows exist to show; the TTL sweep ages them out.
+KEEP_SCOPES = ("workers", "timeline", "debugz", "serving", "telemetry",
+               "recovery")
 
 
 class _Store:
@@ -40,9 +44,13 @@ class _Store:
         self.meta = {}
         # cumulative ingest accounting per scope (bytes, puts): the
         # telemetry-scaling benchmark's primary metric, and the
-        # /statusz "ingest" self-accounting block
+        # /statusz "ingest" self-accounting block. put_requests counts
+        # HTTP requests (a /kvbulk batch is ONE request however many
+        # entries it carries) — the elastic-recovery benchmark's
+        # O(hosts)-not-O(ranks) fan-in metric.
         self.put_bytes = {}
         self.put_count = {}
+        self.put_requests = {}
 
     def put(self, scope, key, value: bytes, now=None):
         now = time.monotonic() if now is None else now
@@ -52,6 +60,11 @@ class _Store:
             self.put_bytes[scope] = (self.put_bytes.get(scope, 0)
                                      + len(value))
             self.put_count[scope] = self.put_count.get(scope, 0) + 1
+
+    def note_request(self, scope, n=1):
+        with self.lock:
+            self.put_requests[scope] = (self.put_requests.get(scope, 0)
+                                        + n)
 
     def get(self, scope, key):
         with self.lock:
@@ -77,7 +90,8 @@ class _Store:
     def ingest_stats(self):
         with self.lock:
             return {"put_bytes": dict(self.put_bytes),
-                    "put_count": dict(self.put_count)}
+                    "put_count": dict(self.put_count),
+                    "put_requests": dict(self.put_requests)}
 
     def sweep(self, ttl_sec, scopes=SWEEP_SCOPES, now=None):
         """Drop entries not rewritten for ``ttl_sec`` from the
@@ -184,13 +198,19 @@ class RendezvousServer:
                 "cross_size": s.cross_size, "round": self._round,
             } for s in slots
         }
-        self._world = {"size": len(slots),
-                       "hosts": sorted({s.hostname for s in slots}),
-                       "master_host": slots[0].hostname if slots else None,
-                       "round": self._round}
+        world = {"size": len(slots),
+                 "hosts": sorted({s.hostname for s in slots}),
+                 "master_host": slots[0].hostname if slots else None,
+                 "round": self._round}
         if self.master_port_fn is not None and slots:
-            self._world["master_port"] = int(
+            world["master_port"] = int(
                 self.master_port_fn(slots, self._round))
+        # publish atomically, master_port included: a worker polling
+        # /world between "round visible" and "port visible" would fall
+        # back to the port-rotation guess and rendezvous into a
+        # different engine port than its peers (split-gang init
+        # failure, caught live by the recovery drive)
+        self._world = world
 
     @property
     def round(self):
@@ -241,6 +261,7 @@ class RendezvousServer:
                 n = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(n)
                 if len(parts) >= 3 and parts[0] == "kv":
+                    store.note_request(parts[1])
                     store.put(parts[1], "/".join(parts[2:]), body)
                     hook = server_ref._on_put
                     if hook is not None:
@@ -249,6 +270,46 @@ class RendezvousServer:
                         except Exception:
                             pass
                     self._send(200)
+                elif parts == ["kvbulk"]:
+                    # leader-routed batch (metrics/telemetry.py relay):
+                    # one request carrying many (scope, key, value_b64)
+                    # entries — the door that keeps driver fan-in
+                    # O(hosts) per elastic round. Entries land in the
+                    # store and fire the put hook exactly as individual
+                    # PUTs would.
+                    import base64
+
+                    try:
+                        envs = json.loads(body)
+                        assert isinstance(envs, list)
+                    except (ValueError, AssertionError,
+                            UnicodeDecodeError):
+                        self._send(400)
+                        return
+                    scopes_seen = set()
+                    accepted = 0
+                    hook = server_ref._on_put
+                    for env in envs:
+                        try:
+                            scope = str(env["scope"])
+                            key = str(env["key"])
+                            value = base64.b64decode(
+                                env.get("value_b64") or "")
+                        except (TypeError, KeyError, ValueError):
+                            continue
+                        if scope not in scopes_seen:
+                            scopes_seen.add(scope)
+                            store.note_request(scope)
+                        store.put(scope, key, value)
+                        accepted += 1
+                        if hook is not None:
+                            try:
+                                hook(scope, key, value)
+                            except Exception:
+                                pass
+                    self._send(200, json.dumps(
+                        {"accepted": accepted}).encode(),
+                        "application/json")
                 else:
                     self._send(404)
 

@@ -251,16 +251,18 @@ class ReplicaGang:
     def push_stats(self, addr: str = None, timeout: float = 2.0) -> bool:
         """Best-effort PUT of this rank's serving snapshot to the
         rendezvous KV (``/kv/serving/<rank>``) — the autoscaler's
-        backlog/latency signal. No-op outside an elastic launch."""
+        backlog/latency signal. Leader-routed when the KV relay is
+        active (``metrics/telemetry.py``): members hand the snapshot to
+        their host leader, which batches the host's serving stream into
+        one driver request per tick. No-op outside an elastic
+        launch."""
         addr = addr or os.environ.get("HVT_RENDEZVOUS_ADDR")
         if not addr:
             return False
-        from horovod_tpu.runner.http_client import put_bytes
-
         try:
-            put_bytes(addr, f"/kv/serving/{self._rank}",
-                      json.dumps(self.snapshot()).encode(),
-                      timeout=timeout, retries=0)
-            return True
-        except OSError:
+            from horovod_tpu.metrics.telemetry import relay_put
+
+            return relay_put(addr, "serving", str(self._rank),
+                             self.snapshot(), timeout=timeout)
+        except Exception:
             return False

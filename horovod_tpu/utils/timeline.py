@@ -57,7 +57,7 @@ _clock_offset_us = 0.0
 # kind wire ids — must match csrc/events.h EventKind / native.EVENT_KINDS
 _ENQUEUED, _NEG_B, _NEG_E, _RANK_READY, _FUSED, _EXEC_B, _EXEC_E, \
     _DONE, _CYCLE, _STALL, _WAKEUP, _ABORT, _CTRL_BYTES, _WIRE_B, \
-    _WIRE_E, _RECONNECT, _REPLAY = range(17)
+    _WIRE_E, _RECONNECT, _REPLAY, _RECOVERY = range(18)
 
 # control-plane role names by wire id — must match csrc/engine.h
 # CtrlRole (the CTRL_BYTES event stamps the recording rank's role into
@@ -271,6 +271,23 @@ class _TimelineState:
                             "tid": self._cycle_lane(), "name": label,
                             "ts": ts, "s": "g", "args": args})
                 continue
+            if kind == _RECOVERY:
+                # always recorded, like ABORT/RECONNECT: an elastic
+                # recovery is a rare headline event. name = the phase
+                # ("restore"/"rendezvous"/"rebuild"/...), arg = outcome
+                # (0 ok, 1 fallback-to-application-restore, 2 failed),
+                # arg2 = the phase's measured duration in µs — stamped
+                # from Python after re-init (hvt_record_event), since
+                # the engine is down for most of a recovery.
+                outcome = {0: "ok", 1: "fallback", 2: "failed"}.get(
+                    ev["arg"], "?")
+                self._emit({"ph": "i", "pid": self.pid,
+                            "tid": self._cycle_lane(),
+                            "name": f"RECOVERY({name}, {outcome})",
+                            "ts": ts, "s": "g",
+                            "args": {"phase": name, "outcome": outcome,
+                                     "duration_us": ev["arg2"]}})
+                continue
             if kind == _ABORT:
                 # always recorded (mark_cycles or not): an abort is the
                 # headline event of any trace that contains one. The
@@ -336,6 +353,25 @@ class _TimelineState:
         self.writer.join(timeout=5)
         self._upload()
 
+    def _shard_landed(self, deadline_sec: float = 8.0) -> bool:
+        """Poll a HEAD on the shard's KV key until the leader's batch
+        flush lands it (or the deadline passes)."""
+        import time as _time
+        import urllib.request
+
+        url = (f"http://{self.upload_addr}/kv/timeline/{self.pid}")
+        deadline = _time.monotonic() + deadline_sec
+        while _time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(url, method="HEAD")
+                with urllib.request.urlopen(req, timeout=3) as resp:
+                    if resp.status == 200:
+                        return True
+            except Exception:
+                pass
+            _time.sleep(0.3)
+        return False
+
     def _upload(self):
         """PUT the finished shard to the rendezvous KV store
         (``/kv/timeline/<rank>``) so the launcher can merge every rank's
@@ -344,11 +380,28 @@ class _TimelineState:
         if not self.upload_addr:
             return
         try:
-            from horovod_tpu.runner.http_client import put_bytes
+            # leader-routed when the KV relay is active: at teardown
+            # every rank uploads at once, and folding the shard storm
+            # through per-host /kvbulk batches keeps the driver's
+            # request fan-in O(hosts) (metrics/telemetry.py). Relay
+            # success only means QUEUED on the leader, and the leader
+            # may itself be tearing down — so verify the shard landed
+            # (HEAD against the driver) and fall back to the direct
+            # PUT when it did not. A shard is merged exactly once
+            # (same key), so the fallback can never duplicate it.
+            from horovod_tpu.metrics.telemetry import relay_put
 
             with open(self.path, "rb") as f:
-                put_bytes(self.upload_addr, f"/kv/timeline/{self.pid}",
-                          f.read())
+                data = f.read()
+            delivered = relay_put(self.upload_addr, "timeline",
+                                  str(self.pid), data=data,
+                                  urgent=True, timeout=15) and \
+                self._shard_landed()
+            if not delivered:
+                from horovod_tpu.runner.http_client import put_bytes
+
+                put_bytes(self.upload_addr,
+                          f"/kv/timeline/{self.pid}", data)
         except Exception as e:
             import sys
 

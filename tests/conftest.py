@@ -77,6 +77,7 @@ _SLOW_MODULES = {
     "test_ctrl_plane",           # 4/16-process tree/star control gangs
     "test_failure_containment",  # chaos gangs (SIGKILL/SIGSTOP + deadlines)
     "test_elastic_driver",       # launcher + failure/growth scenarios
+    "test_elastic_recovery",     # kill-a-rank MiniEngine recovery gangs
     "test_runner",               # launcher subprocesses
     "test_preemption",           # signal/recovery scenarios
     "test_flash_attention",      # pallas interpret mode is slow on CPU
@@ -96,6 +97,10 @@ def pytest_configure(config):
         "markers", "quick: fast inner-loop subset (auto-applied to "
                    "modules outside the known-slow list; run with "
                    "`pytest -m quick` or `./ci.sh --fast`)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` "
+                   "verify run (multi-minute captures; the full "
+                   "./ci.sh suite still runs them)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -314,6 +314,95 @@ def test_driver_discovery_notifies_workers():
     rendezvous.stop()
 
 
+def test_driver_folds_concurrent_blacklist_into_inflight_round():
+    """A host blacklisted while a re-rendezvous is already activating
+    must fold into that activation loop (one worker restart), not
+    trigger a second back-to-back round."""
+    import json
+
+    hold = threading.Event()
+
+    def worker(slot):
+        hold.wait(5)
+        return 0
+
+    driver, _ = make_driver(
+        FixedHostDiscovery({"host-1": 1, "host-2": 1, "host-3": 1}),
+        min_np=1, max_np=3, worker_fn=worker)
+    driver.start(3)
+
+    activations = []
+    in_first = threading.Event()
+    release = threading.Event()
+    real_activate = driver._activate_round
+
+    def slow_activate(np_):
+        activations.append(np_)
+        out = real_activate(np_)
+        if len(activations) == 1:
+            # the first round is PUBLISHED (assignment snapshot taken)
+            # before the concurrent blacklist lands below — the fold
+            # loop must then re-activate, not leave a stale round up
+            in_first.set()
+            release.wait(5)
+        return out
+
+    driver._activate_round = slow_activate
+    t = threading.Thread(target=driver.resume, daemon=True)
+    t.start()
+    assert wait_until(in_first.is_set)
+    # while the first activation is mid-flight: a survivor's failure
+    # report names rank 2 (host-3) -> blacklist + fold
+    driver._on_kv_put("failure", "host-1/0", json.dumps(
+        {"round": 1, "failed_ranks": [2]}).encode())
+    assert driver._resume_pending            # folded, not queued-behind
+    # a second resume() while one is in flight returns immediately
+    driver.resume()
+    release.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    # exactly one extra activation folded in; host-3 is out of it
+    assert len(activations) == 2
+    assert driver.host_manager.is_blacklisted("host-3")
+    assert not driver.has_rank_assignment("host-3", 0)
+    hold.set()
+    driver.stop()
+
+
+def test_driver_preempt_notice_drains_host_gracefully():
+    """/kv/failure/<host>/preempt marks the host DRAINING: it leaves
+    the next assignment when capacity allows, is never blacklisted as
+    a crash, and stays assigned when dropping it would fall below
+    min_np (single-host jobs survive a notice the platform may not
+    honor)."""
+    import json
+
+    hold = threading.Event()
+
+    def worker(slot):
+        hold.wait(5)
+        return 0
+
+    driver, _ = make_driver(
+        FixedHostDiscovery({"host-1": 1, "host-2": 1}),
+        min_np=1, max_np=2, worker_fn=worker)
+    driver.start(2)
+    driver._on_kv_put("failure", "host-2/preempt", json.dumps(
+        {"reason": "signal:15", "graceful": True}).encode())
+    assert "host-2" in driver._active_draining()
+    assert not driver.host_manager.is_blacklisted("host-2")
+    slots = driver._update_host_assignments(2)
+    assert {s.hostname for s in slots} == {"host-1"}
+    # thin capacity: draining host-1 too would drop below min_np, so
+    # the assignment keeps it
+    driver._on_kv_put("failure", "host-1/preempt", json.dumps(
+        {"reason": "signal:15", "graceful": True}).encode())
+    slots = driver._update_host_assignments(2)
+    assert len(slots) >= 1
+    hold.set()
+    driver.stop()
+
+
 def test_driver_grow_on_resume():
     """After a failure round, newly discovered hosts are folded into the
     next assignment up to max_np."""

@@ -1,5 +1,11 @@
 """Elastic state machine tests (reference ``test/single/test_torch_elastic.py``
-TorchState semantics, ``common/elastic.py`` commit/restore)."""
+TorchState semantics, ``common/elastic.py`` commit/restore) plus the
+checkpointless-recovery layer: shard framing, replica-group planning,
+and the ReplicatedState commit/sync protocol over an in-process
+thread-gang collectives backend (the real engine path is exercised by
+tests/test_elastic_recovery.py and benchmarks/elastic_recovery.py)."""
+
+import threading
 
 import jax.numpy as jnp
 import numpy as np
@@ -7,6 +13,11 @@ import pytest
 
 import horovod_tpu as hvt
 from horovod_tpu.elastic import JaxState, ObjectState
+from horovod_tpu.elastic.state import (ReplicaUnavailableError,
+                                       ReplicatedState,
+                                       ShardCorruptError,
+                                       build_replica_groups,
+                                       decode_shard, encode_shard)
 
 
 def test_object_state_commit_restore():
@@ -80,3 +91,414 @@ def test_reset_callbacks():
     s.register_reset_callbacks([lambda: fired.append(1)])
     s.on_reset()
     assert fired == [1]
+
+
+# ---------------------------------------------------------------------------
+# checkpointless recovery: shards / groups / ReplicatedState
+# ---------------------------------------------------------------------------
+
+def test_shard_roundtrip_bit_identity():
+    payload = b"\x00\x01binary state \xff" * 100
+    blob = encode_shard(owner=7, version=42, payload=payload)
+    owner, version, out = decode_shard(blob)
+    assert (owner, version) == (7, 42)
+    assert out == payload                 # byte-for-byte
+
+
+def test_shard_corruption_detected():
+    blob = encode_shard(3, 5, b"hello shard")
+    # payload bit-flip -> CRC mismatch
+    bad = bytearray(blob)
+    bad[-3] ^= 0x40
+    with pytest.raises(ShardCorruptError, match="CRC"):
+        decode_shard(bytes(bad))
+    with pytest.raises(ShardCorruptError, match="truncated"):
+        decode_shard(blob[:10])
+    with pytest.raises(ShardCorruptError, match="magic"):
+        decode_shard(b"X" * len(blob))
+    with pytest.raises(ShardCorruptError, match="length"):
+        decode_shard(blob + b"extra")
+
+
+def test_build_replica_groups_cross_host():
+    hosts = ["h0", "h0", "h1", "h1", "h2", "h2", "h3", "h3"]
+    groups = build_replica_groups(hosts, 2)
+    assert sorted(r for g in groups for r in g) == list(range(8))
+    for g in groups:
+        assert len(g) == 2
+        assert len({hosts[r] for r in g}) == 2, f"group {g} same-host"
+
+
+def test_build_replica_groups_remainder_and_clamp():
+    # 5 ranks, k=2: the trailing singleton merges into its predecessor
+    groups = build_replica_groups(["h0", "h1", "h2", "h0", "h1"], 2)
+    assert sorted(len(g) for g in groups) == [2, 3]
+    # k larger than the world clamps to one group
+    assert build_replica_groups(["h0", "h1"], 5) == [[0, 1]]
+
+
+class _ThreadWorld:
+    """Barrier-based allgather shared by N in-process 'ranks' — just
+    enough collectives to drive the ReplicatedState protocol without an
+    engine (the engine path is covered by the recovery gang tests)."""
+
+    def __init__(self, n):
+        self.n = n
+        self.cond = threading.Condition()
+        self.boxes = {}
+        self.seqs = {}
+
+    def collectives(self, rank, host):
+        return _ThreadCollectives(self, rank, host)
+
+
+class _ThreadCollectives:
+    def __init__(self, world, rank, host):
+        self.w, self._rank, self._host = world, rank, host
+
+    def rank(self):
+        return self._rank
+
+    def size(self):
+        return self.w.n
+
+    def host(self):
+        return self._host
+
+    def allgather(self, obj, name, ranks=None):
+        ranks = sorted(ranks) if ranks is not None \
+            else list(range(self.w.n))
+        seq = self.w.seqs.get((self._rank, name), 0)
+        self.w.seqs[(self._rank, name)] = seq + 1
+        key = (name, seq, tuple(ranks))
+        with self.w.cond:
+            self.w.boxes.setdefault(key, {})[self._rank] = obj
+            self.w.cond.notify_all()
+            deadline = 10.0
+            while len(self.w.boxes[key]) < len(ranks):
+                if not self.w.cond.wait(deadline):
+                    raise RuntimeError(f"allgather {key} timed out")
+        return [self.w.boxes[key][r] for r in ranks]
+
+
+_HOSTS4 = ["h0", "h0", "h1", "h1"]
+
+
+def _gang(fn, n):
+    """Run fn(rank) on n threads; re-raise the first failure."""
+    errs = []
+
+    def body(r):
+        try:
+            fn(r)
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            errs.append((r, e))
+
+    threads = [threading.Thread(target=body, args=(r,))
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    if errs:
+        raise AssertionError(f"rank {errs[0][0]}: {errs[0][1]!r}") \
+            from errs[0][1]
+
+
+def _committed_gang(steps=3, n=4):
+    """A 4-rank gang that committed ``steps`` times; returns states."""
+    w = _ThreadWorld(n)
+    states = [None] * n
+
+    def run_rank(r):
+        s = ReplicatedState(collectives=w.collectives(r, _HOSTS4[r]),
+                            x=0, series=[])
+        states[r] = s
+        for step in range(steps):
+            s.x = step
+            s.series.append((r, step))
+            s.commit()
+
+    _gang(run_rank, n)
+    return states
+
+
+def test_commit_replicates_versioned_shards():
+    states = _committed_gang(steps=3)
+    for r, s in enumerate(states):
+        info = s.replica_info()
+        assert info["version"] == 3
+        assert s.owner == r
+        # every group member's lineage is held at the committed version
+        assert all(3 in vs for vs in info["held"].values())
+        assert len(info["held"]) == 2      # K=2 group: self + 1 peer
+        # groups span hosts
+        assert len({_HOSTS4[m] for m in info["group"]}) == 2
+
+
+def test_sync_rebuilds_lost_rank_from_peers_and_adopts_orphan():
+    states = _committed_gang(steps=3)
+    # rank 3 dies; the world shrinks to 3
+    w2 = _ThreadWorld(3)
+
+    def resync(r):
+        states[r]._collectives = w2.collectives(r, _HOSTS4[r])
+        states[r].sync()
+
+    _gang(resync, 3)
+    for r in range(3):
+        assert states[r].x == 2            # last committed value
+        assert states[r].owner == r
+    adopted = {o: snap for s in states[:3]
+               for o, snap in s.adopted.items()}
+    assert list(adopted) == [3]
+    assert adopted[3]["series"] == [(3, 0), (3, 1), (3, 2)]
+
+
+def test_adopted_orphan_shards_retire_and_cut_advances():
+    """A leftover-adopted lineage's frozen shard must leave every
+    shard store: its live data rides inside the adopter's own snapshot
+    from then on, and a lingering copy would drag a FUTURE recovery
+    cut down to its ancient version and fail the gang over state
+    nobody needs."""
+    states = _committed_gang(steps=3)
+    w2 = _ThreadWorld(3)
+
+    def resync(r):
+        states[r]._collectives = w2.collectives(r, _HOSTS4[r])
+        states[r].sync()
+
+    _gang(resync, 3)
+    for s in states[:3]:
+        assert 3 not in s._peer_shards, "orphan shard must retire"
+
+    # keep training, then recover again: the cut must track the LIVE
+    # lineages' versions, not the dead owner's frozen one
+    def more_commits(r):
+        s = states[r]
+        for step in range(5):
+            s.x = 100 + step
+            s.commit()
+
+    _gang(more_commits, 3)
+    w3 = _ThreadWorld(3)
+
+    def resync2(r):
+        states[r]._collectives = w3.collectives(r, _HOSTS4[r])
+        states[r].sync()
+
+    _gang(resync2, 3)
+    assert all(s.x == 104 for s in states[:3])
+    assert all(s.last_recovery["outcome"] in ("ok", "rollback")
+               for s in states[:3])
+
+
+def test_fresh_rank_never_collides_with_shifted_sticky_owner():
+    """After a shrink, a survivor's sticky owner id can equal a fresh
+    replacement's RANK id; the fresh rank must start a brand-new
+    lineage (bootstrap), never claim the survivor's."""
+    states = _committed_gang(steps=2, n=4)
+    # survivors are ranks 1..3 of the old world, re-formed as ranks
+    # 0..2 (sticky owners 1..3); a fresh worker joins at rank 3 —
+    # which collides with the survivor now holding owner 3
+    w2 = _ThreadWorld(4)
+    survivors = [states[1], states[2], states[3]]
+    fresh = ReplicatedState(collectives=w2.collectives(3, _HOSTS4[3]),
+                            x=0, series=[])
+
+    def resync(r):
+        if r == 3:
+            fresh.sync()
+        else:
+            survivors[r]._collectives = w2.collectives(r, _HOSTS4[r])
+            survivors[r].sync()
+
+    _gang(resync, 4)
+    owners = sorted([s.owner for s in survivors])
+    # one orphan (old owner 0) goes to the fresh rank; had there been
+    # none, it would have minted a brand-new id past every known owner
+    assert owners == [1, 2, 3]
+    assert fresh.owner == 0
+    assert fresh.x == 1                    # owner 0's committed value
+    assert len({fresh.owner, *owners}) == 4, "owner ids must be unique"
+
+
+def test_sync_rolls_back_version_skew_to_consistent_cut():
+    # groups are [0, 2] and [1, 3] under _HOSTS4; let group [0, 2]
+    # commit one step further (the torn-commit shape a mid-commit host
+    # loss produces), then resync the survivors
+    w = _ThreadWorld(4)
+    states = [None] * 4
+
+    def run_rank(r):
+        s = ReplicatedState(collectives=w.collectives(r, _HOSTS4[r]),
+                            x=0)
+        states[r] = s
+        for step in range(2 + (1 if r in (0, 2) else 0)):
+            s.x = step
+            s.commit()
+
+    _gang(run_rank, 4)
+    assert [s.version for s in states] == [3, 2, 3, 2]
+    w2 = _ThreadWorld(3)
+
+    def resync(r):
+        states[r]._collectives = w2.collectives(r, _HOSTS4[r])
+        states[r].sync()
+
+    _gang(resync, 3)
+    # the cut is version 2 (x == 1): ranks 0/2 rolled BACK a generation
+    assert [s.x for s in states[:3]] == [1, 1, 1]
+    assert states[0].last_recovery["outcome"] == "rollback"
+    assert states[1].last_recovery["outcome"] == "ok"
+
+
+def test_fresh_respawn_rebuilds_from_peer_shard():
+    states = _committed_gang(steps=2)
+    # rank 3's process is replaced by a fresh spawn at the same rank
+    w2 = _ThreadWorld(4)
+    fresh = ReplicatedState(collectives=w2.collectives(3, _HOSTS4[3]),
+                            x=0, series=[])
+
+    def resync(r):
+        if r == 3:
+            fresh.sync()
+        else:
+            states[r]._collectives = w2.collectives(r, _HOSTS4[r])
+            states[r].sync()
+
+    _gang(resync, 4)
+    assert fresh.owner == 3
+    assert fresh.x == 1                    # rank 3's committed value
+    assert fresh.series == [(3, 0), (3, 1)]
+    assert fresh.last_recovery["outcome"] == "peer"
+
+
+def test_stale_shard_version_rejected():
+    states = _committed_gang(steps=3)
+    s = states[0]
+    peer_owner = [o for o in s.replica_info()["held"] if o != 0][0]
+    old = encode_shard(peer_owner, 1, b"ancient")
+    s._ingest(old)
+    assert 1 not in dict(s.replica_info()["held"])[peer_owner]
+    # a corrupt incoming copy never evicts the good one either
+    good_versions = s.replica_info()["held"][peer_owner]
+    bad = bytearray(encode_shard(peer_owner, 9, b"corrupt"))
+    bad[-1] ^= 0xFF
+    s._ingest(bytes(bad))
+    assert s.replica_info()["held"][peer_owner] == good_versions
+
+
+def test_crc_mismatch_falls_back_to_application_restore():
+    """A corrupt replica sends the WHOLE gang to the application
+    restore: one rank reloading its checkpoint alone would leave the
+    gang at a mixed step cut, so the fallback outcome propagates
+    through the sync consensus and every rank restores together."""
+    states = _committed_gang(steps=2)
+    # corrupt owner 3's shard everywhere it is held, then replace rank
+    # 3 with a fresh spawn; every rank has an application fallback
+    for s in states:
+        gens = s._peer_shards.get(3)
+        if gens:
+            s._peer_shards[3] = [
+                (v, b[:-1] + bytes([b[-1] ^ 0xFF])) for v, b in gens]
+    w2 = _ThreadWorld(4)
+    fellback = []
+
+    def fallback(st):
+        fellback.append(True)
+        st.x = 99
+        st.series = ["from-checkpoint"]
+
+    fresh = ReplicatedState(collectives=w2.collectives(3, _HOSTS4[3]),
+                            fallback=fallback, x=0, series=[])
+    for s in states:
+        s._fallback = fallback
+
+    def resync(r):
+        if r == 3:
+            fresh.sync()
+        else:
+            states[r]._collectives = w2.collectives(r, _HOSTS4[r])
+            states[r].sync()
+
+    _gang(resync, 4)
+    assert len(fellback) == 4              # the gang restores TOGETHER
+    assert fresh.x == 99
+    assert fresh.last_recovery["outcome"] == "fallback"
+    for s in states[:3]:
+        assert s.x == 99
+
+
+def test_replica_unavailable_without_fallback_raises():
+    states = _committed_gang(steps=2)
+    # owner 3's replicas survive only as corrupt bytes (so the lineage
+    # is still KNOWN — a total loss with no record degrades to the
+    # bootstrap path instead) and the fresh spawn has no fallback
+    for s in states:
+        gens = s._peer_shards.get(3)
+        if gens:
+            s._peer_shards[3] = [
+                (v, b[:-1] + bytes([b[-1] ^ 0xFF])) for v, b in gens]
+    w2 = _ThreadWorld(4)
+    fresh = ReplicatedState(collectives=w2.collectives(3, _HOSTS4[3]),
+                            x=0, series=[])
+    failed = []
+
+    def resync(r):
+        s = fresh if r == 3 else states[r]
+        if r != 3:
+            s._collectives = w2.collectives(r, _HOSTS4[r])
+        try:
+            s.sync()
+        except ReplicaUnavailableError:
+            failed.append(r)
+
+    _gang(resync, 4)
+    # gang-wide consensus: EVERY rank falls back together — partial
+    # recovery would be an inconsistent cut
+    assert sorted(failed) == [0, 1, 2, 3]
+
+
+def test_grow_bootstraps_new_lineage_from_peer():
+    states = _committed_gang(steps=2, n=2)
+    w2 = _ThreadWorld(3)
+    hosts3 = ["h0", "h0", "h1"]
+    new = ReplicatedState(collectives=w2.collectives(2, hosts3[2]),
+                          x=0, series=[])
+
+    def resync(r):
+        if r == 2:
+            new.sync()
+        else:
+            states[r]._collectives = w2.collectives(r, hosts3[r])
+            states[r].sync()
+
+    _gang(resync, 3)
+    assert new.last_recovery["outcome"] == "bootstrap"
+    assert new.x == 1                      # copied the cut-version state
+
+
+def test_replication_disabled_env(monkeypatch):
+    monkeypatch.setenv("HVT_STATE_REPLICATION", "0")
+    calls = []
+
+    class NoCollectives:
+        def rank(self):
+            return 0
+
+        def size(self):
+            return 4
+
+        def host(self):
+            return "h0"
+
+        def allgather(self, obj, name, ranks=None):
+            calls.append(name)
+            raise AssertionError("disabled replication must not "
+                                 "exchange")
+
+    s = ReplicatedState(collectives=NoCollectives(), x=1)
+    s.commit()
+    assert calls == []
+    assert s.version == 0

@@ -241,6 +241,139 @@ def test_member_falls_back_to_direct_when_leader_dies():
         server.stop()
 
 
+# ------------------------------------------ leader-routed KV relay (r14)
+
+def test_relay_put_direct_when_disabled(monkeypatch):
+    monkeypatch.setenv("HVT_KV_RELAY", "0")
+    server, addr = _mk_server(np_=1)
+    try:
+        assert T.relay_put(addr, "failure", "h0/0", {"round": 1})
+        assert json.loads(server.store.get("failure", "h0/0")) == \
+            {"round": 1}
+        assert server.store.ingest_stats()["put_requests"]["failure"] \
+            == 1
+    finally:
+        server.stop()
+
+
+def test_relay_routes_through_leader_and_kvbulk(monkeypatch):
+    """Member envelopes land via the leader's ONE /kvbulk request:
+    same (scope, key, value) in the store, but per-request accounting
+    counts the batch once — the O(hosts) fan-in mechanism."""
+    monkeypatch.setenv("HVT_KV_RELAY", "1")
+    monkeypatch.setenv("HVT_TOPO_HOST", "h0")
+    T._relay_ep_cache.clear()
+    server, addr = _mk_server(np_=2)
+    stop = threading.Event()
+    leader = T.TelemetryPusher(addr, 0, lambda: {"rank": 0}, stop,
+                               host="h0", role="leader",
+                               period_sec=0.2)
+    try:
+        leader.step()  # publish the endpoint
+        hook_hits = []
+        server.set_put_hook(
+            lambda scope, key, val: hook_hits.append((scope, key)))
+        # urgent envelopes: debounce-flushed as one bulk request
+        assert T.relay_put(addr, "failure", "h0/0",
+                           {"failed_ranks": [1]}, urgent=True)
+        assert T.relay_put(addr, "state", "h0/0",
+                           {"state": "READY", "round": 1}, urgent=True)
+        deadline = time.monotonic() + 5
+        while server.store.get("state", "h0/0") is None:
+            assert time.monotonic() < deadline, "bulk flush never landed"
+            time.sleep(0.02)
+        assert json.loads(server.store.get("failure", "h0/0")) == \
+            {"failed_ranks": [1]}
+        # the put hook fired per entry (driver semantics preserved)
+        assert ("failure", "h0/0") in hook_hits
+        assert ("state", "h0/0") in hook_hits
+        reqs = server.store.ingest_stats()["put_requests"]
+        # both envelopes coalesced into one debounced batch
+        assert reqs.get("failure", 0) == 1
+        assert reqs.get("state", 0) == 1
+        # non-urgent rides the next pusher tick
+        assert T.relay_put(addr, "recovery", "h0/0",
+                           {"phase": "rebuild", "outcome": "ok"})
+        assert server.store.get("recovery", "h0/0") is None
+        leader.step()
+        assert server.store.get("recovery", "h0/0") is not None
+    finally:
+        stop.set()
+        leader.close()
+        server.stop()
+        T._relay_ep_cache.clear()
+
+
+def test_relay_falls_back_to_direct_without_leader(monkeypatch):
+    monkeypatch.setenv("HVT_KV_RELAY", "1")
+    monkeypatch.setenv("HVT_TOPO_HOST", "h9")
+    T._relay_ep_cache.clear()
+    server, addr = _mk_server(np_=1)
+    try:
+        # no leader endpoint published for h9 → the PUT still lands
+        assert T.relay_put(addr, "failure", "h9/0", {"round": 2},
+                           urgent=True)
+        assert json.loads(server.store.get("failure", "h9/0")) == \
+            {"round": 2}
+    finally:
+        server.stop()
+        T._relay_ep_cache.clear()
+
+
+def test_kvbulk_endpoint_validates_and_counts():
+    import base64
+    import urllib.error
+    import urllib.request
+
+    from horovod_tpu.runner.http_client import put_bytes
+
+    server, addr = _mk_server(np_=1)
+    try:
+        envs = [{"scope": "serving", "key": str(i),
+                 "value_b64": base64.b64encode(
+                     json.dumps({"i": i}).encode()).decode()}
+                for i in range(5)]
+        put_bytes(addr, "/kvbulk", json.dumps(envs).encode(),
+                  retries=0)
+        assert sorted(server.store.keys("serving")) == \
+            sorted(str(i) for i in range(5))
+        # 5 entries, ONE request
+        assert server.store.ingest_stats()["put_requests"]["serving"] \
+            == 1
+        req = urllib.request.Request(f"http://{addr}/kvbulk",
+                                     data=b"{not-a-list", method="PUT")
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(req, timeout=5)
+        # malformed entries are skipped, valid ones land
+        mixed = [{"nope": 1}, {"scope": "serving", "key": "ok",
+                 "value_b64": base64.b64encode(b"1").decode()}]
+        put_bytes(addr, "/kvbulk", json.dumps(mixed).encode(),
+                  retries=0)
+        assert server.store.get("serving", "ok") == b"1"
+    finally:
+        server.stop()
+
+
+def test_statusz_recovery_rows():
+    server, addr = _mk_server(np_=1)
+    try:
+        server.store.put("recovery", "h0/0", json.dumps(
+            {"phase": "rebuild", "outcome": "peer", "seconds": 0.4,
+             "round": 2}).encode())
+        server.store.put("recovery", "h1/0", json.dumps(
+            {"phase": "recovered", "outcome": "ok", "seconds": 2.2,
+             "round": 2}).encode())
+        doc = server.statusz_snapshot()
+        rec = doc["recovery"]
+        assert rec["reports"] == 2
+        assert rec["by_phase"] == {"rebuild": 1, "recovered": 1}
+        assert rec["by_outcome"] == {"peer": 1, "ok": 1}
+        assert rec["max_seconds"] == 2.2
+        assert rec["ranks"]["h0/0"]["phase"] == "rebuild"
+    finally:
+        server.stop()
+
+
 # ------------------------------------------------- KV staleness (satellite)
 
 def test_store_timestamps_and_ttl_sweep():
