@@ -100,6 +100,8 @@ class MiniEngine:
         self.lib.hvt_result_read.argtypes = [ctypes.c_int,
                                              ctypes.c_void_p,
                                              ctypes.c_longlong]
+        self.lib.hvt_wait_timeout.argtypes = [ctypes.c_int,
+                                              ctypes.c_longlong]
         self.lib.hvt_engine_stats.argtypes = [
             ctypes.POINTER(ctypes.c_longlong), ctypes.c_int]
         self.lib.hvt_events_drain.argtypes = [ctypes.c_void_p,
@@ -109,6 +111,11 @@ class MiniEngine:
         self.slots = _slot_index()
         self.rank = 0
         self.size = 1
+        # eager, not lazy: two client threads (the serving soak runs
+        # one per tenant) racing the lazy getattr-init would each
+        # create their own dict and drop the loser's handle entries
+        self._dtype_of = {}
+        self._ready = {}  # handle → payload collected by wait_timeout
 
     def init(self, rank, size, addr="127.0.0.1", port=29640, cycle_ms=1):
         rc = self.lib.hvt_init(rank, size, addr.encode(), port, cycle_ms)
@@ -130,13 +137,22 @@ class MiniEngine:
               "float64": (8, ctypes.c_double)}
 
     def submit(self, name, values, op="allreduce", reduce="sum",
-               dtype="float32", root=0, members=None):
+               dtype="float32", root=0, members=None, group_id=-1,
+               group_size=0):
         """Async submit of a single-dim collective; returns the handle
         (pair with wait()). Lets tests land several submissions in one
-        engine cycle."""
+        engine cycle. group_id/group_size join the submission into an
+        engine-side fusion group (negotiated atomically, fused into ONE
+        collective — the serving soak's request batches ride this)."""
         wire_dt, ct = self.DTYPES[dtype]
         n = len(values)
-        buf = (ct * n)(*values)
+        # a preconstructed ctypes array is used as-is: hvt_submit copies
+        # the payload synchronously, so callers may reuse one buffer
+        # across submits (the serving soak's request payloads cycle over
+        # a few values — rebuilding a 16K-element array per request was
+        # pure python overhead)
+        buf = values if isinstance(values, ctypes.Array) \
+            else (ct * n)(*values)
         dims = (ctypes.c_longlong * 1)(n)
         splits = (ctypes.c_longlong * 1)(0)
         mem = members or []
@@ -145,15 +161,44 @@ class MiniEngine:
             name.encode(), self.OPS[op], self.REDUCE[reduce], wire_dt,
             1, dims, ctypes.cast(buf, ctypes.c_void_p),
             ctypes.c_longlong(n * ctypes.sizeof(ct)), root, 1.0, 1.0,
-            0, splits, -1, 0, len(mem), mem_arr)
+            0, splits, group_id, group_size, len(mem), mem_arr)
         if h < 0:
             raise RuntimeError("hvt_submit rejected")
-        self._dtype_of = getattr(self, "_dtype_of", {})
         self._dtype_of[h] = ct
         return h
 
+    def wait_timeout(self, h, timeout_ms) -> bool:
+        """Bounded poll of a pending handle: False while still pending
+        after timeout_ms (the handle stays waitable), True when done —
+        pair with wait() to collect. rc<0 surfaces through wait()'s
+        error path.
+
+        On success the payload is read out IMMEDIATELY and stashed for
+        that wait(): hvt_wait_timeout shares hvt_wait's move-out
+        semantics (handles are waited at most once), so deferring the
+        hvt_result_* calls to a later hvt_wait would find an empty
+        output. Error status persists on the handle, so rc<0 just
+        falls through to wait()'s hvt_wait."""
+        rc = int(self.lib.hvt_wait_timeout(h, int(timeout_ms)))
+        if rc == 1:
+            return False
+        if rc == 0:
+            ct = self._dtype_of[h]
+            nbytes = int(self.lib.hvt_result_bytes(h))
+            out = (ct * (nbytes // ctypes.sizeof(ct)))()
+            if nbytes:
+                self.lib.hvt_result_read(
+                    h, ctypes.cast(out, ctypes.c_void_p),
+                    ctypes.c_longlong(nbytes))
+            self._ready[h] = list(out)
+        return True
+
     def wait(self, h, name="?"):
         ct = self._dtype_of.pop(h)
+        if h in self._ready:
+            out = self._ready.pop(h)
+            self.lib.hvt_release(h)
+            return out
         rc = self.lib.hvt_wait(h)
         if rc != 0:
             err = ctypes.create_string_buffer(4096)
@@ -205,6 +250,30 @@ class MiniEngine:
                     rx += int(buf[i].arg2)
             if n < len(buf):
                 return cycles, tx, rx
+
+    def drain_exec_events(self):
+        """Drain the flight recorder and return the EXEC span stream:
+        a ring-ordered list of (ts_us, kind, lane) for EXEC_BEGIN (5) /
+        EXEC_END (6) events — enough to reconstruct which lanes were
+        mid-execution when another lane's execution started (the
+        serving soak's pool-concurrency probe). Events come back in
+        RING order, not timestamp order: the ring's atomic head
+        preserves each thread's true record order, while sorting on
+        the microsecond-truncated stamps would shuffle the several
+        events a fast span records within one microsecond — phantom
+        overlaps a single-thread engine cannot actually produce.
+        Non-exec events are consumed and discarded."""
+        out = []
+        buf = (_Event * 2048)()
+        while True:
+            n = int(self.lib.hvt_events_drain(buf, len(buf)))
+            for i in range(n):
+                k = int(buf[i].kind)
+                if k in (5, 6):  # EXEC_BEGIN / EXEC_END (csrc/events.h)
+                    out.append((int(buf[i].ts_us), k,
+                                int(buf[i].lane)))
+            if n < len(buf):
+                return out
 
 
 # ---------------------------------------------------------------------------

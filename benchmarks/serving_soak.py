@@ -1,0 +1,1429 @@
+#!/usr/bin/env python
+"""Mixed-tenant serving soak under production failures
+(``python benchmarks/serving_soak.py``).
+
+ISSUE 15's composition gate: the serving machinery (PR 6 ReplicaGang +
+this PR's request-level batching and per-lane execution pool), the
+transient-fault chaos (PR 10 flaky_conn/partition), the telemetry plane
+(PR 13 /statusz health rules), and the elastic driver/autoscaler (PR
+6/14) all running AT ONCE over a simulated 64-rank / 8-host gang of
+featherweight MiniEngine workers (bare ctypes over ``libhvt_core.so`` —
+no jax/numpy per worker; same harness family as
+``ctrl_plane_scaling.py`` / ``elastic_recovery.py``).
+
+**The tenant grid.** Every rank serves TWO lanes: its host's "row" lane
+(contiguous ranks, one replica per host) and a "column" lane striding
+one rank per host. A row lane and a column lane share exactly ONE rank,
+which is precisely the shape the engine's per-lane worker pool
+(``HVT_LANE_WORKERS``) isolates: a saturated row lane's data-plane time
+no longer head-of-line-blocks the column lane crossing it on the shared
+rank.
+
+**The storyline** (one "pool" arm, phases separated by engine barriers,
+all traffic deterministic step counts — wall-clock-bounded loops
+deadlock gangs, see BENCH_NOTES r13):
+
+- ``warm``/``baseline`` — every lane carries light traffic; the
+  /statusz health plane must stay ALERT-FREE (the clean-gang pin).
+- ``fire`` — one host's row lane goes hot (bigger payloads, more
+  requests) while ``flaky_conn`` cuts a hot-host rank's links
+  mid-transfer; the idle COLUMN lanes' exec-start overlap with the
+  hot lane's open exec spans is the lane-isolation gate (impossible
+  without the pool — see ``_col_ov_frac``; measured over column lanes
+  not containing the flaky rank), and the reconnects must surface as
+  a ``reconnect_storm`` alert.
+- ``storm`` — a ``partition`` fault splits two hosts away for ~600 ms
+  mid-traffic; the links heal (zero engine aborts — the transient-fault
+  gate) and traffic completes.
+- ``endure`` → host SIGKILL → re-shard — the driver kills the last
+  host; survivors abort into the PR 4 containment path, report
+  failures, and re-rendezvous into a smaller world (the autoscaler
+  records the shed; ``push_stale`` alerts must name only killed ranks);
+  lanes are re-planned for the new world and a ``recovered`` phase
+  completes clean.
+
+A second, shorter "nopool" arm (``HVT_LANE_WORKERS=0``, no chaos, no
+kill) replays warm/baseline/fire for the per-lane worker pool A/B: the
+single-thread engine's column-lane inflation under the same hot
+neighbor is the denominator of the isolation claim.
+
+Member-identical (admitted, shed, batch-boundary) decision CRCs are
+asserted per lane per phase — the PR 6 invariant extended to batching.
+
+Artifact: ``benchmarks/r15_serving_soak.json`` (committed from
+``--capture``); ``ci.sh --servesoak`` runs ``--smoke`` (8 ranks /
+4 hosts) + ``--check`` of both.
+
+Modes:
+    --smoke [--out X.json]     8-rank / 4-host soak (ci.sh --servesoak)
+    --capture [--out ...]      the full 64-rank / 8-host r15 matrix
+    --check X.json             artifact schema + mode-aware claim gates
+Worker mode is selected internally via HVT_SSK_WORKER.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import types
+import zlib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMA = "hvt-serving-soak-r2"
+
+# health-alert rules that injected faults may legitimately fire; any
+# OTHER rule in a chaos phase fails the run, and baseline must be empty
+ALLOWED_ALERTS = {"reconnect_storm", "push_stale", "straggler",
+                  "serving_backlog"}
+
+
+def lane_slot(members) -> int:
+    """Python mirror of engine.h LaneId/LaneSlot: the stats bucket a
+    process-set lane's exec telemetry lands in (FNV-1a over the sorted
+    member list, 8 LE bytes per rank; bucket 0 is the global lane)."""
+    if not members:
+        return 0
+    h = 1469598103934665603
+    for m in sorted(int(x) for x in members):
+        for b in range(8):
+            h ^= (m >> (b * 8)) & 0xFF
+            h = (h * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    if h == 0:
+        h = 1
+    return 1 + (h % 7)
+
+
+def _stub_package():
+    """Register a bare ``horovod_tpu`` package root so submodule
+    imports work WITHOUT executing the real package ``__init__`` (which
+    imports jax — the weight this harness exists to avoid)."""
+    if "horovod_tpu" not in sys.modules:
+        pkg = types.ModuleType("horovod_tpu")
+        pkg.__path__ = [os.path.join(REPO, "horovod_tpu")]
+        sys.modules["horovod_tpu"] = pkg
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# lane plans (shared by workers + the driver's expectations)
+# ---------------------------------------------------------------------------
+
+def row_partition(size: int, per_host: int):
+    """One contiguous lane per host (the driver packs ranks host-major)."""
+    return [list(range(h * per_host, (h + 1) * per_host))
+            for h in range(size // per_host)]
+
+
+def col_partition(size: int, per_host: int):
+    """per_host lanes, each striding one rank per host."""
+    return [list(range(i, size, per_host)) for i in range(per_host)]
+
+
+# ---------------------------------------------------------------------------
+# MiniEngine adapter for ReplicaGang (the serving engine seam)
+# ---------------------------------------------------------------------------
+
+class MiniServingEngine:
+    """The five-method serving-engine seam over a MiniEngine, jax-free.
+
+    Batches ride the engine's native fusion groups; group ids must be
+    identical across a lane's members and globally unique across
+    concurrently-open lanes, so they derive from (lane_base, per-lane
+    flush sequence) — never from a per-process counter, which would
+    drift across members once one lane runs hotter than another."""
+
+    def __init__(self, eng, rank: int, size: int, lane_base: int):
+        self.eng = eng
+        self._rank, self._size = rank, size
+        self._lane_base = int(lane_base)
+        self._flush_seq = 0
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._size
+
+    def submit(self, name, tensor, members, op="sum"):
+        return [self.eng.submit(name, tensor, reduce=op,
+                                members=list(members))]
+
+    def submit_batch(self, name, tensors, members, op="sum"):
+        self._flush_seq += 1
+        gid = (self._lane_base * 65536 + (self._flush_seq % 32768)) \
+            & 0x7FFFFFFF
+        n = len(tensors)
+        return [self.eng.submit(f"{name}.{i}", t, reduce=op,
+                                members=list(members), group_id=gid,
+                                group_size=n)
+                for i, t in enumerate(tensors)]
+
+    def wait(self, handle, timeout=None):
+        from horovod_tpu.common.exceptions import HorovodTimeoutError
+
+        hs = handle
+        if timeout is not None and not self.eng.wait_timeout(
+                hs[0], max(1, int(timeout * 1e3))):
+            raise HorovodTimeoutError(
+                f"serving wait exceeded {timeout:.3f}s")
+        outs = [self.eng.wait(h) for h in hs]
+        return outs if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# worker
+# ---------------------------------------------------------------------------
+
+def _lane_record(gang) -> dict:
+    s = gang.stats
+    return {
+        "members": list(gang.my_members),
+        "admitted": s.admitted, "shed": s.shed, "batches": s.batches,
+        "completed": s.completed, "deadline_miss": s.deadline_miss,
+        "p50_ms": round(s.percentile(50), 4),
+        "p99_ms": round(s.percentile(99), 4),
+        # the member-identity probe: the full (admit, shed, batch)
+        # tuple sequence, CRC'd
+        "dec_crc": zlib.crc32(repr(gang.decisions).encode())
+                   & 0xFFFFFFFF,
+    }
+
+
+def _worker():
+    _stub_package()
+    import importlib
+
+    from benchmarks.ctrl_plane_scaling import MiniEngine
+
+    erun = importlib.import_module("horovod_tpu.elastic.run")
+    from horovod_tpu.metrics import telemetry as T
+    from horovod_tpu.runner.http_client import get_json, put_bytes
+    from horovod_tpu.serving.replica_gang import ReplicaGang
+
+    spec = json.loads(os.environ["HVT_SSK_SPEC"])
+    kv = os.environ["HVT_RENDEZVOUS_ADDR"]
+    host = os.environ["HVT_SSK_HOST"]
+    erun._identity = (host, os.environ.get("HVT_LOCAL_PROCESS_ID", "0"))
+    per_host = spec["per_host"]
+    window = spec["window"]
+    batch = spec["batch"]
+    admission = spec["admission_ms"] / 1e3
+    burst = spec["burst"]
+    debug = os.environ.get("HVT_SSK_DEBUG")
+
+    def trace(msg):
+        if debug:
+            print(f"[ssk {host}/{os.environ.get('HVT_LOCAL_PROCESS_ID')}]"
+                  f" {msg}", file=sys.stderr, flush=True)
+
+    def init_engine(eng, rank, size, port):
+        import ctypes
+
+        try:
+            eng.init(rank, size, port=port,
+                     cycle_ms=spec.get("cycle_ms", 2))
+        except RuntimeError:
+            err = ctypes.create_string_buffer(4096)
+            eng.lib.hvt_error_message(err, 4096)
+            raise RuntimeError(
+                f"hvt_init failed (rank {rank}/{size} port {port}): "
+                f"{err.value.decode(errors='replace')}")
+
+    round_ = erun._sync_slot_from_rendezvous(0)
+    rank = int(os.environ["HVT_PROCESS_ID"])
+    size = int(os.environ["HVT_NUM_PROCESSES"])
+    world = get_json(kv, "/world", retries=2)
+    eng = MiniEngine()
+    init_engine(eng, rank, size, int(world["master_port"]))
+    trace(f"up rank={rank}/{size} round={round_}")
+
+    # telemetry: real compact snapshots off the engine stats block, so
+    # /statusz sees queue/link/reconnect state (the health rules' food)
+    stop = threading.Event()
+
+    def snap_fn():
+        return T.build_snapshot(
+            rank, host,
+            {"rank": rank, "engine": {"running": True,
+                                      "cycles":
+                                          eng.stats().get("cycles", 0)}},
+            eng.stats())
+
+    pusher = T.TelemetryPusher(kv, rank, snap_fn, stop,
+                               period_sec=spec.get("push_sec", 1.0))
+    threading.Thread(target=pusher.run, daemon=True).start()
+
+    def progress(body):
+        if rank != 0:
+            return
+        try:
+            put_bytes(kv, "/kv/progress/0", json.dumps(body).encode(),
+                      timeout=2, retries=0)
+        except Exception:
+            pass
+
+    def barrier(tag):
+        out = eng.allreduce(f"ssk.bar.{tag}", [1.0])
+        assert int(out[0]) == size, (tag, out)
+
+    def make_gangs(phase):
+        """Fresh per-phase gangs over the CURRENT world: one row lane
+        (this host's ranks) and one column lane (this rank's stride)."""
+        rows = row_partition(size, per_host)
+        cols = col_partition(size, per_host)
+        my_row = next(i for i, g in enumerate(rows) if rank in g)
+        my_col = next(i for i, g in enumerate(cols) if rank in g)
+        # a hot tenant runs a DEEPER window (hot_window): the realistic
+        # hot-lane shape, and what makes the nopool arm's head-of-line
+        # blocking visible — with the default window only ~2 fused row
+        # ops are ever outstanding, so the column op rarely queues
+        # behind one. Row members all live on one host, so the
+        # per-host parameter keeps every member's program identical
+        # (decision CRCs must still match).
+        row_window = (spec.get("hot_window", window)
+                      if host == spec["hot_host"] else window)
+        # the row lane is named to sort BEFORE the column lane: the
+        # coordinator completes cold negotiations in name-lexicographic
+        # order (engine.cc counts_ iteration), so head-of-line blocking
+        # only exists for the neighbor BEHIND the hot tenant in that
+        # deterministic order. The observer column lane is deliberately
+        # placed on the unlucky side — production tenants do not get to
+        # choose their side, so the bench bounds the worst case — and
+        # BOTH iso arms see the identical order, keeping the A/B fair.
+        row = ReplicaGang(
+            len(rows), admission_timeout=admission,
+            max_backlog=row_window,
+            batch_window=batch, name=f"{phase}.arow", partition=rows,
+            engine=MiniServingEngine(eng, rank, size, 1 + my_row))
+        col = ReplicaGang(
+            len(cols), admission_timeout=admission, max_backlog=window,
+            batch_window=batch, name=f"{phase}.col", partition=cols,
+            engine=MiniServingEngine(eng, rank, size, 101 + my_col))
+        return row, col
+
+    def make_bufs(elems, salt):
+        """Prebuilt payloads, one per salt value (hvt_submit copies
+        synchronously). Built with array-module C-level repeat — a
+        python list comprehension at 1M elems burns SECONDS of GIL on
+        the hot ranks, long enough that the other tenant's whole phase
+        program drains before the hot lane submits anything and the
+        phases never actually contend (found via the exec-span
+        timeline: the hot lane's first exec began 3.2 s into fire)."""
+        import ctypes as C
+        from array import array
+
+        out = []
+        for s in range(salt):
+            a = array("f", [float(s + 1)]) * elems
+            out.append((C.c_float * elems).from_buffer(a))
+        return out
+
+    def drive_lane(gang, n, bufs, lane_burst=None):
+        """One tenant's serving loop: burst-submit, reap at the window,
+        flush + drain. A pure function of the request index, so every
+        member of the lane plays the identical program."""
+        salt = len(bufs)
+        b = lane_burst or burst
+        k = 0
+        while k < n:
+            for _ in range(min(b, n - k)):
+                gang.submit_request(bufs[k % salt])
+                k += 1
+            while gang.backlog() >= gang.max_backlog:
+                gang.reap()
+        gang.flush()
+        while gang.backlog():
+            gang.reap()
+
+    def serve_phase(phase, row_n, col_n, row_elems, col_elems):
+        """Drive one phase with one thread PER TENANT — the production
+        shape (each tenant has its own serving loop), and the shape the
+        per-lane pool isolates: without it the hot row tenant's engine
+        executions head-of-line-block the column tenant's on the shared
+        rank. Then barrier + publish the per-lane record. Lane programs
+        stay deterministic per member, so decision CRCs must match."""
+        row, col = make_gangs(phase)
+        # per-phase delta of the engine's in-rank, per-lane exec
+        # telemetry — the robust isolation metric (data-plane wall time
+        # per executed response on THIS rank, no python-thread or
+        # admission noise). Lanes hash onto 8 stats buckets; a rank
+        # whose row and col lanes collide marks its sample unusable.
+        slot_row = lane_slot(row.my_members)
+        slot_col = lane_slot(col.my_members)
+        # prebuild BOTH tenants' payloads, then re-sync the gang: the
+        # hot ranks' (bigger) build must not let the other tenants
+        # race ahead — the phases measure CONCURRENT traffic
+        row_bufs = make_bufs(row_elems, 13)
+        col_bufs = make_bufs(col_elems, 11)
+        barrier(f"pre.{phase}")
+        eng.drain_exec_events()  # clear pre-phase exec spans
+        s0 = eng.stats()
+        errs = []
+
+        def run(gang, n, bufs, lane_burst=None):
+            try:
+                drive_lane(gang, n, bufs, lane_burst)
+            except BaseException as e:  # noqa: B036 — re-raised below
+                errs.append(e)
+
+        row_burst = (spec.get("hot_burst")
+                     if host == spec["hot_host"] else None)
+        t_row = threading.Thread(target=run,
+                                 args=(row, row_n, row_bufs, row_burst))
+        t_col = threading.Thread(target=run,
+                                 args=(col, col_n, col_bufs))
+        t_row.start()
+        t_col.start()
+        t_row.join()
+        t_col.join()
+        if errs:
+            raise RuntimeError(f"serving thread failed: {errs[0]!r}")
+        s1 = eng.stats()
+
+        def lane_us(slot, group):
+            dn = (s1.get(f"{group}_ns[{slot}]", 0)
+                  - s0.get(f"{group}_ns[{slot}]", 0))
+            dc = (s1.get(f"{group}_count[{slot}]", 0)
+                  - s0.get(f"{group}_count[{slot}]", 0))
+            return (round(dn / 1e3 / max(dc, 1), 2), dc)
+
+        # lane_exec = data-plane wall time per executed response;
+        # lane_hol = submit → engine-pickup queue wait (the in-rank
+        # service-start delay a hot inline neighbor causes; both ends
+        # stamp on THIS rank, so peer skew cannot leak in)
+        exec_stats = {
+            "row": lane_us(slot_row, "lane_exec"),
+            "col": lane_us(slot_col, "lane_exec"),
+            "collision": slot_row == slot_col,
+        }
+        hol_stats = {
+            "row": lane_us(slot_row, "lane_hol"),
+            "col": lane_us(slot_col, "lane_hol"),
+        }
+        # the GATED isolation probe: from the flight recorder's
+        # lane-stamped EXEC spans, how many of each tenant's exec
+        # STARTS happened while the OTHER tenant's exec span was open
+        # on this rank. Event-ordering, not wall-clock: a single-thread
+        # engine can never have two spans open (LaneBarrier quiesces
+        # the pool before every inline execution), so a nonzero
+        # overlapped count is constructive proof the pool decoupled the
+        # lanes — and an oversubscribed 1-core harness box cannot fake
+        # or hide it the way it skews latency ratios.
+        ov = {"row": [0, 0], "col": [0, 0]}  # [starts, overlapped]
+        busy_us = {"row": 0, "col": 0}  # span-open wall time (duty)
+        if slot_row != slot_col:
+            ev_stream = eng.drain_exec_events()
+            dump_dir = os.environ.get("HVT_SSK_EV_DUMP")
+            if dump_dir:
+                with open(os.path.join(
+                        dump_dir, f"ev_{phase}_{rank}.json"), "w") as f:
+                    json.dump({"slot_row": slot_row,
+                               "slot_col": slot_col,
+                               "events": ev_stream}, f)
+            open_n = {}
+            open_t0 = {}
+            for ts, kind, lane in ev_stream:
+                tenant = ("row" if lane == slot_row else
+                          "col" if lane == slot_col else None)
+                if kind == 5:  # EXEC_BEGIN
+                    if tenant:
+                        other = slot_col if tenant == "row" else slot_row
+                        ov[tenant][0] += 1
+                        if open_n.get(other, 0) > 0:
+                            ov[tenant][1] += 1
+                        if not open_n.get(lane):
+                            open_t0[lane] = ts
+                    open_n[lane] = open_n.get(lane, 0) + 1
+                else:  # EXEC_END
+                    open_n[lane] = max(0, open_n.get(lane, 0) - 1)
+                    if tenant and not open_n[lane] and lane in open_t0:
+                        busy_us[tenant] += ts - open_t0.pop(lane)
+        # the autoscaler's serving signal + the /statusz serving block
+        # (and its ghost-lane staleness handling after the re-shard)
+        row.push_stats()
+        col.push_stats()
+        barrier(phase)
+        st = eng.stats()
+        lanes_rec = {"row": _lane_record(row), "col": _lane_record(col)}
+        for tenant in ("row", "col"):
+            us, cnt = exec_stats[tenant]
+            lanes_rec[tenant]["exec_us_mean"] = us
+            lanes_rec[tenant]["exec_count"] = cnt
+            hus, hcnt = hol_stats[tenant]
+            lanes_rec[tenant]["hol_us_mean"] = hus
+            lanes_rec[tenant]["hol_count"] = hcnt
+            lanes_rec[tenant]["ov_starts"] = ov[tenant][0]
+            lanes_rec[tenant]["ov_overlapped"] = ov[tenant][1]
+            lanes_rec[tenant]["busy_us"] = busy_us[tenant]
+            lanes_rec[tenant]["slot_collision"] = \
+                exec_stats["collision"]
+        rec = {
+            "rank": rank, "host": host, "round": round_, "size": size,
+            "lanes": lanes_rec,
+            "engine": {
+                "aborts": sum(v for k, v in st.items()
+                              if k.startswith("aborts[")),
+                "pool_tasks": st.get("lane_pool_tasks", 0),
+                "lane_workers": st.get("lane_workers", 0),
+                "reconnects": (st.get("link_reconnects[ctrl]", 0)
+                               + st.get("link_reconnects[data]", 0)),
+                "data_ops": eng.lib.hvt_data_ops()
+                if hasattr(eng.lib, "hvt_data_ops") else 0,
+            },
+        }
+        try:
+            put_bytes(kv, f"/kv/ssk/{phase}/{rank}",
+                      json.dumps(rec).encode(), timeout=5, retries=2)
+        except Exception:
+            pass
+        progress({"phase_done": phase, "round": round_,
+                  "size": size, "t": time.monotonic()})
+        trace(f"phase {phase} done (aborts={rec['engine']['aborts']})")
+        return rec
+
+    hot_host = spec["hot_host"]
+    ph = spec["phases"]
+
+    def hot(n):
+        return n * spec["hot_factor"] if host == hot_host else n
+
+    def hot_elems(n):
+        return spec["hot_elems"] if host == hot_host else n
+
+    t_kill_seen = None
+    recovered_round = None
+    try:
+        serve_phase("warm", ph["warm"], ph["warm"],
+                    spec["row_elems"], spec["col_elems"])
+        serve_phase("baseline", ph["baseline"], ph["baseline"],
+                    spec["row_elems"], spec["col_elems"])
+        serve_phase("fire", hot(ph["fire"]), ph["fire"],
+                    hot_elems(spec["row_elems"]), spec["col_elems"])
+        if ph.get("storm"):
+            serve_phase("storm", hot(ph["storm"]), ph["storm"],
+                        hot_elems(spec["row_elems"]), spec["col_elems"])
+        if spec.get("kill"):
+            # endure: keep serving until the driver kills a host and
+            # the containment path fires; bounded by a step count so a
+            # missed kill fails loudly instead of wedging
+            killed = False
+            try:
+                serve_phase("endure", ph["endure"], ph["endure"],
+                            spec["row_elems"], spec["col_elems"])
+            except RuntimeError as e:
+                killed = True
+                trace(f"failure during endure: {e}")
+                t_kill_seen = time.monotonic()
+                erun._report_failure(round_, e)
+                erun._report_state("READY", round_)
+                eng.shutdown()
+                round_ = erun._sync_slot_from_rendezvous(round_)
+                rank = int(os.environ["HVT_PROCESS_ID"])
+                size = int(os.environ["HVT_NUM_PROCESSES"])
+                world = get_json(kv, "/world", retries=2)
+                init_engine(eng, rank, size, int(world["master_port"]))
+                trace(f"recovered rank={rank}/{size} round={round_}")
+            if not killed:
+                raise RuntimeError(
+                    "endure phase completed without the host kill — "
+                    "the driver never injected it")
+            serve_phase("recovered", ph["recovered"], ph["recovered"],
+                        spec["row_elems"], spec["col_elems"])
+            progress({"phase_done": "recovered", "round": round_,
+                      "size": size,
+                      "recover_sec": (time.monotonic()
+                                      - (t_kill_seen or 0)),
+                      "t": time.monotonic()})
+    finally:
+        stop.set()
+        pusher.close()
+    barrier("fin")
+    eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# driver harness
+# ---------------------------------------------------------------------------
+
+class _Gang:
+    """Worker bookkeeping: the ElasticDriver spawns through here so the
+    harness can SIGKILL a whole host (same shape as
+    elastic_recovery._Gang)."""
+
+    def __init__(self, spec, kv_addr, lane_workers):
+        self.spec = spec
+        self.kv_addr = kv_addr
+        self.lane_workers = lane_workers
+        self.lock = threading.Lock()
+        self.by_host = {}
+        self.rank0_out = None
+        import tempfile
+
+        self.log_dir = tempfile.mkdtemp(prefix="hvt_ssk_logs_")
+
+    def crash_logs(self, limit=3, tail=1500):
+        out = []
+        try:
+            for name in sorted(os.listdir(self.log_dir)):
+                path = os.path.join(self.log_dir, name)
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read().decode(errors="replace")
+                except OSError:
+                    continue
+                if "Traceback" in data or "ERROR" in data:
+                    out.append(f"--- {name} ---\n{data[-tail:]}")
+                if len(out) >= limit:
+                    break
+        except OSError:
+            pass
+        return "\n".join(out)
+
+    def spawn(self, slot_info):
+        host = slot_info.hostname
+        spec = self.spec
+        env = dict(os.environ)
+        env.update({
+            "HVT_SSK_WORKER": "1",
+            "HVT_SSK_SPEC": json.dumps(spec),
+            "HVT_RENDEZVOUS_ADDR": self.kv_addr,
+            "HVT_HOSTNAME": "127.0.0.1",
+            "HVT_SSK_HOST": host,
+            "HVT_LOCAL_PROCESS_ID": str(slot_info.local_rank),
+            # flat_topo (iso arms): every rank its own topology host,
+            # so the hot row lane negotiates a cross-host RING group —
+            # same-host groups take the shm/hierarchical backends,
+            # which are not ConcurrentGroupsSafe and execute inline on
+            # the engine thread in BOTH arms, nulling the pool A/B the
+            # iso pair exists to measure (ROADMAP follow-on 4b)
+            "HVT_TOPO_HOST": (f"{host}.s{slot_info.local_rank}"
+                              if spec.get("flat_topo") else host),
+            "HVT_TELEMETRY_ROLE": ("leader" if slot_info.local_rank == 0
+                                   else "member"),
+            "HVT_KV_RELAY": "1",
+            "HVT_LANE_WORKERS": str(self.lane_workers),
+            "HVT_DEBUGZ_INTERVAL_MS": "1000",
+            "HVT_RELAY_FLUSH_MS": "400",
+            "HVT_KV_TTL_SEC": "600",
+            "HVT_CTRL_TOPOLOGY": "star",
+            "HVT_CONNECT_TIMEOUT": "240",
+            "HVT_LOG_LEVEL": "error",
+            # reconnect budgets sized for BOTH chaos classes at gang
+            # scale: a partition between two 8-rank hosts breaks 64
+            # data links at once, and on a 1-core box the acceptor
+            # sides drain their re-dial herd over whole seconds — the
+            # window must absorb hold + herd. A SIGKILLed peer still
+            # escalates fast: its dials are REFUSED instantly, so the
+            # retry count (not the window) bounds dead-peer detection
+            # to a few seconds of backoff.
+            "HVT_LINK_RETRIES": "12",
+            "HVT_LINK_RETRY_WINDOW_MS": "10000",
+            "HVT_OP_TIMEOUT_MS": "60000",
+            "PYTHONUNBUFFERED": "1",
+        })
+        faults = spec.get("faults") or {}
+        fr = faults.get("flaky_rank")
+        if fr is not None and slot_info.rank == int(fr):
+            env["HVT_FAULT_INJECT"] = (
+                f"flaky_conn:rank={fr}:count={faults['flaky_count']}"
+                f":after_ops={faults['flaky_after_ops']}")
+        part = faults.get("partition")
+        if part and host in part["hosts"]:
+            env["HVT_FAULT_INJECT"] = (
+                f"partition:hosts={part['a']}|{part['b']}"
+                f":ms={part['ms']}:after_ops={part['after_ops']}")
+        first = slot_info.rank == 0
+        log = None
+        if self.log_dir and not first:
+            log = open(os.path.join(
+                self.log_dir,
+                f"{host}_{slot_info.local_rank}.log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            cwd=REPO,
+            stdout=subprocess.PIPE if first else
+            (log or subprocess.DEVNULL),
+            stderr=subprocess.STDOUT if first else
+            (log or subprocess.DEVNULL),
+            text=first)
+        if log is not None:
+            log.close()
+        with self.lock:
+            self.by_host.setdefault(host, []).append(proc)
+            if first:
+                self.rank0_out = proc
+        return proc.wait()
+
+    def kill_host(self, host):
+        with self.lock:
+            procs = list(self.by_host.get(host, []))
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGKILL)
+                except OSError:
+                    pass
+
+    def kill_all(self):
+        with self.lock:
+            procs = [p for ps in self.by_host.values() for p in ps]
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def _agg_phase(records: list) -> dict:
+    """Fold per-rank phase records into per-lane rows with the
+    member-identity verdicts."""
+    lanes = {}
+    engine = {"aborts": 0, "pool_tasks": 0, "reconnects": 0,
+              "lane_workers": 0}
+    for rec in records:
+        engine["aborts"] += rec["engine"]["aborts"]
+        engine["pool_tasks"] += rec["engine"]["pool_tasks"]
+        engine["reconnects"] += rec["engine"]["reconnects"]
+        engine["lane_workers"] = max(engine["lane_workers"],
+                                     rec["engine"]["lane_workers"])
+        for tenant, lr in rec["lanes"].items():
+            key = f"{tenant}:{min(lr['members'])}"
+            row = lanes.setdefault(key, {
+                "tenant": tenant, "members": lr["members"],
+                "member_rows": [], "p99_ms_max": 0.0,
+                "p50_samples": [], "exec_us_samples": [],
+                "hol_us_samples": [], "ov_samples": []})
+            row["member_rows"].append(
+                (lr["admitted"], lr["shed"], lr["batches"],
+                 lr["dec_crc"]))
+            row["p99_ms_max"] = max(row["p99_ms_max"], lr["p99_ms"])
+            row["p50_samples"].append(lr["p50_ms"])
+            if not lr.get("slot_collision") and lr.get("exec_count"):
+                row["exec_us_samples"].append(lr["exec_us_mean"])
+            if not lr.get("slot_collision") and lr.get("hol_count"):
+                row["hol_us_samples"].append(lr["hol_us_mean"])
+            if not lr.get("slot_collision") and lr.get("ov_starts"):
+                row["ov_samples"].append(
+                    (lr["ov_starts"], lr.get("ov_overlapped", 0)))
+            row["admitted"] = lr["admitted"]
+            row["shed"] = lr["shed"]
+            row["batches"] = lr["batches"]
+    for key, row in lanes.items():
+        uniq = set(row.pop("member_rows"))
+        row["member_identical"] = len(uniq) == 1
+        samples = row.pop("exec_us_samples")
+        row["exec_us_mean"] = (round(sum(samples) / len(samples), 2)
+                               if samples else None)
+        row["exec_members"] = len(samples)
+        # the lane's head-of-line wait carries the isolation signal on
+        # its hot-host member only — keep the MAX over members (the
+        # blocked member), not the mean: the idle members' ~0 waits
+        # would dilute a per-host effect by the host count
+        hol = row.pop("hol_us_samples")
+        row["hol_us_max"] = round(max(hol), 2) if hol else None
+        row["hol_us_mean"] = (round(sum(hol) / len(hol), 2)
+                              if hol else None)
+        row["hol_members"] = len(hol)
+        # overlapped-exec-starts fraction, worst (= most overlapped)
+        # member: the member sharing a rank with the hot tenant is the
+        # one whose executions the pool decouples — the others' spans
+        # barely intersect and would dilute a lane-sum
+        ovs = row.pop("ov_samples")
+        row["ov_frac_max"] = (round(max(o / s for s, o in ovs), 4)
+                              if ovs else None)
+        row["ov_starts"] = sum(s for s, _ in ovs)
+        row["ov_overlapped"] = sum(o for _, o in ovs)
+        p50s = sorted(row.pop("p50_samples"))
+        row["p50_ms_med"] = (round(p50s[len(p50s) // 2], 4)
+                             if p50s else None)
+    return {"lanes": lanes, "engine": engine, "ranks": len(records)}
+
+
+def run_arm(arm, spec, lane_workers, timeout=1200):
+    """One full soak for one arm; returns the arm record. The
+    ElasticDriver, rendezvous server, /statusz plane and autoscaler are
+    REAL — only the serving workers are featherweight."""
+    _stub_package()
+    from benchmarks.ctrl_plane_scaling import _next_port
+    from horovod_tpu.runner.elastic.autoscaler import (Autoscaler,
+                                                       AutoscalePolicy)
+    from horovod_tpu.runner.elastic.discovery import FixedHostDiscovery
+    from horovod_tpu.runner.elastic.driver import ElasticDriver
+    from horovod_tpu.runner.elastic.settings import ElasticSettings
+    from horovod_tpu.runner.http_server import RendezvousServer
+
+    np_, hosts = spec["np"], spec["hosts"]
+    per_host = spec["per_host"]
+    kill_host = f"h{hosts - 1}"
+    # push_stale must mean "dead", not "descheduled": on a 1-core box
+    # running np_ worker processes, a LIVE worker can easily miss a few
+    # 1 s push slots under load — 12 intervals keeps the rule a kill
+    # detector while the clean-gang phases stay alert-free
+    os.environ["HVT_HEALTH_STALE_INTERVALS"] = "12"
+    rendezvous = RendezvousServer()
+    rendezvous.master_port_fn = lambda slots, rnd: _next_port()
+    kv_port = rendezvous.start(0)
+    kv_addr = f"127.0.0.1:{kv_port}"
+    gang = _Gang(spec, kv_addr, lane_workers)
+    settings = ElasticSettings(
+        min_np=np_ - per_host, max_np=np_, elastic_timeout=240.0,
+        reset_limit=6, discovery_interval=0.25)
+    driver = ElasticDriver(
+        rendezvous,
+        FixedHostDiscovery({f"h{i}": per_host for i in range(hosts)}),
+        settings, create_worker_fn=gang.spawn)
+    scaler = Autoscaler(driver, rendezvous,
+                        policy=AutoscalePolicy(interval_sec=0.5))
+    # failure reports live in a scope the recovery round's store reset
+    # clears — a polled step() can miss the window, so chain the
+    # driver's put hook and step the policy the moment a report lands
+    # (the driver's own handler still runs first)
+    driver_hook = rendezvous._on_put
+
+    def _hook(scope, key, value):
+        if driver_hook is not None:
+            driver_hook(scope, key, value)
+        if scope == "failure":
+            try:
+                scaler.step()
+            except Exception:
+                pass
+
+    rendezvous.set_put_hook(_hook)
+
+    result = {"arm": arm, "np": np_, "hosts": hosts,
+              "lane_workers": lane_workers, "phases": {},
+              "alerts_by_phase": {}, "killed_host": None}
+    phase_names = ["warm", "baseline", "fire"]
+    if spec["phases"].get("storm"):
+        phase_names.append("storm")
+    deadline = time.monotonic() + timeout
+    harvested = {}
+
+    def prog():
+        raw = rendezvous.store.get("progress", "0")
+        try:
+            return json.loads(raw) if raw else {}
+        except ValueError:
+            return {}
+
+    # building /statusz parses every pushed blob; at 64 ranks that is
+    # tens of ms of GIL per build, and this process also serves the
+    # gang's whole KV/rendezvous plane — polling it every loop
+    # iteration starves the HTTP threads and wedges the gang it is
+    # supposed to observe (found live at 64 ranks). Throttle to the
+    # push cadence; the health engine self-gates ingestion anyway.
+    _last_poll = [0.0]
+    _last_scale = [0.0]
+
+    def scaler_tick():
+        # fast enough to catch failure reports before the recovery
+        # round's store reset clears the failure scope, slow enough not
+        # to hog the GIL the HTTP plane needs
+        now = time.monotonic()
+        if now - _last_scale[0] < 0.3:
+            return
+        _last_scale[0] = now
+        try:
+            scaler.step()
+        except Exception:
+            pass
+
+    def poll_statusz(phase):
+        now = time.monotonic()
+        if now - _last_poll[0] < 0.8:
+            return
+        _last_poll[0] = now
+        try:
+            snap = rendezvous.statusz_snapshot()
+        except Exception:
+            return
+        bucket = result["alerts_by_phase"].setdefault(phase, {})
+        for a in snap.get("alerts") or ():
+            bucket.setdefault(a["rule"], set()).add(a.get("subject"))
+
+    def harvest(phase, expect, wait_sec=30):
+        """Collect every rank's /kv/ssk/<phase>/ record (the relay may
+        deliver a beat after the barrier)."""
+        t_end = time.monotonic() + wait_sec
+        while time.monotonic() < t_end:
+            keys = rendezvous.store.keys("ssk")
+            mine = [k for k in keys if k.startswith(f"{phase}/")]
+            if len(mine) >= expect:
+                break
+            time.sleep(0.2)
+        recs = []
+        for k in rendezvous.store.keys("ssk"):
+            if not k.startswith(f"{phase}/"):
+                continue
+            try:
+                recs.append(json.loads(rendezvous.store.get("ssk", k)))
+            except (ValueError, TypeError):
+                pass
+        if len(recs) < expect:
+            raise RuntimeError(
+                f"{arm}: phase {phase}: {len(recs)}/{expect} records"
+                f"\n{gang.crash_logs()}")
+        harvested[phase] = recs
+        return recs
+
+    try:
+        driver.start(np_)
+        seen = set()
+        # alerts observed before the first phase marker land in "boot":
+        # a 64-rank bring-up is a re-dial herd (listen backlogs
+        # overflow, refused dials retry as reconnects), so the window
+        # between driver.start and the end of `warm` is NOT a
+        # clean-gang observation — only `baseline` is gated alert-free
+        cur_phase = "boot"
+        while True:
+            p = prog()
+            done = p.get("phase_done")
+            poll_statusz(cur_phase)
+            scaler_tick()
+            if done and done not in seen and done in phase_names:
+                # the progress marker is one key — a fast phase's
+                # marker can be overwritten before this loop polls, so
+                # harvest every phase up to `done` (the records persist
+                # in the ssk scope until the next round reset)
+                idx = phase_names.index(done)
+                for p_name in phase_names[:idx + 1]:
+                    if p_name not in seen:
+                        seen.add(p_name)
+                        harvest(p_name, np_)
+                cur_phase = (phase_names[idx + 1]
+                             if idx + 1 < len(phase_names) else done)
+            if phase_names[-1] in seen:
+                break
+            if time.monotonic() > deadline or (driver.finished()
+                                               and driver.error):
+                raise RuntimeError(
+                    f"{arm}: phases stalled at {sorted(seen)} "
+                    f"(progress={p}, err={driver.error})"
+                    f"\n{gang.crash_logs()}")
+            time.sleep(0.15)
+
+        if spec.get("kill"):
+            # the workers are now in `endure`; kill the last host and
+            # watch the elastic plane re-shard mid-traffic
+            time.sleep(spec.get("kill_delay_sec", 1.0))
+            t_kill = time.monotonic()
+            gang.kill_host(kill_host)
+            result["killed_host"] = kill_host
+            cur_phase = "endure"
+            while True:
+                p = prog()
+                poll_statusz(cur_phase)
+                scaler_tick()
+                if p.get("phase_done") == "recovered":
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{arm}: gang never recovered (progress={p})"
+                        f"\n{gang.crash_logs()}")
+                if driver.finished() and driver.error:
+                    raise RuntimeError(
+                        f"{arm}: driver failed mid-recovery: "
+                        f"{driver.error}\n{gang.crash_logs()}")
+                time.sleep(0.15)
+            result["time_to_recovered_sec"] = round(
+                time.monotonic() - t_kill, 2)
+            result["world_after"] = int(prog().get("size") or 0)
+            harvest("recovered", np_ - per_host)
+            cur_phase = "recovered"
+            # the killed ranks' last pushes age into push_stale after
+            # HVT_HEALTH_STALE_INTERVALS x 1 s — keep watching until
+            # the alert lands (bounded)
+            t_stale = t_kill + 45
+            while time.monotonic() < min(t_stale, deadline):
+                poll_statusz(cur_phase)
+                rules = result["alerts_by_phase"].get(cur_phase) or {}
+                all_rules = {r for b in
+                             result["alerts_by_phase"].values()
+                             for r in b}
+                if "push_stale" in all_rules:
+                    break
+                del rules
+                time.sleep(0.5)
+
+        # let every worker publish + exit
+        t_end = time.monotonic() + 120
+        while not driver.finished() and time.monotonic() < t_end:
+            poll_statusz(cur_phase)
+            time.sleep(0.2)
+        results = driver.get_results() or {}
+        # killed-host workers legitimately die by SIGKILL; every
+        # surviving worker must exit 0
+        bad = {r: rc for r, rc in results.items()
+               if rc not in (0, -signal.SIGKILL)}
+        if bad:
+            raise RuntimeError(f"{arm}: nonzero worker exits {bad}"
+                               f"\n{gang.crash_logs()}")
+        for phase, recs in harvested.items():
+            result["phases"][phase] = _agg_phase(recs)
+        result["alerts_by_phase"] = {
+            ph: {rule: sorted(x for x in subs if x is not None)
+                 for rule, subs in rules.items()}
+            for ph, rules in result["alerts_by_phase"].items()}
+        result["autoscaler_decisions"] = sorted(
+            {a for _, a, _ in scaler.decisions})
+        return result
+    finally:
+        scaler.stop()
+        gang.kill_all()
+        try:
+            driver.stop()
+        except Exception:
+            pass
+        rendezvous.stop()
+
+
+# ---------------------------------------------------------------------------
+# capture / claims / check
+# ---------------------------------------------------------------------------
+
+def _spec(smoke: bool) -> dict:
+    """The chaos-soak shape: the composition gate (clean phases, hot +
+    flaky fire, partition storm, host kill, re-shard). The soak arm
+    runs a MODERATE lane-worker count in capture: 64 ranks on the
+    1-core harness box are already fully oversubscribed, so the pool's
+    benefit cannot show there (that is the iso pair's job) while its
+    extra threads would only slow the box — see BENCH_NOTES r15."""
+    if smoke:
+        return {
+            "np": 8, "hosts": 4, "per_host": 2, "window": 8,
+            "batch": 4, "burst": 4, "admission_ms": 2000.0,
+            "cycle_ms": 2, "push_sec": 1.0, "lane_workers": 4,
+            "row_elems": 256, "col_elems": 256,
+            "hot_elems": 16384, "hot_factor": 4, "hot_host": "h1",
+            "phases": {"warm": 8, "baseline": 48, "fire": 48,
+                       "storm": 32, "endure": 4000, "recovered": 24},
+            "kill": True, "kill_delay_sec": 1.0,
+            "faults": {
+                "flaky_rank": 3, "flaky_count": 2,
+                "flaky_after_ops": 0,  # filled by capture()
+                "partition": {"hosts": ["h2", "h3"], "a": "h2",
+                              "b": "h3", "ms": 600,
+                              "after_ops": 0},
+            },
+        }
+    return {
+        "np": 64, "hosts": 8, "per_host": 8, "window": 8,
+        "batch": 4, "burst": 4, "admission_ms": 4000.0,
+        "cycle_ms": 2, "push_sec": 1.0, "lane_workers": 2,
+        "row_elems": 256, "col_elems": 256,
+        "hot_elems": 32768, "hot_factor": 4, "hot_host": "h1",
+        "phases": {"warm": 8, "baseline": 64, "fire": 64,
+                   "storm": 32, "endure": 4000, "recovered": 24},
+        "kill": True, "kill_delay_sec": 1.5,
+        "faults": {
+            "flaky_rank": 9, "flaky_count": 2,
+            "flaky_after_ops": 0,
+            "partition": {"hosts": ["h2", "h3"], "a": "h2",
+                          "b": "h3", "ms": 600, "after_ops": 0},
+        },
+    }
+
+
+def _iso_spec(smoke: bool) -> dict:
+    """The lane-isolation A/B shape: CLEAN (no chaos, no kill), small
+    enough that the 1-core harness box has actual concurrency headroom
+    for the pool to exploit — the in-rank head-of-line effect is an
+    engine-THREAD property, not a gang-size property, so it is
+    measured where the hardware can express it."""
+    return {
+        "np": 8 if smoke else 16, "hosts": 4, "per_host": 2 if smoke
+        else 4, "window": 8, "batch": 4, "burst": 4,
+        "admission_ms": 4000.0, "cycle_ms": 2, "push_sec": 1.0,
+        "row_elems": 256, "col_elems": 256,
+        # hot tenant = FEW, HUGE requests (hot_factor 1, 4 MB
+        # payloads): the in-rank blocking the pool removes scales with
+        # the hot op's DURATION, while the python harness's own
+        # artifacts (the hot serving thread's GIL share delays the
+        # SAME rank's other-tenant submits, identically in both arms)
+        # scale with the request COUNT — probed span-level with the
+        # flight recorder, BENCH_NOTES r15. Deep hot_window/hot_burst
+        # keep several fused ops outstanding so the nopool engine
+        # thread is continuously busy
+        "hot_elems": 1048576, "hot_factor": 1,
+        "hot_host": "h1", "hot_window": 24, "hot_burst": 12,
+        "phases": {"warm": 16, "baseline": 64, "fire": 64, "storm": 0},
+        "kill": False, "faults": {}, "flat_topo": True,
+    }
+
+
+def _ops_before(spec, phase: str) -> int:
+    """Data-plane ops a NON-hot, non-killed rank has executed before
+    `phase`'s traffic starts: per completed phase, each lane
+    contributes requests/batch fused collectives plus the pre- and
+    post-phase barrier allreduces; `phase`'s own pre-barrier has also
+    run by the time its traffic flows."""
+    order = ["warm", "baseline", "fire", "storm"]
+    ops = 0
+    for name in order[:order.index(phase)]:
+        n = spec["phases"][name]
+        ops += 2 * (n // spec["batch"])  # row + col lanes
+        ops += 2  # pre- + post-phase barriers
+    return ops + 1  # the current phase's pre-barrier
+
+
+def _fill_fault_ops(spec):
+    """Arm the transient faults by op count so they fire INSIDE their
+    phase: flaky_conn ~25% into `fire` (the flaky rank is on the hot
+    host, whose row lane runs hot_factor x requests — but its op
+    counter is also fed by the same inflated stream, so anchoring at
+    the phase floor plus a small margin keeps the cuts inside fire),
+    partition ~30% into `storm` (its hosts are non-hot, so the plain
+    per-rank count applies)."""
+    f = spec["faults"]
+    fire_slots = spec["phases"]["fire"] // spec["batch"]
+    f["flaky_after_ops"] = _ops_before(spec, "fire") + \
+        max(2, fire_slots // 4)
+    storm_slots = spec["phases"]["storm"] // spec["batch"]
+    f["partition"]["after_ops"] = _ops_before(spec, "storm") + \
+        max(2, storm_slots // 3)
+
+
+def _col_ratio(arm_rec, spec, metric="exec_us_mean"):
+    """Worst observer column lane's fire/baseline ratio of `metric` —
+    the idle-lane isolation number. The gated metric is the engine's
+    in-rank data-plane exec latency (`exec_us_mean`): it measures
+    exactly the head-of-line blocking the lane pool removes and is
+    stable on an oversubscribed 1-core harness box, where end-to-end
+    p99s at ms scale are scheduler-quantum noise (reported as
+    `p99_ms_max` per lane but not gated — BENCH_NOTES r15). Column
+    lanes containing the flaky rank are excluded: their spikes are the
+    injected fault, not the hot neighbor."""
+    flaky = (spec.get("faults") or {}).get("flaky_rank")
+    base = arm_rec["phases"].get("baseline", {}).get("lanes", {})
+    fire = arm_rec["phases"].get("fire", {}).get("lanes", {})
+    ratios = []
+    for key, row in fire.items():
+        if row["tenant"] != "col":
+            continue
+        if flaky is not None and int(flaky) in row["members"]:
+            continue
+        b = base.get(key)
+        if not b or not b.get(metric) or not row.get(metric):
+            continue
+        ratios.append(row[metric] / b[metric])
+    # mean over the observer lanes: each lane's ratio carries shared-box
+    # jitter, and a worst-of gate would gate on that jitter instead of
+    # the systematic head-of-line effect
+    return round(sum(ratios) / len(ratios), 3) if ratios else 0.0
+
+
+def _col_hol_us(arm_rec, spec, phase="fire"):
+    """Mean over observer column lanes of each lane's WORST-member
+    head-of-line wait (µs) in `phase`. The worst member is the one
+    sharing a rank with the hot row tenant — the rank where the
+    single-thread engine serializes the idle lane behind the hot one.
+    Column lanes containing the flaky rank are excluded (their waits
+    are the injected fault)."""
+    flaky = (spec.get("faults") or {}).get("flaky_rank")
+    lanes = arm_rec["phases"].get(phase, {}).get("lanes", {})
+    vals = []
+    for row in lanes.values():
+        if row["tenant"] != "col":
+            continue
+        if flaky is not None and int(flaky) in row["members"]:
+            continue
+        if row.get("hol_us_max"):
+            vals.append(row["hol_us_max"])
+    return round(sum(vals) / len(vals), 2) if vals else 0.0
+
+
+def _col_ov_frac(arm_rec, spec, phase="fire"):
+    """Mean over observer column lanes of each lane's most-overlapped
+    member's exec-start overlap fraction in `phase`: the share of the
+    column lane's executions that STARTED while the crossing row
+    lane's execution span was still open on the same rank. The gated
+    isolation metric — pure event ordering. A single-thread engine
+    (HVT_LANE_WORKERS=0) can never hold two exec spans open, so its
+    fraction is structurally 0; the pool arm's is direct proof the
+    idle lane executes DURING the saturated neighbor's executions
+    instead of queueing behind them. Column lanes containing the flaky
+    rank are excluded (their schedule is the injected fault's)."""
+    flaky = (spec.get("faults") or {}).get("flaky_rank")
+    lanes = arm_rec["phases"].get(phase, {}).get("lanes", {})
+    vals = []
+    for row in lanes.values():
+        if row["tenant"] != "col":
+            continue
+        if flaky is not None and int(flaky) in row["members"]:
+            continue
+        if row.get("ov_frac_max") is not None:
+            vals.append(row["ov_frac_max"])
+    return round(sum(vals) / len(vals), 4) if vals else 0.0
+
+
+def _hot_row_exec_us(arm_rec, spec, phase="fire"):
+    """The hot host's row lane data-plane exec mean (µs) in `phase` —
+    the natural scale of the head-of-line blocking: an idle lane
+    serialized behind the hot tenant waits a large fraction of this;
+    an isolated one, a small fraction. Normalizing by it makes the
+    HOL gates dimensionless (box-speed independent)."""
+    hot_i = int(str(spec["hot_host"])[1:])
+    key = f"row:{hot_i * spec['per_host']}"
+    row = arm_rec["phases"].get(phase, {}).get("lanes", {}).get(key)
+    return (row or {}).get("exec_us_mean") or 0.0
+
+
+def capture(out_path, smoke=False):
+    spec = _spec(smoke)
+    _fill_fault_ops(spec)
+    iso_spec = _iso_spec(smoke)
+    record = {"schema": SCHEMA, "mode": "smoke" if smoke else "capture",
+              "created_unix": int(time.time()),
+              "config": spec, "iso_config": iso_spec,
+              "arms": {}, "claims": {}}
+
+    def arm(name, arm_spec, workers, timeout):
+        t0 = time.monotonic()
+        rec = run_arm(name, arm_spec, workers, timeout=timeout)
+        rec["total_sec"] = round(time.monotonic() - t0, 1)
+        record["arms"][name] = rec
+        print(f"{name} arm done in {rec['total_sec']}s", flush=True)
+        return rec
+
+    # the lane-isolation A/B pair: clean, small, pool on vs off
+    iso_nopool = arm("iso_nopool", iso_spec, 0, 900)
+    iso_pool = arm("iso_pool", iso_spec, 4, 900)
+    # the chaos soak: full storyline at gang scale
+    soak = arm("soak", spec, spec.get("lane_workers", 2),
+               900 if smoke else 1800)
+
+    ratio_pool = _col_ratio(iso_pool, iso_spec)
+    ratio_nopool = _col_ratio(iso_nopool, iso_spec)
+    # the A/B bound rides the per-lane MEDIAN latency: the hot
+    # neighbor shifts every idle-lane request's latency (not just the
+    # tail), so p50 carries the head-of-line signal with far less
+    # scheduler noise than p99 on the shared harness box — probed at
+    # 1.63-1.89x across repeated runs vs 1.0-2.6x for exec-based and
+    # 1.0-1.24x for p99-based (BENCH_NOTES r15)
+    p50_pool = _col_ratio(iso_pool, iso_spec, metric="p50_ms_med")
+    p50_nopool = _col_ratio(iso_nopool, iso_spec, metric="p50_ms_med")
+    # `baseline` is the gated clean-gang observation; `boot` (driver
+    # start → end of warm, the 64-link dial herd) and `warm` roll into
+    # the ungated boot bucket — see run_arm's cur_phase comment
+    baseline_alerts = sorted(
+        (soak["alerts_by_phase"].get("baseline") or {}).keys())
+    observed = sorted({r for rules in soak["alerts_by_phase"].values()
+                       for r in rules})
+    per_host = spec["per_host"]
+    killed_ranks = set(range(spec["np"] - per_host, spec["np"]))
+    stale_subjects = {
+        s for ph_rules in soak["alerts_by_phase"].values()
+        for s in ph_rules.get("push_stale", ())}
+    ident = all(
+        row["member_identical"]
+        for arm_rec in record["arms"].values()
+        for phase in arm_rec["phases"].values()
+        for row in phase["lanes"].values())
+    # transient-fault abort gate: cumulative engine aborts at the end
+    # of the LAST pre-kill phase must be zero on every rank
+    last_transient = "storm" if spec["phases"].get("storm") else "fire"
+    soak_tr = soak["phases"][last_transient]["engine"]
+    batches_ok = all(
+        0 < row["batches"] <= row["admitted"]
+        and row["admitted"] >= spec["batch"] * (row["batches"] - 1)
+        for phase in soak["phases"].values()
+        for row in phase["lanes"].values() if row["admitted"])
+    # lane-isolation A/B, gated on exec-span overlap: the fraction of
+    # the idle column lane's executions that START while the hot row
+    # lane's execution is still mid-flight on the shared rank. Pure
+    # event ordering, so the oversubscribed 1-core harness box cannot
+    # fake OR hide it: without the pool the engine thread can never
+    # hold two exec spans open (the idle op literally queues behind
+    # the hot one → fraction structurally 0); with the pool the idle
+    # lane's worker starts it mid-span (fraction ~ the hot lane's duty
+    # cycle). Wall-clock exec/hol/p50/p99 ratios stay recorded but
+    # ungated — on this box they are scheduler noise in BOTH
+    # directions (BENCH_NOTES r15).
+    ov_pool = _col_ov_frac(iso_pool, iso_spec)
+    ov_nopool = _col_ov_frac(iso_nopool, iso_spec)
+    hol_pool = _col_hol_us(iso_pool, iso_spec)
+    hol_nopool = _col_hol_us(iso_nopool, iso_spec)
+    hot_exec_pool = _hot_row_exec_us(iso_pool, iso_spec)
+    hot_exec_nopool = _hot_row_exec_us(iso_nopool, iso_spec)
+    record["claims"] = {
+        "idle_col_overlap_frac_pool": ov_pool,
+        "idle_col_overlap_frac_nopool": ov_nopool,
+        "idle_col_hol_us_fire_pool": hol_pool,
+        "idle_col_hol_us_fire_nopool": hol_nopool,
+        "nopool_hol_over_pool_hol": round(
+            hol_nopool / max(hol_pool, 1e-9), 2),
+        "hot_row_exec_us_fire_pool": hot_exec_pool,
+        "hot_row_exec_us_fire_nopool": hot_exec_nopool,
+        # report-only wall-clock ratios (see the gate comment above)
+        "idle_col_exec_fire_over_baseline_pool": ratio_pool,
+        "idle_col_exec_fire_over_baseline_nopool": ratio_nopool,
+        "idle_col_p50_fire_over_baseline_pool": p50_pool,
+        "idle_col_p50_fire_over_baseline_nopool": p50_nopool,
+        "nopool_over_pool": round(
+            p50_nopool / max(p50_pool, 1e-9), 2),
+        # end-to-end p99 ratios: reported, not gated (ms-scale
+        # scheduler noise on the 1-core harness box — BENCH_NOTES r15)
+        "idle_col_p99_fire_over_baseline_pool": _col_ratio(
+            iso_pool, iso_spec, metric="p99_ms_max"),
+        "idle_col_p99_fire_over_baseline_nopool": _col_ratio(
+            iso_nopool, iso_spec, metric="p99_ms_max"),
+        "soak_col_exec_fire_over_baseline": _col_ratio(soak, spec),
+        "zero_aborts_transient": soak_tr["aborts"] == 0,
+        "pool_engaged_tasks": soak_tr["pool_tasks"],
+        "iso_pool_engaged_tasks":
+            iso_pool["phases"]["fire"]["engine"]["pool_tasks"],
+        "member_identical_decisions": ident,
+        "batching_coalesced": batches_ok,
+        "baseline_alert_rules": baseline_alerts,
+        "observed_alert_rules": observed,
+        "push_stale_subjects_killed_only": all(
+            s in {f"rank {r}" for r in killed_ranks}
+            for s in stale_subjects),
+        "reconnect_storm_seen": any(
+            "reconnect_storm" in rules
+            for rules in soak["alerts_by_phase"].values()),
+        "push_stale_seen": bool(stale_subjects),
+        "autoscaler_shed": "shed" in soak.get("autoscaler_decisions",
+                                              ()),
+        "reshard_world": soak.get("world_after"),
+        "reshard_expected": spec["np"] - per_host,
+        "time_to_recovered_sec": soak.get("time_to_recovered_sec"),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+        print(f"wrote {out_path}")
+    print("claims: " + json.dumps(record["claims"], sort_keys=True))
+    rc = check_record(record)
+    if rc:
+        print("serving_soak: CAPTURE FAILED ITS OWN GATES",
+              file=sys.stderr)
+    return record, rc
+
+
+def check_record(rec: dict) -> int:
+    errs = []
+
+    def need(cond, msg):
+        if not cond:
+            errs.append(msg)
+
+    need(rec.get("schema") == SCHEMA, f"schema != {SCHEMA}")
+    mode = rec.get("mode")
+    need(mode in ("smoke", "capture"), f"bad mode {mode!r}")
+    arms = rec.get("arms") or {}
+    need({"iso_pool", "iso_nopool", "soak"} <= set(arms),
+         "missing arms")
+    claims = rec.get("claims") or {}
+    for arm_name, arm_rec in arms.items():
+        for phase in ("warm", "baseline", "fire"):
+            need(phase in (arm_rec.get("phases") or {}),
+                 f"{arm_name}: phase {phase} missing")
+        for pname, ph in (arm_rec.get("phases") or {}).items():
+            need(ph.get("lanes"), f"{arm_name}/{pname}: no lanes")
+    if errs:
+        for e in errs:
+            print(f"serving_soak --check: {e}", file=sys.stderr)
+        return 1
+    # mode-aware gates: the committed capture pins the ISSUE numbers;
+    # the CI smoke runs the same machinery at a smaller shape with
+    # looser bounds — the CORRECTNESS gates stay strict in both modes.
+    # The isolation pair is gated on exec-span overlap (see the claims
+    # comment in capture()): with the pool, a meaningful share of the
+    # idle column lane's executions must START while the hot row
+    # lane's execution span is still open on the shared rank; without
+    # the pool that is structurally impossible (one engine thread, one
+    # span at a time), so the nopool fraction must be exactly 0.
+    # Wall-clock exec/hol/p50/p99 ratios are recorded report-only.
+    ov_pool_gate = 0.3 if mode == "capture" else 0.15
+    hol_ab_gate = 4.0 if mode == "capture" else 2.0
+    need(claims.get("idle_col_overlap_frac_pool", 0) >= ov_pool_gate,
+         f"pool arm: only {claims.get('idle_col_overlap_frac_pool')} "
+         f"of idle-lane exec starts overlapped the hot lane's exec "
+         f"span (< {ov_pool_gate}) — the pool is not decoupling the "
+         f"lanes")
+    need(claims.get("idle_col_overlap_frac_nopool", 1) == 0.0,
+         f"nopool arm: idle-lane exec starts overlapped the hot "
+         f"lane's span ({claims.get('idle_col_overlap_frac_nopool')})"
+         f" — impossible for a single-thread engine; the A/B arms are "
+         f"mislabeled or the pool env leaked")
+    # the pinned latency-ratio bound: the idle lane's submit →
+    # engine-pickup wait on the blocked member, nopool over pool. Both
+    # ends stamp on the same rank, so this survives the shared harness
+    # box far better than end-to-end percentiles (still recorded
+    # report-only below)
+    need(claims.get("nopool_hol_over_pool_hol", 0) >= hol_ab_gate,
+         f"pool A/B: nopool/pool idle-lane head-of-line wait "
+         f"{claims.get('nopool_hol_over_pool_hol')} < {hol_ab_gate}")
+    need(claims.get("zero_aborts_transient") is True,
+         "engine aborts under transient chaos")
+    need(claims.get("pool_engaged_tasks", 0) > 0,
+         "lane pool executed no tasks in the soak arm")
+    need(claims.get("iso_pool_engaged_tasks", 0) > 0,
+         "lane pool executed no tasks in the iso_pool arm")
+    need(claims.get("member_identical_decisions") is True,
+         "replica members disagreed on (admit, shed, batch) decisions")
+    need(claims.get("batching_coalesced") is True,
+         "request batching did not coalesce")
+    need(claims.get("baseline_alert_rules") == [],
+         f"clean-gang phases raised alerts: "
+         f"{claims.get('baseline_alert_rules')}")
+    need(set(claims.get("observed_alert_rules") or ())
+         <= ALLOWED_ALERTS,
+         f"unexpected alert rules: {claims.get('observed_alert_rules')}")
+    need(claims.get("reconnect_storm_seen") is True,
+         "flaky_conn chaos never surfaced as a reconnect_storm alert")
+    need(claims.get("push_stale_seen") is True,
+         "the host kill never surfaced as push_stale alerts")
+    need(claims.get("push_stale_subjects_killed_only") is True,
+         "push_stale alerts named ranks outside the killed host")
+    need(claims.get("autoscaler_shed") is True,
+         "the autoscaler never recorded the shed decision")
+    need(claims.get("reshard_world")
+         == claims.get("reshard_expected"),
+         f"re-shard world {claims.get('reshard_world')} != expected "
+         f"{claims.get('reshard_expected')}")
+    for e in errs:
+        print(f"serving_soak --check: {e}", file=sys.stderr)
+    if not errs:
+        print(f"serving_soak --check: OK (mode={mode}, claims: "
+              + json.dumps(claims, sort_keys=True) + ")")
+    return 1 if errs else 0
+
+
+def check(path: str) -> int:
+    with open(path) as f:
+        rec = json.load(f)
+    return check_record(rec)
+
+
+def main():
+    if os.environ.get("HVT_SSK_WORKER"):
+        _worker()
+        return 0
+    args = sys.argv[1:]
+
+    def argval(flag, dflt):
+        if flag not in args:
+            return dflt
+        i = args.index(flag) + 1
+        if i >= len(args):
+            sys.exit(f"serving_soak: {flag} requires a value")
+        return args[i]
+
+    if "--check" in args:
+        return check(argval("--check", ""))
+    out = argval("--out", "" if "--smoke" in args
+                 else os.path.join(REPO, "benchmarks",
+                                   "r15_serving_soak.json"))
+    _, rc = capture(out, smoke="--smoke" in args)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,6 +38,12 @@
 #                      # gang suite): seeded randomized transient
 #                      # faults over a 4-proc gang, asserting
 #                      # bit-identical results and zero aborts
+#   ./ci.sh --servesoak # build + the serving gang suite (batching
+#                      # determinism, lane-pool parity) + an 8-rank
+#                      # mixed-tenant serving soak smoke (chaos + host
+#                      # kill + autoscaler re-shard over MiniEngine
+#                      # workers) + schema/claim --check of the fresh
+#                      # AND committed benchmarks/r15_serving_soak.json
 #   ./ci.sh --elastic  # build + the checkpointless-recovery gangs
 #                      # (kill-a-rank peer rebuild + restart-from-
 #                      # checkpoint baseline over a REAL ElasticDriver)
@@ -76,6 +82,7 @@ CODEC=0
 SOAK=0
 OBS=0
 ELASTIC=0
+SERVESOAK=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
@@ -87,6 +94,7 @@ ELASTIC=0
 [[ "${1:-}" == "--soak" ]] && SOAK=1
 [[ "${1:-}" == "--obs" ]] && OBS=1
 [[ "${1:-}" == "--elastic" ]] && ELASTIC=1
+[[ "${1:-}" == "--servesoak" ]] && SERVESOAK=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -182,6 +190,26 @@ if [[ "$PERFGATE" == "1" || "$REBASELINE" == "1" ]]; then
   python -m horovod_tpu.tools.hvt_analyze --diff \
     benchmarks/perf_baseline.json "$ART"
   echo "CI OK (perfgate; report kept at $ART)"
+  exit 0
+fi
+
+if [[ "$SERVESOAK" == "1" ]]; then
+  echo "=== [2/3] serving gang suite (batching + lane pool) ==="
+  run_pytest tests/test_serving.py -q
+  echo "=== [3/3] 8-rank mixed-tenant serving soak + artifact checks ==="
+  # chaos (flaky_conn + partition) + one host SIGKILL + autoscaler
+  # re-shard over MiniEngine workers; --check gates the claims
+  # (mode-aware: the smoke runs looser timing bounds than the
+  # committed 64-rank capture — see benchmarks/serving_soak.py)
+  ART=$(mktemp /tmp/hvt_servesoak_XXXX.json)
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    python benchmarks/serving_soak.py --smoke --out "$ART"
+  python benchmarks/serving_soak.py --check "$ART"
+  # the committed 64-rank artifact must stay schema- and claim-valid
+  python benchmarks/serving_soak.py --check \
+    benchmarks/r15_serving_soak.json
+  rm -f "$ART"
+  echo "CI OK (servesoak)"
   exit 0
 fi
 

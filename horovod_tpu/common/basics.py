@@ -551,6 +551,21 @@ def poll_engine_stats(registry=None):
            "(HVT_REPLAY_BUDGET_BYTES) after reconnects, both planes",
            "replay_bytes")
 
+    # per-lane execution pool (HVT_LANE_WORKERS): how many responses
+    # ran on a pool worker instead of the engine thread, and the
+    # configured pool size — zero tasks with a nonzero pool means the
+    # traffic was pool-ineligible (global lane, shm/hierarchical
+    # backend, EF/auto-codec) and still serializes on the engine thread
+    bridge("hvt_lane_pool_tasks_total",
+           "responses executed by the per-lane worker pool "
+           "(HVT_LANE_WORKERS) instead of the engine thread",
+           "lane_pool_tasks")
+    reg.gauge(
+        "hvt_lane_workers",
+        "configured per-lane execution pool size (HVT_LANE_WORKERS; "
+        "0 = single-thread engine)").set(
+        stats.get("lane_workers", 0))
+
     # error feedback: resident residual bytes + buffers the
     # HVT_EF_MAX_BYTES budget evicted/refused (a rising drop counter
     # means quantization is running uncompensated — raise the budget)
@@ -582,9 +597,24 @@ def poll_engine_stats(registry=None):
     lane_n = reg.counter(
         "hvt_lane_exec_total",
         "data-plane responses executed per lane bucket", ("lane",))
+    # head-of-line wait per lane: submit → engine-thread pickup on
+    # this rank — the in-rank service-start delay a hot neighbor
+    # executing inline causes and HVT_LANE_WORKERS relieves; a lane
+    # whose hol seconds climb while its exec seconds stay flat is
+    # being starved by a neighbor, not slow itself
+    hol_s = reg.counter(
+        "hvt_lane_hol_seconds_total",
+        "head-of-line wait (submit -> engine pickup) per lane bucket",
+        ("lane",))
+    hol_n = reg.counter(
+        "hvt_lane_hol_total",
+        "submissions with a measured head-of-line wait per lane "
+        "bucket", ("lane",))
     depth = stats.get("lane_depth") or ()
     lane_ns = stats.get("lane_exec_ns") or ()
     lane_cnt = stats.get("lane_exec_count") or ()
+    lane_hol_ns = stats.get("lane_hol_ns") or ()
+    lane_hol_cnt = stats.get("lane_hol_count") or ()
     for i in range(native.STATS_LANE_SLOTS):
         lane = str(i)
         lane_depth.labels(lane=lane).set(
@@ -593,6 +623,10 @@ def poll_engine_stats(registry=None):
             (lane_ns[i] if i < len(lane_ns) else 0) / 1e9)
         lane_n.labels(lane=lane).set_total(
             lane_cnt[i] if i < len(lane_cnt) else 0)
+        hol_s.labels(lane=lane).set_total(
+            (lane_hol_ns[i] if i < len(lane_hol_ns) else 0) / 1e9)
+        hol_n.labels(lane=lane).set_total(
+            lane_hol_cnt[i] if i < len(lane_hol_cnt) else 0)
 
     # failure containment: coordinated aborts by cause + the sticky
     # broken flag (alerts page on either; the cause label says whether

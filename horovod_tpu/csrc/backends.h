@@ -132,6 +132,16 @@ class CollectiveBackend {
   // Synchronization keyed to it stays sound even when non-member ranks
   // skip responses and run ahead.
   virtual void BeginResponse(uint64_t seq) { (void)seq; }
+
+  // True when *Group collectives over rank-disjoint link sets may run
+  // on different threads at once — the eligibility gate of the engine's
+  // per-lane execution pool (HVT_LANE_WORKERS). Only the flat TCP ring
+  // qualifies: it is stateless per call (the DataPlane keeps per-thread
+  // scratch) and pairwise, so disjoint groups never share a socket. The
+  // shm backend sequences per-response barrier words through mutable
+  // members and the hierarchical backend composes multiple phases —
+  // both stay on the engine thread.
+  virtual bool ConcurrentGroupsSafe() const { return false; }
 };
 
 // Flat TCP ring over the full mesh — always enabled (the fallback).
@@ -143,6 +153,7 @@ class RingBackend : public CollectiveBackend {
       : dp_(dp), topo_(std::move(topo)) {}
   const char* Name() const override { return "ring"; }
   bool Enabled(const Response&, int64_t) const override { return true; }
+  bool ConcurrentGroupsSafe() const override { return true; }
   void Allreduce(void* buf, int64_t count, DataType dtype, ReduceKind red,
                  double postscale, WirePair wire) override;
   void Allgatherv(const void* in, int64_t my_rows,

@@ -239,6 +239,11 @@ int hvt_engine_flags() {
 //          self-healing reconnects — hvt_link_reconnects_total{plane}
 //   136    frames_replayed (whole control frames re-sent after heals)
 //   137    replay_bytes (replay-ring bytes re-sent after heals)
+//   138    lane_pool_tasks (responses executed on a lane-pool worker)
+//   139    lane_workers (configured HVT_LANE_WORKERS; 0 = pool off)
+//   140..147 lane_hol_ns per lane bucket (submit → engine-queue
+//          pickup head-of-line wait — hvt_lane_hol_seconds_total)
+//   148..155 lane_hol_count per lane bucket
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
@@ -253,6 +258,13 @@ constexpr int kStatsEfScalars = 2;
 // reconnect counter per LinkPlane, then the replay scalars
 constexpr int kStatsLinkPlanes = 2;
 constexpr int kStatsRecoveryScalars = 2;
+// per-lane execution pool scalars appended after the recovery block:
+// lane_pool_tasks (counter) + lane_workers (gauge)
+constexpr int kStatsLanePoolScalars = 2;
+// per-lane head-of-line telemetry appended after the pool scalars:
+// lane_hol_ns + lane_hol_count, kLaneSlots each (the in-rank
+// response-ready → exec-start wait the lane pool removes)
+constexpr int kStatsLaneHolGroups = 2;
 static_assert(kStatsLinkPlanes == hvt::kLinkPlanes,
               "transport.h kLinkPlanes drifted from the stats layout");
 constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
@@ -262,7 +274,9 @@ constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
                                 kStatsTailScalars +
                                 hvt::kWireCodecCount * hvt::kStatsOps +
                                 kStatsEfScalars + kStatsLinkPlanes +
-                                kStatsRecoveryScalars;
+                                kStatsRecoveryScalars +
+                                kStatsLanePoolScalars +
+                                kStatsLaneHolGroups * hvt::kLaneSlots;
 static_assert(kStatsSlotCount == HVT_STATS_SLOT_COUNT,
               "hvt_engine_stats layout drifted from stats_slots.h — the "
               "slot ABI is append-only: add new slots to the end of the "
@@ -318,6 +332,12 @@ int hvt_engine_stats(long long* out, int max_n) {
     v[base++] = s.link_reconnects[i].load(std::memory_order_relaxed);
   v[base++] = s.frames_replayed.load(std::memory_order_relaxed);
   v[base++] = s.replay_bytes.load(std::memory_order_relaxed);
+  v[base++] = s.lane_pool_tasks.load(std::memory_order_relaxed);
+  v[base++] = s.lane_workers.load(std::memory_order_relaxed);
+  for (int i = 0; i < hvt::kLaneSlots; ++i)
+    v[base++] = s.lane_hol_ns[i].load(std::memory_order_relaxed);
+  for (int i = 0; i < hvt::kLaneSlots; ++i)
+    v[base++] = s.lane_hol_count[i].load(std::memory_order_relaxed);
   for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
   return kStatsSlotCount;
 }

@@ -2,6 +2,7 @@
 
 #include "logging.h"
 
+#include <climits>
 #include <csignal>
 #include <chrono>
 #include <cstdio>
@@ -392,6 +393,9 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   resp_seq_ = 0;
   stats_.Reset();  // fresh telemetry per (re-)init — an elastic restart
                    // starts a new scrape epoch on every rank
+  // per-lane execution pool (HVT_LANE_WORKERS; 0 = off, bit-identical
+  // single-thread engine)
+  StartLanePool();
   // direct control-plane peers this rank serves: children (+ the parent
   // link for non-root ranks) — the fan-in number the tree exists to cap
   stats_.ctrl_peers.store(
@@ -465,6 +469,7 @@ void Engine::Shutdown() {
   }
   queue_cv_.notify_all();
   if (thread_.joinable()) thread_.join();
+  StopLanePool();  // idempotent — EnterBroken may have stopped it
   workers_.clear();
   control_.reset();
   tree_parent_.reset();
@@ -730,6 +735,10 @@ void Engine::EnterBroken(int cause, const std::string& why) {
   // this rank wake with PeerLostError immediately (FIN from Close), so
   // the abort cascades through the gang in one deadline, not N.
   if (data_) data_->Abort();
+  // Quiesce the lane pool BEFORE FailAll: workers mid-collective fail
+  // fast on the aborted links, and joining them here means FailAll is
+  // the only writer left completing their stranded entries.
+  StopLanePool();
   FailAll("hvt engine aborted (" + std::string(AbortCauseName(cause)) +
           "): " + why);
 }
@@ -1042,6 +1051,11 @@ void Engine::ThreadLoop() {
 }
 
 bool Engine::RunCycle(bool& progressed, bool& outstanding) {
+  // a lane worker's failure surfaces here, at cycle granularity: the
+  // rethrow reaches ThreadLoop's catch ladder with its abort class and
+  // the usual EnterBroken containment runs (links aborted → remaining
+  // workers fail fast → FailAll completes their entries)
+  if (!lane_threads_.empty()) RethrowLanePoolError();
   stats_.cycles.fetch_add(1, std::memory_order_relaxed);
   if (timeline_.active() && timeline_.mark_cycles())
     timeline_.CycleMark();
@@ -1063,6 +1077,27 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
         stats_.wakeup_hist.Observe(ns);
         events_.Record(EventKind::WAKEUP, "", -1,
                        static_cast<int32_t>(submitted_.size()), ns / 1000);
+      }
+      // per-lane head-of-line wait: how long each submission sat in
+      // the client queue before the engine thread picked it up
+      // (lane_hol_ns/lane_hol_count). Both ends are stamped on THIS
+      // rank, so peers' submit skew and negotiation latency cannot
+      // leak in: the wait grows only when this engine thread is busy —
+      // which is exactly what a hot neighbor executing INLINE causes
+      // and what the per-lane pool (HVT_LANE_WORKERS) removes. The
+      // single-thread floor is the event-driven coalescing delay
+      // (≤ cycle_ms) plus scheduler quanta.
+      const double now_sec = NowSec();
+      for (auto& e : submitted_) {
+        if (e->op == OpType::JOIN || e->submit_sec <= 0) continue;
+        int64_t ns =
+            static_cast<int64_t>((now_sec - e->submit_sec) * 1e9);
+        if (ns < 0) ns = 0;
+        const int32_t ls = LaneSlot(LaneId(e->members));
+        stats_.lane_hol_ns[ls].fetch_add(ns,
+                                         std::memory_order_relaxed);
+        stats_.lane_hol_count[ls].fetch_add(
+            1, std::memory_order_relaxed);
       }
     }
     for (auto& e : submitted_) {
@@ -1391,9 +1426,82 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
     if (!nm.empty() && pending_.count(nm)) announced_.erase(nm);
   }
 
-  // 5. execute
+  // 5. execute. With the per-lane pool active (HVT_LANE_WORKERS),
+  // eligible set-lane allreduces are handed to worker threads — a hot
+  // tenant's data-plane time no longer head-of-line-blocks its
+  // neighbors within this rank. Everything else quiesces the pool
+  // first (LaneBarrier) and runs inline with single-thread semantics;
+  // non-member skips run inline WITHOUT a barrier (pure cache
+  // bookkeeping, but it must advance in response order).
   for (auto& resp : responses) {
     bool tensor = resp.kind == Response::Kind::TENSOR;
+    bool nonmember_skip = false;
+    if (!lane_threads_.empty()) {
+      RethrowLanePoolError();
+      if (tensor && !resp.members.empty()) {
+        bool mine = false;
+        std::vector<int> grp;
+        for (auto mr : resp.members) {
+          grp.push_back(static_cast<int>(mr));
+          mine = mine || mr == rank_;
+        }
+        // non-member set-lane responses are pure cache bookkeeping —
+        // no data plane touched — so they fall through to the inline
+        // path (keeping its EXEC events and exec_ns/exec_count stats
+        // identical to the pool-off build) WITHOUT quiescing the
+        // pool: they must advance in response order, not serialize
+        // against the workers
+        nonmember_skip = !mine;
+        if (mine && LanePoolEligible(resp, grp, mine)) {
+          auto t = std::make_shared<LaneTask>();
+          t->resp = resp;
+          ++resp_seq_;
+          t->seq = resp_seq_;
+          data_ops_++;
+          MaybeInjectFault();
+          const size_t el_d = DataTypeSize(resp.dtype);
+          t->entries.resize(resp.names.size());
+          for (size_t i = 0; i < resp.names.size(); ++i) {
+            auto it = pending_.find(resp.names[i]);
+            if (it == pending_.end()) continue;
+            t->entries[i] = it->second;
+            pending_.erase(it);
+            announced_.erase(resp.names[i]);
+            // in-flight until CompleteEntry: a worker throw leaves
+            // the entry for FailAll, exactly like the inline path
+            MutexLock lk(handles_mu_);
+            inflight_.push_back(t->entries[i]);
+          }
+          stats_.tensors_coordinated.fetch_add(
+              static_cast<int64_t>(resp.names.size()),
+              std::memory_order_relaxed);
+          for (int64_t n : resp.numels) {
+            cycle_bytes_ += n * static_cast<int64_t>(el_d);
+            stats_.fusion_bytes.fetch_add(
+                n * static_cast<int64_t>(el_d),
+                std::memory_order_relaxed);
+          }
+          // cache inserts stay on the engine thread IN RESPONSE ORDER
+          // (positions must be identical gang-wide); doing them at
+          // dispatch instead of post-exec keeps one order for pooled
+          // and inline responses alike
+          if (CacheableResponse(resp)) {
+            for (size_t i = 0; i < resp.names.size(); ++i) {
+              if (!t->entries[i]) continue;
+              CachedParams p{resp.op,      resp.reduce,
+                             resp.dtype,   t->entries[i]->shape,
+                             resp.root,    resp.prescale,
+                             resp.postscale, t->entries[i]->splits,
+                             resp.members};
+              cache_.Insert(resp.names[i], p);
+            }
+          }
+          DispatchLaneTask(std::move(t));
+          continue;
+        }
+      }
+      if (!nonmember_skip) LaneBarrier();
+    }
     bool trace = timeline_.active() && tensor;
     if (trace)
       for (auto& n : resp.names)
@@ -1490,7 +1598,10 @@ bool Engine::RunCycle(bool& progressed, bool& outstanding) {
   UpdateDiag();
 
   if (resp_flags & kRespFlagShutdown) {
-    // coordinated shutdown: drain anything left as errors
+    // coordinated shutdown: quiesce the lane pool (its in-flight
+    // collectives must complete — every member executes the same
+    // stream), then drain anything left as errors
+    LaneBarrier();
     for (auto& [n, e] : pending_)
       CompleteEntry(e, Status::Aborted("hvt shut down"));
     pending_.clear();
@@ -2842,120 +2953,34 @@ void Engine::ExecuteResponse(const Response& resp,
         return;
       }
 
-      // fused path: pack → (prescale) → ring → unpack, with postscale
-      // folded into the backend. Single-tensor responses — the common
-      // shape for large payloads, which fuse rarely — skip the fusion
-      // buffer entirely and run the collective in place on the entry's
-      // own input buffer: no 2·bytes pack/unpack memcpy sweep.
-      int64_t total = 0;
-      for (auto n : resp.numels) total += n;
+      // fused path: pack → (prescale) → (EF) → ring → unpack, with
+      // postscale folded into the backend. The body is shared with the
+      // per-lane execution pool (ExecFusedAllreduce); entries are taken
+      // HERE because the pending table is engine-thread state, and
+      // cache inserts stay on the engine thread in response order.
       std::vector<EntryPtr> entries(resp.names.size());
-      uint8_t* work;
-      bool in_place = false;
-      if (resp.names.size() == 1) {
-        entries[0] = take(resp.names[0]);
-        in_place = entries[0] != nullptr &&
-                   entries[0]->input.size() ==
-                       static_cast<size_t>(total) * el;
-      }
-      if (in_place) {
-        work = entries[0]->input.data();
-      } else {
-        // per-lane fusion scratch: each process set's buffer converges
-        // to its own working-set size instead of thrashing one shared
-        // allocation across tenants
-        auto& fusion_buffer = fusion_buffers_[LaneId(resp.members)];
-        fusion_buffer.resize(static_cast<size_t>(total) * el);
-        work = fusion_buffer.data();
-        int64_t off = 0;
+      for (size_t i = 0; i < resp.names.size(); ++i)
+        entries[i] = take(resp.names[i]);
+      // per-lane fusion scratch: each process set's buffer converges
+      // to its own working-set size instead of thrashing one shared
+      // allocation across tenants
+      ExecFusedAllreduce(resp, entries, resp_seq_,
+                         fusion_buffers_[LaneId(resp.members)],
+                         /*apply_ef=*/true);
+      // every rank inserts in the same order → identical caches;
+      // grouped tensors stay uncached (groups renegotiate as a
+      // unit). Set-scoped responses cache too (lane-keyed fast
+      // path); non-member ranks mirror the insert via
+      // CacheResponseAllRanks so positions never diverge.
+      if (CacheableResponse(resp)) {
         for (size_t i = 0; i < resp.names.size(); ++i) {
-          if (!entries[i]) entries[i] = take(resp.names[i]);
-          size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
-          if (entries[i]) {
-            memcpy(work + off, entries[i]->input.data(), bytes);
-          } else {
-            memset(work + off, 0, bytes);  // joined stand-in
-          }
-          off += bytes;
-        }
-      }
-      if (resp.prescale != 1.0)
-        ScaleBuffer(work, total, resp.dtype, resp.prescale);
-      {
-        // subset responses route through the backend list too (shm serves
-        // them via per-group barrier cells; ring is the fallback) — the
-        // reference serves every op from the selected backend
-        // (operation_manager.cc). postscale (incl. the Average divide)
-        // folds into the backend's final data pass, and the negotiated
-        // wire-codec pair rides along for the TCP ring.
-        double post = resp.postscale;
-        if (resp.reduce == ReduceKind::AVERAGE) post /= m;
-        WirePair wire{static_cast<WireCodec>(resp.wire_intra),
-                      static_cast<WireCodec>(resp.wire_inter)};
-        auto* be = PickBackend(resp, total);
-        // error feedback: compensate the codec that will actually touch
-        // this payload. Add each tensor's stored residual, roundtrip the
-        // compensated input through the codec (idempotent on the wire's
-        // own grid, so the first-hop quantization of this rank's data
-        // becomes lossless — exactly so when ring-segment offsets are
-        // block-aligned; unaligned segments re-grid at most one wire
-        // quantum per element, uncaptured), and keep the new
-        // quantization error for the next submission of the same
-        // (name, lane). Per-rank local — every rank compensates only
-        // its own contribution, so cross-rank bit-identity of the
-        // collective is untouched. EffectiveWire picks ONE codec per
-        // payload: a pair with two lossy codecs (bf16,int8
-        // hierarchical) leaves the intra-phase bf16 rounding
-        // uncompensated — see docs/performance.md §EF.
-        const Codec* efc =
-            ef_enabled_ ? CodecFor(EffectiveWire(be, resp, grp)) : nullptr;
-        if (efc && WireEligible(resp)) {
-          const uint64_t lane = LaneId(resp.members);
-          int64_t eoff = 0;
-          for (size_t i = 0; i < resp.names.size(); ++i) {
-            const int64_t n = resp.numels[i];
-            if (entries[i]) {  // joined stand-ins carry no gradient
-              float* seg = reinterpret_cast<float*>(work) + eoff;
-              if (float* r = EfResidual(resp.names[i], lane, n)) {
-                for (int64_t j = 0; j < n; ++j) seg[j] += r[j];
-                memcpy(r, seg, static_cast<size_t>(n) * 4);
-                efc->Roundtrip(seg, n);
-                for (int64_t j = 0; j < n; ++j) r[j] -= seg[j];
-              } else {
-                efc->Roundtrip(seg, n);  // over budget: quantize w/o memory
-              }
-            }
-            eoff += n;
-          }
-        }
-        be->BeginResponse(resp_seq_);
-        if (resp.members.empty())
-          be->Allreduce(work, total, resp.dtype, resp.reduce, post, wire);
-        else
-          be->AllreduceGroup(work, total, resp.dtype, resp.reduce, grp,
-                             post, wire);
-      }
-      int64_t off = 0;
-      for (size_t i = 0; i < resp.names.size(); ++i) {
-        size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
-        if (entries[i]) {
-          if (in_place)
-            entries[i]->output = std::move(entries[i]->input);
-          else
-            entries[i]->output.assign(work + off, work + off + bytes);
-          // every rank inserts in the same order → identical caches;
-          // grouped tensors stay uncached (groups renegotiate as a
-          // unit). Set-scoped responses cache too (lane-keyed fast
-          // path); non-member ranks mirror the insert via
-          // CacheResponseAllRanks so positions never diverge.
+          if (!entries[i]) continue;
           CachedParams p{resp.op,      resp.reduce,    resp.dtype,
                          entries[i]->shape, resp.root, resp.prescale,
                          resp.postscale, entries[i]->splits,
                          resp.members};
-          if (CacheableResponse(resp)) cache_.Insert(resp.names[i], p);
-          CompleteEntry(entries[i], Status::OK());
+          cache_.Insert(resp.names[i], p);
         }
-        off += bytes;
       }
       return;
     }
@@ -3093,6 +3118,399 @@ void Engine::ExecuteResponse(const Response& resp,
 
     default:
       return;
+  }
+}
+
+// --------------------------------------------------------------------------
+// fused-allreduce execution body (engine thread AND lane-pool workers)
+// --------------------------------------------------------------------------
+
+void Engine::ExecFusedAllreduce(const Response& resp,
+                                std::vector<EntryPtr>& entries,
+                                uint64_t seq,
+                                std::vector<uint8_t>& scratch,
+                                bool apply_ef) {
+  // participants — the caller already established this rank is one
+  std::vector<int> grp;
+  if (resp.members.empty()) {
+    grp.resize(size_);
+    for (int i = 0; i < size_; ++i) grp[i] = i;
+  } else {
+    for (auto mr : resp.members) grp.push_back(static_cast<int>(mr));
+  }
+  const int m = static_cast<int>(grp.size());
+  const size_t el = DataTypeSize(resp.dtype);
+  // response-scoped telemetry stamps: the DataPlane context is
+  // per-thread, so the EXECUTING thread (engine or pool worker) stamps
+  // its own — a worker's WIRE spans and byte counters attribute to its
+  // own lane even while the engine thread executes something else
+  if (data_) {
+    data_->set_stat_op(static_cast<int>(resp.op));
+    data_->set_wire_ctx(resp.names[0], LaneSlot(LaneId(resp.members)));
+  }
+  int64_t total = 0;
+  for (auto n : resp.numels) total += n;
+  // Single-tensor responses — the common shape for large payloads,
+  // which fuse rarely — skip the fusion buffer entirely and run the
+  // collective in place on the entry's own input buffer: no 2·bytes
+  // pack/unpack memcpy sweep.
+  uint8_t* work;
+  const bool in_place = entries.size() == 1 && entries[0] != nullptr &&
+                        entries[0]->input.size() ==
+                            static_cast<size_t>(total) * el;
+  if (in_place) {
+    work = entries[0]->input.data();
+  } else {
+    scratch.resize(static_cast<size_t>(total) * el);
+    work = scratch.data();
+    int64_t off = 0;
+    for (size_t i = 0; i < resp.names.size(); ++i) {
+      size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
+      if (entries[i]) {
+        memcpy(work + off, entries[i]->input.data(), bytes);
+      } else {
+        memset(work + off, 0, bytes);  // joined stand-in
+      }
+      off += bytes;
+    }
+  }
+  if (resp.prescale != 1.0)
+    ScaleBuffer(work, total, resp.dtype, resp.prescale);
+  {
+    // subset responses route through the backend list too (shm serves
+    // them via per-group barrier cells; ring is the fallback) — the
+    // reference serves every op from the selected backend
+    // (operation_manager.cc). postscale (incl. the Average divide)
+    // folds into the backend's final data pass, and the negotiated
+    // wire-codec pair rides along for the TCP ring.
+    double post = resp.postscale;
+    if (resp.reduce == ReduceKind::AVERAGE) post /= m;
+    WirePair wire{static_cast<WireCodec>(resp.wire_intra),
+                  static_cast<WireCodec>(resp.wire_inter)};
+    auto* be = PickBackend(resp, total);
+    // error feedback: compensate the codec that will actually touch
+    // this payload. Add each tensor's stored residual, roundtrip the
+    // compensated input through the codec (idempotent on the wire's
+    // own grid, so the first-hop quantization of this rank's data
+    // becomes lossless — exactly so when ring-segment offsets are
+    // block-aligned; unaligned segments re-grid at most one wire
+    // quantum per element, uncaptured), and keep the new
+    // quantization error for the next submission of the same
+    // (name, lane). Per-rank local — every rank compensates only
+    // its own contribution, so cross-rank bit-identity of the
+    // collective is untouched. EffectiveWire picks ONE codec per
+    // payload: a pair with two lossy codecs (bf16,int8
+    // hierarchical) leaves the intra-phase bf16 rounding
+    // uncompensated — see docs/performance.md §EF. apply_ef is false
+    // on the pool path (residuals are engine-thread state; EF-active
+    // responses never reach the pool — LanePoolEligible).
+    const Codec* efc = (apply_ef && ef_enabled_)
+                           ? CodecFor(EffectiveWire(be, resp, grp))
+                           : nullptr;
+    if (efc && WireEligible(resp)) {
+      const uint64_t lane = LaneId(resp.members);
+      int64_t eoff = 0;
+      for (size_t i = 0; i < resp.names.size(); ++i) {
+        const int64_t n = resp.numels[i];
+        if (entries[i]) {  // joined stand-ins carry no gradient
+          float* seg = reinterpret_cast<float*>(work) + eoff;
+          if (float* r = EfResidual(resp.names[i], lane, n)) {
+            for (int64_t j = 0; j < n; ++j) seg[j] += r[j];
+            memcpy(r, seg, static_cast<size_t>(n) * 4);
+            efc->Roundtrip(seg, n);
+            for (int64_t j = 0; j < n; ++j) r[j] -= seg[j];
+          } else {
+            efc->Roundtrip(seg, n);  // over budget: quantize w/o memory
+          }
+        }
+        eoff += n;
+      }
+    }
+    be->BeginResponse(seq);
+    if (resp.members.empty())
+      be->Allreduce(work, total, resp.dtype, resp.reduce, post, wire);
+    else
+      be->AllreduceGroup(work, total, resp.dtype, resp.reduce, grp,
+                         post, wire);
+  }
+  int64_t off = 0;
+  for (size_t i = 0; i < resp.names.size(); ++i) {
+    size_t bytes = static_cast<size_t>(resp.numels[i]) * el;
+    if (entries[i]) {
+      if (in_place)
+        entries[i]->output = std::move(entries[i]->input);
+      else
+        entries[i]->output.assign(work + off, work + off + bytes);
+      CompleteEntry(entries[i], Status::OK());
+    }
+    off += bytes;
+  }
+}
+
+// --------------------------------------------------------------------------
+// per-lane execution pool (HVT_LANE_WORKERS)
+// --------------------------------------------------------------------------
+
+// |a ∩ b| ≥ 2: the two member lists share at least one rank PAIR, i.e.
+// at least one data socket — their collectives must serialize in
+// response order (which is identical on every rank, so all ranks
+// serialize them the same way). Sharing exactly ONE rank is safe: that
+// rank talks to disjoint peer sets over disjoint sockets, which is
+// precisely the in-rank isolation the pool exists to provide. Member
+// lists are ascending (the submit path sorts them).
+static bool LaneMembersConflict(const std::vector<int64_t>& a,
+                                const std::vector<int64_t>& b) {
+  size_t i = 0, j = 0;
+  int shared = 0;
+  while (i < a.size() && j < b.size()) {
+    if (a[i] == b[j]) {
+      if (++shared >= 2) return true;
+      ++i;
+      ++j;
+    } else if (a[i] < b[j]) {
+      ++i;
+    } else {
+      ++j;
+    }
+  }
+  return false;
+}
+
+void Engine::StartLanePool() {
+  lane_workers_ = 0;
+  stats_.lane_workers.store(0, std::memory_order_relaxed);
+  if (size_ <= 1) return;
+  int n = static_cast<int>(EnvInt("HVT_LANE_WORKERS", 0));
+  if (n <= 0) return;
+  if (n > 16) n = 16;
+  lane_workers_ = n;
+  {
+    MutexLock lk(pool_mu_);
+    pool_stop_ = false;
+    pool_error_.clear();
+    pool_error_cause_ = -1;
+    lane_queues_.assign(static_cast<size_t>(n), {});
+    lane_active_.assign(static_cast<size_t>(n), nullptr);
+    lane_worker_of_.clear();
+  }
+  for (int i = 0; i < n; ++i)
+    lane_threads_.emplace_back([this, i] { LaneWorkerLoop(i); });
+  stats_.lane_workers.store(n, std::memory_order_relaxed);
+  HVT_LOG(INFO, rank_) << "per-lane execution pool: " << n
+                       << " worker(s) (HVT_LANE_WORKERS)";
+}
+
+void Engine::StopLanePool() {
+  if (lane_threads_.empty()) {
+    lane_workers_ = 0;
+    return;
+  }
+  {
+    MutexLock lk(pool_mu_);
+    pool_stop_ = true;
+  }
+  pool_cv_.notify_all();
+  for (auto& th : lane_threads_)
+    if (th.joinable()) th.join();
+  lane_threads_.clear();
+  {
+    // tasks still queued here were error-completed by FailAll (their
+    // entries sit in inflight_); on the clean path the shutdown-cycle
+    // barrier drained everything first
+    MutexLock lk(pool_mu_);
+    lane_queues_.clear();
+    lane_active_.clear();
+    lane_worker_of_.clear();
+    pool_stop_ = false;
+  }
+  lane_workers_ = 0;
+  stats_.lane_workers.store(0, std::memory_order_relaxed);
+}
+
+void Engine::LaneWorkerLoop(int wi) {
+  while (true) {
+    std::shared_ptr<LaneTask> t;
+    {
+      CvLock lk(pool_mu_);
+      pool_cv_.wait(lk.native(), [&]() REQUIRES(pool_mu_) {
+        return pool_stop_ ||
+               !lane_queues_[static_cast<size_t>(wi)].empty();
+      });
+      if (pool_stop_) return;
+      t = lane_queues_[static_cast<size_t>(wi)].front();
+      lane_queues_[static_cast<size_t>(wi)].pop_front();
+      lane_active_[static_cast<size_t>(wi)] = t;
+    }
+    auto note = [&](int cause, const char* what) {
+      MutexLock lk(pool_mu_);
+      if (pool_error_.empty()) {
+        pool_error_ = what;
+        pool_error_cause_ = cause;
+      }
+    };
+    try {
+      RunLaneTask(*t);
+    } catch (const OpTimeoutError& e) {
+      note(kAbortTimeout, e.what());
+    } catch (const PeerLostError& e) {
+      note(kAbortPeerLost, e.what());
+    } catch (const std::exception& e) {
+      // the failed task's entries stay in inflight_ — the engine
+      // thread rethrows this error, EnterBroken aborts the links, and
+      // FailAll error-completes them (PR 4 containment unchanged)
+      note(kAbortInternal, e.what());
+    }
+    {
+      MutexLock lk(pool_mu_);
+      lane_active_[static_cast<size_t>(wi)] = nullptr;
+    }
+    pool_done_cv_.notify_all();
+  }
+}
+
+void Engine::RethrowLanePoolError() {
+  std::string msg;
+  int cause = -1;
+  {
+    MutexLock lk(pool_mu_);
+    if (pool_error_.empty()) return;
+    msg = "lane worker: " + pool_error_;
+    cause = pool_error_cause_;
+  }
+  switch (cause) {
+    case kAbortTimeout:
+      throw OpTimeoutError(msg);
+    case kAbortPeerLost:
+      throw PeerLostError(msg);
+    default:
+      throw std::runtime_error(msg);
+  }
+}
+
+void Engine::LaneBarrier() {
+  if (lane_threads_.empty()) return;
+  {
+    CvLock lk(pool_mu_);
+    pool_done_cv_.wait(lk.native(), [&]() REQUIRES(pool_mu_) {
+      for (auto& q : lane_queues_)
+        if (!q.empty()) return false;
+      for (auto& a : lane_active_)
+        if (a) return false;
+      return true;
+    });
+  }
+  RethrowLanePoolError();
+}
+
+void Engine::DispatchLaneTask(std::shared_ptr<LaneTask> t) {
+  RethrowLanePoolError();
+  const uint64_t lid = LaneId(t->resp.members);
+  {
+    CvLock lk(pool_mu_);
+    // sticky anti-affinity assignment: a lane keeps its worker (FIFO
+    // program order), and a first-seen lane lands on the least-busy
+    // worker — a blind LaneId-hash can deterministically co-locate a
+    // hot lane with an idle neighbor on one FIFO, reintroducing
+    // exactly the head-of-line blocking the pool exists to remove
+    int wi;
+    auto wit = lane_worker_of_.find(lid);
+    if (wit != lane_worker_of_.end()) {
+      wi = wit->second;
+    } else {
+      std::vector<int> lanes_on(static_cast<size_t>(lane_workers_), 0);
+      for (auto& kv : lane_worker_of_)
+        lanes_on[static_cast<size_t>(kv.second)]++;
+      wi = 0;
+      size_t best_load = SIZE_MAX;
+      int best_lanes = INT_MAX;
+      for (int w = 0; w < lane_workers_; ++w) {
+        size_t load = lane_queues_[static_cast<size_t>(w)].size() +
+                      (lane_active_[static_cast<size_t>(w)] ? 1 : 0);
+        int nl = lanes_on[static_cast<size_t>(w)];
+        if (load < best_load ||
+            (load == best_load && nl < best_lanes)) {
+          best_load = load;
+          best_lanes = nl;
+          wi = w;
+        }
+      }
+      lane_worker_of_[lid] = wi;
+    }
+    auto conflicted = [&]() REQUIRES(pool_mu_) {
+      if (!pool_error_.empty()) return false;  // unblock; rethrown below
+      for (int w = 0; w < lane_workers_; ++w) {
+        if (w == wi) continue;  // same queue = FIFO program order
+        auto& act = lane_active_[static_cast<size_t>(w)];
+        if (act &&
+            LaneMembersConflict(act->resp.members, t->resp.members))
+          return true;
+        for (auto& q : lane_queues_[static_cast<size_t>(w)])
+          if (LaneMembersConflict(q->resp.members, t->resp.members))
+            return true;
+      }
+      return false;
+    };
+    pool_done_cv_.wait(lk.native(), [&]() REQUIRES(pool_mu_) {
+      return !conflicted();
+    });
+    lane_queues_[static_cast<size_t>(wi)].push_back(std::move(t));
+  }
+  pool_cv_.notify_all();
+  RethrowLanePoolError();
+}
+
+bool Engine::LanePoolEligible(const Response& resp,
+                              const std::vector<int>& grp, bool mine) {
+  if (lane_threads_.empty() || !mine || resp.members.empty())
+    return false;
+  if (resp.op != OpType::ALLREDUCE ||
+      resp.reduce == ReduceKind::ADASUM)
+    return false;
+  int64_t total = 0;
+  for (auto n : resp.numels) total += n;
+  auto* be = PickBackend(resp, total);
+  if (!be->ConcurrentGroupsSafe()) return false;
+  // rank 0's auto-mode codec tuner learns from inline executions only
+  if (rank_ == 0 && wire_auto_ && WireEligible(resp)) return false;
+  // EF residuals are engine-thread state: a response the error-feedback
+  // pass would compensate stays inline
+  if (ef_enabled_ && WireEligible(resp) &&
+      CodecFor(EffectiveWire(be, resp, grp)) != nullptr)
+    return false;
+  return true;
+}
+
+void Engine::RunLaneTask(LaneTask& t) {
+  const Response& resp = t.resp;
+  const int32_t resp_lane = LaneSlot(LaneId(resp.members));
+  const int32_t op_w = static_cast<int32_t>(resp.op);
+  const int64_t fused_n = static_cast<int64_t>(resp.names.size());
+  const bool trace = timeline_.active();  // mutex-guarded writer
+  for (auto& n : resp.names) {
+    if (trace) timeline_.ExecuteStart(n, OpName(resp.op));
+    if (fused_n > 1)
+      events_.Record(EventKind::FUSED, n, op_w, rank_, fused_n,
+                     resp_lane);
+    events_.Record(EventKind::EXEC_BEGIN, n, op_w, rank_, 0, resp_lane);
+  }
+  const double t0 = NowSec();
+  ExecFusedAllreduce(resp, t.entries, t.seq, t.buf, /*apply_ef=*/false);
+  const int64_t exec_ns = static_cast<int64_t>((NowSec() - t0) * 1e9);
+  const int op_i = static_cast<int>(resp.op);
+  if (op_i >= 0 && op_i < kStatsOps) {
+    stats_.exec_ns[op_i].fetch_add(exec_ns, std::memory_order_relaxed);
+    stats_.exec_count[op_i].fetch_add(1, std::memory_order_relaxed);
+  }
+  // pool tasks are member-only by construction, so the lane attribution
+  // rule (members only) holds
+  stats_.lane_exec_ns[resp_lane].fetch_add(exec_ns,
+                                           std::memory_order_relaxed);
+  stats_.lane_exec_count[resp_lane].fetch_add(1,
+                                              std::memory_order_relaxed);
+  stats_.lane_pool_tasks.fetch_add(1, std::memory_order_relaxed);
+  for (auto& n : resp.names) {
+    events_.Record(EventKind::EXEC_END, n, op_w, rank_, 0, resp_lane);
+    if (trace) timeline_.ExecuteEnd(n);
   }
 }
 

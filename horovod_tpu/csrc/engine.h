@@ -258,6 +258,21 @@ struct EngineStats {
   std::atomic<int64_t> link_reconnects[kLinkPlanes]{};
   std::atomic<int64_t> frames_replayed{0};
   std::atomic<int64_t> replay_bytes{0};
+  // per-lane execution pool (HVT_LANE_WORKERS): responses executed on
+  // a pool worker instead of the engine thread (counter), and the
+  // configured worker count (gauge, set at Init; 0 = pool off)
+  std::atomic<int64_t> lane_pool_tasks{0};
+  std::atomic<int64_t> lane_workers{0};
+  // per-lane head-of-line wait (service-start delay): ns between a
+  // submission landing in the client queue and the engine thread
+  // picking it up to announce. Both ends are stamped on THIS rank, so
+  // peers' submit skew and negotiation latency cannot leak in: a
+  // single-thread engine executing a hot neighbor inline cannot drain
+  // the queue, so that blocking lands here; with the lane pool the
+  // engine thread stays free and the wait collapses to the
+  // event-driven coalescing tick (≤ cycle_ms) + scheduler quanta.
+  std::atomic<int64_t> lane_hol_ns[kLaneSlots]{};
+  std::atomic<int64_t> lane_hol_count[kLaneSlots]{};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -290,6 +305,10 @@ struct EngineStats {
     for (auto& l : link_reconnects) l = 0;
     frames_replayed = 0;
     replay_bytes = 0;
+    lane_pool_tasks = 0;
+    lane_workers = 0;
+    for (auto& l : lane_hol_ns) l = 0;
+    for (auto& l : lane_hol_count) l = 0;
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -371,6 +390,8 @@ class Engine {
   Status Init(int rank, int size, const std::string& master_addr,
               int master_port, int cycle_ms);
   void Shutdown();
+  // per-lane execution pool introspection (tests)
+  int lane_worker_count() const { return lane_workers_; }
   bool initialized() const { return initialized_.load(); }
   int rank() const { return rank_; }
   int size() const { return size_; }
@@ -445,6 +466,58 @@ class Engine {
   bool RunCycle(bool& progressed, bool& outstanding);
   void ExecuteResponse(const Response& resp,
                        std::map<std::string, EntryPtr>& pending)
+      EXCLUDES(handles_mu_);
+
+  // ------------------------------------------------------------------
+  // per-lane execution pool (HVT_LANE_WORKERS)
+  // ------------------------------------------------------------------
+  // In-rank blast-radius containment for multi-tenant serving: the
+  // engine thread keeps sole ownership of negotiation, caches and the
+  // pending table, but eligible TENSOR allreduces on process-SET lanes
+  // are handed to a small worker pool so a hot or degraded lane's data
+  // plane time no longer head-of-line-blocks its neighbors on the same
+  // rank. Tasks hash to per-worker FIFO queues by LaneId (same lane →
+  // same worker → program order); a task whose member set shares TWO
+  // OR MORE ranks with any task queued/active on another worker (i.e.
+  // shares a socket pair) waits at dispatch — response order is
+  // identical gang-wide, so every rank serializes conflicting lanes
+  // the same way. Everything else (global lane, shm/hierarchical
+  // backends, Adasum, EF-compensated or tuner-observed responses)
+  // takes LaneBarrier() and runs inline, preserving the single-thread
+  // semantics exactly; HVT_LANE_WORKERS=0 keeps the engine
+  // bit-identical to the pre-pool build.
+  struct LaneTask {
+    Response resp;
+    std::vector<EntryPtr> entries;  // aligned with resp.names
+    uint64_t seq = 0;               // resp_seq_ at dispatch
+    std::vector<uint8_t> buf;       // task-local fusion scratch
+  };
+  void StartLanePool();
+  void StopLanePool() EXCLUDES(pool_mu_);
+  void LaneWorkerLoop(int wi) EXCLUDES(pool_mu_, handles_mu_);
+  // Conflict-checked enqueue (engine thread): blocks until no other
+  // worker holds a task sharing ≥2 member ranks with `t`.
+  void DispatchLaneTask(std::shared_ptr<LaneTask> t)
+      EXCLUDES(pool_mu_);
+  // Wait until every queue is empty and every worker idle; then
+  // surface any worker error (rethrown with its abort class).
+  void LaneBarrier() EXCLUDES(pool_mu_);
+  void RethrowLanePoolError() EXCLUDES(pool_mu_);
+  // True when `resp` may run on a pool worker on this rank (member,
+  // set-lane, ring-backend TENSOR allreduce outside the EF/auto-codec
+  // paths).
+  bool LanePoolEligible(const Response& resp,
+                        const std::vector<int>& grp, bool mine);
+  // Execute one dispatched task on a worker thread: flight-recorder
+  // EXEC span, fused-allreduce body, per-op/per-lane stats.
+  void RunLaneTask(LaneTask& t) EXCLUDES(handles_mu_);
+  // The fused-allreduce execution body shared by the inline path and
+  // the pool (pack → prescale → [EF, inline only] → backend → unpack →
+  // complete). `scratch` is the fusion buffer to use when the response
+  // cannot run in place.
+  void ExecFusedAllreduce(const Response& resp,
+                          std::vector<EntryPtr>& entries, uint64_t seq,
+                          std::vector<uint8_t>& scratch, bool apply_ef)
       EXCLUDES(handles_mu_);
   void CompleteEntry(const EntryPtr& e, const Status& s)
       EXCLUDES(handles_mu_);
@@ -698,6 +771,25 @@ class Engine {
   // buffer and vice versa — each lane's buffer converges to its own
   // working-set size
   std::map<uint64_t, std::vector<uint8_t>> fusion_buffers_;
+
+  // per-lane execution pool (see the LaneTask block above). pool_mu_
+  // is leaf-level: never held while taking queue_mu_/handles_mu_.
+  int lane_workers_ = 0;  // HVT_LANE_WORKERS (0 = pool off)
+  std::vector<std::thread> lane_threads_;
+  Mutex pool_mu_;
+  std::condition_variable pool_cv_;       // workers: task available
+  std::condition_variable pool_done_cv_;  // dispatcher: drain/conflict
+  std::vector<std::deque<std::shared_ptr<LaneTask>>> lane_queues_
+      GUARDED_BY(pool_mu_);
+  std::vector<std::shared_ptr<LaneTask>> lane_active_
+      GUARDED_BY(pool_mu_);  // one slot per worker (null = idle)
+  // sticky lane → worker assignment (least-busy on first sight; see
+  // DispatchLaneTask) — a blind LaneId hash can deterministically
+  // co-locate a hot lane with an idle neighbor on one worker FIFO
+  std::map<uint64_t, int> lane_worker_of_ GUARDED_BY(pool_mu_);
+  bool pool_stop_ GUARDED_BY(pool_mu_) = false;
+  std::string pool_error_ GUARDED_BY(pool_mu_);
+  int pool_error_cause_ GUARDED_BY(pool_mu_) = -1;
 };
 
 }  // namespace hvt

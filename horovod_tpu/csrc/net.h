@@ -137,6 +137,14 @@ class Sock {
       fd_ = -1;
     }
   }
+  // Wake any thread blocked in (or about to issue) a syscall on this
+  // fd WITHOUT releasing the fd number: close() would let a concurrent
+  // accept/dial recycle it under that thread, silently redirecting its
+  // I/O to an unrelated socket. The fd is reclaimed by Close() /
+  // the destructor once no other thread can be driving the link.
+  void ShutdownOnly() {
+    if (fd_ >= 0) ::shutdown(fd_, SHUT_RDWR);
+  }
   bool valid() const { return fd_ >= 0; }
   int fd() const { return fd_; }
 

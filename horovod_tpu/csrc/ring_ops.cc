@@ -300,10 +300,11 @@ void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
   // hvt_analyze can attribute execution time to the wire vs the reduce.
   // A pump that throws leaves the span unclosed — an aborted transfer is
   // exactly what an open WIRE span in a trace means.
+  PlaneCtx& cx = Ctx();
   const int64_t wire_bytes = static_cast<int64_t>(send_n + recv_n);
   if (events_ && wire_bytes > 0)
-    events_->Record(EventKind::WIRE_BEGIN, wire_name_, stat_op_, 0,
-                    wire_bytes, wire_lane_);
+    events_->Record(EventKind::WIRE_BEGIN, cx.wire_name, cx.stat_op, 0,
+                    wire_bytes, cx.wire_lane);
   while (sent < send_n || rcvd < recv_n) {
     // a link mid-reconnect reports fd < 0: drive its Some() op directly
     // (the call heals the link or escalates) instead of parking an
@@ -383,8 +384,8 @@ void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
   }
   flush_chunks();
   if (events_ && wire_bytes > 0)
-    events_->Record(EventKind::WIRE_END, wire_name_, stat_op_, 0,
-                    wire_bytes, wire_lane_);
+    events_->Record(EventKind::WIRE_END, cx.wire_name, cx.stat_op, 0,
+                    wire_bytes, cx.wire_lane);
   CountTx(send_n, codec);
 }
 
@@ -406,11 +407,12 @@ void DataPlane::RingReduceScatter(uint8_t* bytes,
   auto wbytes = [&](int64_t n) {
     return cdc ? cdc->CompressedSize(n) : static_cast<size_t>(n) * el;
   };
+  PlaneCtx& cx = Ctx();
   int64_t max_seg = 0;
   for (int i = 0; i < l; ++i)
     max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
-  scratch_.resize(wbytes(max_seg));
-  if (cdc) wire_send_.resize(wbytes(max_seg));
+  cx.scratch.resize(wbytes(max_seg));
+  if (cdc) cx.wire_send.resize(wbytes(max_seg));
   // chunk alignment: raw streams align to the element, codec streams to
   // the self-contained wire block (in-band scales) — either way a
   // completed chunk decodes and reduces in place
@@ -427,9 +429,9 @@ void DataPlane::RingReduceScatter(uint8_t* bytes,
     const size_t send_w = wbytes(send_n), recv_w = wbytes(recv_n);
     const uint8_t* sp = bytes + seg_off[send_seg] * el;
     if (cdc) {
-      cdc->Compress(wire_send_.data(),
+      cdc->Compress(cx.wire_send.data(),
                     reinterpret_cast<const float*>(sp), send_n);
-      sp = wire_send_.data();
+      sp = cx.wire_send.data();
     }
     uint8_t* dst_seg = bytes + seg_off[recv_seg] * el;
     auto reduce_chunk = [&](size_t off, size_t len) {
@@ -442,23 +444,23 @@ void DataPlane::RingReduceScatter(uint8_t* bytes,
                          ? recv_n
                          : CodecElemsBefore(*cdc, off + len);
         ReduceFromWire(*cdc, reinterpret_cast<float*>(dst_seg) + e0,
-                       scratch_.data() + off, e1 - e0, red, decode_);
+                       cx.scratch.data() + off, e1 - e0, red, cx.decode);
       } else {
-        ReduceInto(dst_seg + off, scratch_.data() + off,
+        ReduceInto(dst_seg + off, cx.scratch.data() + off,
                    static_cast<int64_t>(len / el), dtype, red);
       }
     };
     if (pipeline_) {
-      Duplex(peer(next), sp, send_w, peer(prev), scratch_.data(), recv_w,
-             chunk, wid, reduce_chunk);
+      Duplex(peer(next), sp, send_w, peer(prev), cx.scratch.data(),
+             recv_w, chunk, wid, reduce_chunk);
     } else {
       // blocking baseline: full-duplex via index-parity ordering (avoids
       // head-of-line deadlock for frames below the socket buffer size)
       if (idx % 2 == 0) {
         SendCounted(peer(next), sp, send_w, wid);
-        peer(prev).Recv(scratch_.data(), recv_w);
+        peer(prev).Recv(cx.scratch.data(), recv_w);
       } else {
-        peer(prev).Recv(scratch_.data(), recv_w);
+        peer(prev).Recv(cx.scratch.data(), recv_w);
         SendCounted(peer(next), sp, send_w, wid);
       }
       if (recv_n > 0) reduce_chunk(0, recv_w);
@@ -484,12 +486,13 @@ void DataPlane::RingAllgatherSegs(uint8_t* bytes,
   const size_t align = cdc ? cdc->WireBlockBytes() : el;
   const size_t chunk = std::max<size_t>(
       align, (static_cast<size_t>(chunk_bytes_) / align) * align);
+  PlaneCtx& cx = Ctx();
   if (cdc) {
     int64_t max_seg = 0;
     for (int i = 0; i < l; ++i)
       max_seg = std::max(max_seg, seg_off[i + 1] - seg_off[i]);
-    wire_send_.resize(wbytes(max_seg));
-    wire_recv_.resize(wbytes(max_seg));
+    cx.wire_send.resize(wbytes(max_seg));
+    cx.wire_recv.resize(wbytes(max_seg));
   }
   for (int step = 0; step < l - 1; ++step) {
     int send_seg = (idx + 1 - step + l) % l;
@@ -503,7 +506,7 @@ void DataPlane::RingAllgatherSegs(uint8_t* bytes,
       const size_t send_w = wbytes(send_n), recv_w = wbytes(recv_n);
       if (step == 0)
         cdc->Compress(
-            wire_send_.data(),
+            cx.wire_send.data(),
             reinterpret_cast<const float*>(bytes + seg_off[send_seg] * el),
             send_n);
       float* dst = reinterpret_cast<float*>(bytes + seg_off[recv_seg] * el);
@@ -512,22 +515,22 @@ void DataPlane::RingAllgatherSegs(uint8_t* bytes,
         int64_t e1 = off + len >= recv_w
                          ? recv_n
                          : CodecElemsBefore(*cdc, off + len);
-        cdc->Decompress(dst + e0, wire_recv_.data() + off, e1 - e0);
+        cdc->Decompress(dst + e0, cx.wire_recv.data() + off, e1 - e0);
       };
       if (pipeline_) {
-        Duplex(peer(next), wire_send_.data(), send_w, peer(prev),
-               wire_recv_.data(), recv_w, chunk, wid, widen_chunk);
+        Duplex(peer(next), cx.wire_send.data(), send_w, peer(prev),
+               cx.wire_recv.data(), recv_w, chunk, wid, widen_chunk);
       } else {
         if (idx % 2 == 0) {
-          SendCounted(peer(next), wire_send_.data(), send_w, wid);
-          peer(prev).Recv(wire_recv_.data(), recv_w);
+          SendCounted(peer(next), cx.wire_send.data(), send_w, wid);
+          peer(prev).Recv(cx.wire_recv.data(), recv_w);
         } else {
-          peer(prev).Recv(wire_recv_.data(), recv_w);
-          SendCounted(peer(next), wire_send_.data(), send_w, wid);
+          peer(prev).Recv(cx.wire_recv.data(), recv_w);
+          SendCounted(peer(next), cx.wire_send.data(), send_w, wid);
         }
         if (recv_n > 0) widen_chunk(0, recv_w);
       }
-      std::swap(wire_send_, wire_recv_);
+      std::swap(cx.wire_send, cx.wire_recv);
       continue;
     }
     if (pipeline_) {

@@ -37,7 +37,10 @@
 #include <cstdint>
 #include <functional>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
+#include <unordered_map>
 #include <vector>
 
 #include "common.h"
@@ -158,7 +161,7 @@ class DataPlane {
     codec_tx_sink_ = sink;
   }
   void set_stat_op(int op) {
-    stat_op_ = (op >= 0 && op < kWireOps) ? op : 0;
+    Ctx().stat_op = (op >= 0 && op < kWireOps) ? op : 0;
   }
 
   // ---- wire-phase flight-recorder spans --------------------------------
@@ -171,21 +174,44 @@ class DataPlane {
   // spanned. Fused units attribute their spans to the first member name.
   void BindEvents(EventRing* ring) { events_ = ring; }
   void set_wire_ctx(const std::string& name, int lane) {
-    wire_name_ = name;
-    wire_lane_ = lane;
+    PlaneCtx& cx = Ctx();
+    cx.wire_name = name;
+    cx.wire_lane = lane;
   }
 
  private:
+  // Per-thread execution context: the response-scoped telemetry stamps
+  // (stat_op / wire ctx) and the scratch/staging buffers. One per
+  // calling thread so the engine's per-lane worker pool can pump
+  // disjoint sub-rings concurrently without sharing mutable state —
+  // each lane's buffers also converge to that lane's working-set size,
+  // exactly like the engine's per-lane fusion buffers.
+  struct PlaneCtx {
+    int stat_op = 0;
+    std::string wire_name;
+    int wire_lane = 0;
+    std::vector<uint8_t> scratch;
+    std::vector<uint8_t> wire_send, wire_recv;  // compressed ping-pong
+    std::vector<float> decode;  // block-codec chunk-decode staging
+  };
+  PlaneCtx& Ctx() {
+    const std::thread::id id = std::this_thread::get_id();
+    std::lock_guard<std::mutex> lk(ctx_mu_);
+    auto& p = ctxs_[id];
+    if (!p) p.reset(new PlaneCtx());
+    return *p;  // stable: boxed, never moved by rehash
+  }
   Transport& peer(int r) { return *peers_[static_cast<size_t>(r)]; }
   void CountTx(size_t n, WireCodec codec) {
     if (!tx_sink_) return;
-    tx_sink_[stat_op_].fetch_add(static_cast<int64_t>(n),
-                                 std::memory_order_relaxed);
+    const int op = Ctx().stat_op;
+    tx_sink_[op].fetch_add(static_cast<int64_t>(n),
+                           std::memory_order_relaxed);
     if (codec != WireCodec::RAW)
-      txc_sink_[stat_op_].fetch_add(static_cast<int64_t>(n),
-                                    std::memory_order_relaxed);
+      txc_sink_[op].fetch_add(static_cast<int64_t>(n),
+                              std::memory_order_relaxed);
     if (codec_tx_sink_)
-      codec_tx_sink_[static_cast<int>(codec) * kWireOps + stat_op_]
+      codec_tx_sink_[static_cast<int>(codec) * kWireOps + op]
           .fetch_add(static_cast<int64_t>(n), std::memory_order_relaxed);
   }
   void SendCounted(Transport& s, const void* data, size_t n,
@@ -208,17 +234,13 @@ class DataPlane {
   std::vector<std::unique_ptr<Transport>> peers_;
   bool pipeline_ = true;        // HVT_RING_PIPELINE
   int64_t chunk_bytes_ = 1 << 20;  // HVT_RING_CHUNK_BYTES
-  int stat_op_ = 0;             // engine-thread-only (set_stat_op)
   std::atomic<int64_t>* tx_sink_ = nullptr;   // [kWireOps], caller-owned
   std::atomic<int64_t>* txc_sink_ = nullptr;  // [kWireOps], caller-owned
   // [kWireCodecCount * kWireOps] codec-major, caller-owned
   std::atomic<int64_t>* codec_tx_sink_ = nullptr;
   EventRing* events_ = nullptr;               // caller-owned (engine)
-  std::string wire_name_;       // engine-thread-only (set_wire_ctx)
-  int wire_lane_ = 0;
-  std::vector<uint8_t> scratch_;
-  std::vector<uint8_t> wire_send_, wire_recv_;  // compressed ping-pong
-  std::vector<float> decode_;   // block-codec chunk-decode staging
+  std::mutex ctx_mu_;
+  std::unordered_map<std::thread::id, std::unique_ptr<PlaneCtx>> ctxs_;
 };
 
 // Elementwise accumulate: dst = dst (op) src, for count elements.

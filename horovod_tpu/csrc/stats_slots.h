@@ -21,7 +21,7 @@
 // `hist.count`.
 #pragma once
 
-#define HVT_STATS_SLOT_COUNT 138
+#define HVT_STATS_SLOT_COUNT 156
 
 // X-macro: HVT_STATS_SLOT(index, "name")
 #define HVT_STATS_SLOTS(X)                  \
@@ -162,4 +162,22 @@
   X(134, "link_reconnects[ctrl]")          \
   X(135, "link_reconnects[data]")          \
   X(136, "frames_replayed")                \
-  X(137, "replay_bytes")
+  X(137, "replay_bytes")                   \
+  X(138, "lane_pool_tasks")                \
+  X(139, "lane_workers")                   \
+  X(140, "lane_hol_ns[0]")                 \
+  X(141, "lane_hol_ns[1]")                 \
+  X(142, "lane_hol_ns[2]")                 \
+  X(143, "lane_hol_ns[3]")                 \
+  X(144, "lane_hol_ns[4]")                 \
+  X(145, "lane_hol_ns[5]")                 \
+  X(146, "lane_hol_ns[6]")                 \
+  X(147, "lane_hol_ns[7]")                 \
+  X(148, "lane_hol_count[0]")              \
+  X(149, "lane_hol_count[1]")              \
+  X(150, "lane_hol_count[2]")              \
+  X(151, "lane_hol_count[3]")              \
+  X(152, "lane_hol_count[4]")              \
+  X(153, "lane_hol_count[5]")              \
+  X(154, "lane_hol_count[6]")              \
+  X(155, "lane_hol_count[7]")

@@ -27,17 +27,26 @@
 // to a wedged peer fixes nothing — so the heartbeat/timeout abort
 // classes behave exactly as PR 4 pinned them.
 //
-// Thread-safety: like Sock, links are engine-thread affine (see the
-// net.h contract). The per-link state/epoch/retry fields read by the
-// diagnostics snapshot are plain — UpdateDiag copies them ON the
-// engine thread; client threads read the snapshot, never the link.
+// Thread-safety: a link is used by ONE thread at a time, but since the
+// per-lane execution pool (engine.cc, HVT_LANE_WORKERS) that thread is
+// no longer always the engine thread: disjoint serving lanes pump
+// disjoint link sets concurrently. Every blocking/nonblocking transfer
+// claims the link for its duration (LinkClaim — a per-link owner-token
+// CAS), and a sibling sweep's ProbeAndRepair try-claims and SKIPS links
+// another thread holds, so two threads can never race a socket or a
+// heal. The state/epoch/retry fields read by the diagnostics snapshot
+// are relaxed atomics — UpdateDiag may now copy them while a worker
+// thread heals the link.
 #pragma once
 
 #include <algorithm>
+#include <atomic>
 #include <deque>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -234,6 +243,15 @@ struct ReconnectHub;
 // broken links (defined after TcpLink; see the full comment there).
 inline void ServiceSiblingLinks(ReconnectHub* hub, TcpLink* busy);
 
+// Small monotonically-assigned per-thread id used as the link owner
+// token (std::thread::id is not CAS-friendly). 0 is reserved for
+// "unowned".
+inline uint64_t LinkThreadToken() {
+  static std::atomic<uint64_t> next{1};
+  thread_local uint64_t tok = next.fetch_add(1);
+  return tok;
+}
+
 // Shared reconnect state, owned by the engine (one per engine run):
 // the parking lot for accepted-but-not-mine reconnect dials, the
 // telemetry sinks (EngineStats fields — they outlive every link), and
@@ -248,8 +266,10 @@ struct ReconnectHub {
   // engine gates
   std::atomic<bool>* stop = nullptr;    // engine shutdown_requested_
   std::atomic<bool> closed{false};      // EnterBroken: reconnects refuse
-  int64_t hold_until_ms = 0;            // partition fault: heal no
-                                        // earlier than this
+  // partition fault: heal no earlier than this. Atomic: the chaos
+  // injector arms it on the engine thread while lane-pool workers read
+  // it inside their own reconnect episodes.
+  std::atomic<int64_t> hold_until_ms{0};
   int my_rank = 0;
   // Abort sniffing: the engine sets abort_flag to its control-frame
   // abort bit (wire.h kAbortFrameFlag); sibling sweeps then PEEK
@@ -267,15 +287,22 @@ struct ReconnectHub {
     int64_t peer_epoch = 0;
     int64_t peer_rx = 0;
   };
+  // guarded by parked_mu: two threads (engine + a lane-pool worker, or
+  // two workers) can run acceptor-side reconnects concurrently, each
+  // parking dials the other's link owns
+  std::mutex parked_mu;
   std::map<std::pair<int, int>, Parked> parked;
-  // live links (engine-thread registry) — the diagnostics snapshot and
-  // the chaos injector walk this instead of widening the Transport seam
+  // live links — registered/unregistered only at Init/Shutdown (no
+  // lane workers running), so sweeps iterate it without a lock; the
+  // diagnostics snapshot and the chaos injector walk this instead of
+  // widening the Transport seam
   std::vector<TcpLink*> links;
 
   void Reset() {
     closed.store(false);
-    hold_until_ms = 0;
+    hold_until_ms.store(0);
     remote_abort_seen.store(false);
+    std::lock_guard<std::mutex> lk(parked_mu);
     parked.clear();
     // links unregister themselves via ~TcpLink
   }
@@ -327,6 +354,37 @@ class TcpLink : public Transport {
   int64_t epoch() const { return epoch_; }
   int retries() const { return retries_; }
   double state_since_sec() const { return state_since_; }
+
+  // Exclusive-use claim (see the thread-safety note at the top of this
+  // file). Reentrant: a frame whose caller already holds the link
+  // (Send → SendSome) sees its own token and holds nothing. Contention
+  // is rare and short — a sibling sweep's probe (≤ ~0.65 s) on a link
+  // whose owner is between pump iterations — so waiting is a yield
+  // loop, not a futex.
+  class Claim {
+   public:
+    explicit Claim(TcpLink* l) : l_(l) {
+      const uint64_t me = LinkThreadToken();
+      if (l_->owner_.load(std::memory_order_relaxed) == me) return;
+      uint64_t expect = 0;
+      while (!l_->owner_.compare_exchange_weak(
+          expect, me, std::memory_order_acquire,
+          std::memory_order_relaxed)) {
+        expect = 0;
+        std::this_thread::yield();
+      }
+      held_ = true;
+    }
+    ~Claim() {
+      if (held_) l_->owner_.store(0, std::memory_order_release);
+    }
+    Claim(const Claim&) = delete;
+    Claim& operator=(const Claim&) = delete;
+
+   private:
+    TcpLink* l_;
+    bool held_ = false;
+  };
   // Reconnect opt-out for parked side channels (tree members' star
   // socket): a failure throws immediately instead of healing, so the
   // owner can retire the link without a coordinator on the other end.
@@ -350,7 +408,14 @@ class TcpLink : public Transport {
   void Abort() override {
     state_ = LinkState::DEAD;
     state_since_ = NowSec();
-    sock_.Close();
+    // shutdown WITHOUT close: EnterBroken aborts the links BEFORE
+    // joining the lane pool, so a worker may still be blocked in (or
+    // about to issue) a syscall on this fd. shutdown wakes it with
+    // FIN/RST but keeps the fd number allocated — close() here could
+    // let a concurrent reconnect-accept recycle the number under the
+    // worker. The fd is reclaimed when the link is destroyed
+    // (engine Shutdown tears the DataPlane down after the pool joins).
+    sock_.ShutdownOnly();
   }
 
   void Idle() override { ServiceSiblingLinks(hub_, this); }
@@ -363,6 +428,24 @@ class TcpLink : public Transport {
   // dial role. Never blocks beyond one bounded attempt; a repaired
   // link goes straight back to HEALTHY with its replay armed.
   void ProbeAndRepair() {
+    // try-claim: never touch a link another thread is actively driving
+    // or already probing — the owner heals its own link in-call, and a
+    // concurrent probe would race the socket mid-heal
+    const uint64_t me = LinkThreadToken();
+    bool held = false;
+    if (owner_.load(std::memory_order_relaxed) != me) {
+      uint64_t expect = 0;
+      if (!owner_.compare_exchange_strong(expect, me,
+                                          std::memory_order_acquire,
+                                          std::memory_order_relaxed))
+        return;
+      held = true;
+    }
+    ProbeAndRepairOwned();
+    if (held) owner_.store(0, std::memory_order_release);
+  }
+
+  void ProbeAndRepairOwned() {
     if (state_ == LinkState::DEAD || (hub_ && hub_->closed.load()))
       return;
     if (state_ == LinkState::HEALTHY && sock_.valid()) {
@@ -411,6 +494,7 @@ class TcpLink : public Transport {
   // after it would turn a healed link into an abort (the Duplex pump
   // re-arms for exactly the same reason).
   void Send(const void* p, size_t n, int64_t timeout_ms = -1) override {
+    Claim claim(this);
     if (timeout_ms < 0) timeout_ms = OpTimeoutMs();
     int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
     auto* src = static_cast<const uint8_t*>(p);
@@ -425,6 +509,7 @@ class TcpLink : public Transport {
     }
   }
   void Recv(void* p, size_t n, int64_t timeout_ms = -1) override {
+    Claim claim(this);
     if (timeout_ms < 0) timeout_ms = OpTimeoutMs();
     int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
     auto* dst = static_cast<uint8_t*>(p);
@@ -441,6 +526,7 @@ class TcpLink : public Transport {
 
   // ---- nonblocking best-effort moves (the duplex pump) ---------------
   size_t SendSome(const void* p, size_t n) override {
+    Claim claim(this);
     if (!EnsureUsable("send")) return 0;
     // stream order: pending replay bytes precede any new payload
     if (replay_from_ >= 0 && !FlushReplayOnce()) return 0;
@@ -463,6 +549,7 @@ class TcpLink : public Transport {
     return static_cast<size_t>(k);
   }
   size_t RecvSome(void* p, size_t n) override {
+    Claim claim(this);
     if (!EnsureUsable("recv")) return 0;
     ssize_t k = ::recv(sock_.fd(), p, n, MSG_DONTWAIT);
     if (k > 0) {
@@ -638,16 +725,22 @@ class TcpLink : public Transport {
         int64_t peer_epoch = 0, peer_rx = -1;
         bool adopted = false;
         if (hub_) {
-          auto it =
-              hub_->parked.find({static_cast<int>(plane_), peer_});
-          if (it != hub_->parked.end()) {
-            Sock s = std::move(it->second.sock);
-            peer_epoch = it->second.peer_epoch;
-            peer_rx = it->second.peer_rx;
-            hub_->parked.erase(it);
-            if (TryAck(s, peer_epoch)) sock_ = std::move(s);
-            adopted = true;
+          // move the parked dial out under the lock, handshake outside
+          // it (TryAck blocks up to 2 s)
+          Sock s;
+          {
+            std::lock_guard<std::mutex> plk(hub_->parked_mu);
+            auto it =
+                hub_->parked.find({static_cast<int>(plane_), peer_});
+            if (it != hub_->parked.end()) {
+              s = std::move(it->second.sock);
+              peer_epoch = it->second.peer_epoch;
+              peer_rx = it->second.peer_rx;
+              hub_->parked.erase(it);
+              adopted = true;
+            }
           }
+          if (adopted && TryAck(s, peer_epoch)) sock_ = std::move(s);
         }
         if (!adopted) {
           if (!listener_)
@@ -668,6 +761,7 @@ class TcpLink : public Transport {
                 pk.sock = std::move(s);
                 pk.peer_epoch = pe;
                 pk.peer_rx = prx;
+                std::lock_guard<std::mutex> plk(hub_->parked_mu);
                 hub_->parked[{pplane, prank}] =
                     std::move(pk);  // latest wins
               }
@@ -753,7 +847,7 @@ class TcpLink : public Transport {
                                          // (re-armed) replay
       }
     }
-    epoch_ = std::max(epoch_, peer_epoch);
+    epoch_.store(std::max(epoch_.load(), peer_epoch));
     if (dial_host_.empty()) ++epoch_;  // acceptor already bumped in ack
     state_ = LinkState::HEALTHY;
     double dur = NowSec() - t0;
@@ -804,7 +898,7 @@ class TcpLink : public Transport {
     try {
       Writer w;
       w.i32_raw(kLinkHelloMagic);
-      w.i64_raw(std::max(epoch_, peer_epoch) + 1);
+      w.i64_raw(std::max(epoch_.load(), peer_epoch) + 1);
       w.i64_raw(rx_);
       s.SendFrame(w.buf, 2000);
       return true;
@@ -887,16 +981,27 @@ class TcpLink : public Transport {
   Listener* listener_;
   ReplayRing ring_;
   bool reconnect_ = true;
-  int64_t tx_ = 0;           // bytes ever handed to the kernel
-  int64_t rx_ = 0;           // bytes ever consumed by the app
-  int64_t replay_from_ = -1; // pending replay cursor (<0 → none)
-  int64_t cut_after_ = -1;   // chaos: close once tx_ crosses this
-  int64_t cut_after_rx_ = -1;  // chaos: close once rx_ crosses this
+  // owner-thread token (0 = unowned): the Claim CAS word above
+  std::atomic<uint64_t> owner_{0};
+  // tx_/rx_ are owner-thread counters, but the chaos injector reads
+  // tx_ (InjectCutAfter) and the diagnostics snapshot may read either
+  // from the engine thread while a lane worker drives the link —
+  // atomics keep those cross-thread reads defined
+  std::atomic<int64_t> tx_{0};  // bytes ever handed to the kernel
+  std::atomic<int64_t> rx_{0};  // bytes ever consumed by the app
+  int64_t replay_from_ = -1;  // pending replay cursor (<0 → none;
+                              // owner-thread only, like the ring)
+  // chaos cut marks: armed by the engine thread, checked by the owner
+  std::atomic<int64_t> cut_after_{-1};
+  std::atomic<int64_t> cut_after_rx_{-1};
   std::deque<int64_t> frame_ends_;  // SendFrame end offsets in-window
-  LinkState state_ = LinkState::HEALTHY;
-  int64_t epoch_ = 0;
-  int retries_ = 0;
-  double state_since_;
+  // state/epoch/retries/state_since: written by the owning thread,
+  // read by UpdateDiag from the engine thread — relaxed-consistency
+  // telemetry reads, hence atomics
+  std::atomic<LinkState> state_{LinkState::HEALTHY};
+  std::atomic<int64_t> epoch_{0};
+  std::atomic<int> retries_{0};
+  std::atomic<double> state_since_;
   std::vector<uint8_t> frame_;  // SendFrame staging
 };
 

@@ -223,35 +223,129 @@ def replication_enabled() -> bool:
         "0", "off", "false")
 
 
-def build_replica_groups(hosts_by_rank, k):
-    """Partition ranks 0..n-1 into replication groups of ~k members,
-    each spanning distinct hosts wherever the topology allows.
+def partial_fallback_enabled() -> bool:
+    """``HVT_PARTIAL_FALLBACK`` gate (default on): when only SOME
+    lineages lost every intact replica, ranks with recoverable lineages
+    keep their peer-rebuilt state and ONLY the lost lineages restore
+    from the application fallback (ROADMAP 5d). ``0`` restores the
+    pre-r15 all-or-nothing semantics — every rank takes the fallback
+    together — for applications whose state is gang-replicated rather
+    than per-lineage (a data-parallel optimizer restored from an older
+    checkpoint on one rank only would diverge from its peers)."""
+    return os.environ.get("HVT_PARTIAL_FALLBACK", "1") not in (
+        "0", "off", "false")
 
-    Ranks are interleaved round-robin across hosts (h0's first slot,
-    h1's first slot, ..., h0's second slot, ...) and the interleaved
-    order is chunked into groups — so with >= k hosts every group is
-    fully cross-host, and a lost host costs at most one member per
-    group. A trailing remainder group of one is merged into its
-    predecessor (a group of one replicates nothing). Deterministic in
-    its inputs: every rank computes the identical plan from the same
-    gathered rank→host table."""
-    n = len(hosts_by_rank)
-    k = max(1, min(int(k), n))
+
+def _interleave_by_host(ranks, hosts_by_rank):
+    """Round-robin ranks across their hosts (h0's first slot, h1's
+    first slot, ..., h0's second slot, ...): chunking the result into
+    groups of k puts every group on k distinct hosts whenever there
+    are >= k hosts."""
     by_host = {}
     order = []
-    for r in range(n):
+    for r in ranks:
         h = hosts_by_rank[r]
         if h not in by_host:
             by_host[h] = []
             order.append(h)
         by_host[h].append(r)
-    interleaved = []
+    out = []
     depth = max(len(v) for v in by_host.values()) if by_host else 0
     for i in range(depth):
         for h in order:
             if i < len(by_host[h]):
-                interleaved.append(by_host[h][i])
-    groups = [interleaved[i:i + k] for i in range(0, n, k)]
+                out.append(by_host[h][i])
+    return out
+
+
+def rack_of(host) -> str:
+    """The topology group of a host id: the prefix before ``/`` when
+    ``HVT_TOPO_HOST`` carries a rack dimension (``rack0/h3``), else
+    ``None`` (flat topology — every host stands alone)."""
+    h = str(host)
+    return h.split("/", 1)[0] if "/" in h else None
+
+
+def build_replica_groups(hosts_by_rank, k):
+    """Partition ranks 0..n-1 into replication groups of ~k members,
+    each spanning distinct hosts wherever the topology allows, and
+    preferring SAME-RACK/different-host peers when ``HVT_TOPO_HOST``
+    carries a rack dimension (``rack/host`` — ROADMAP 5b's
+    topology-weighted placement).
+
+    Within each rack that has at least k distinct hosts, ranks are
+    interleaved round-robin across that rack's hosts and chunked into
+    rack-local groups — replication traffic stays inside the rack
+    while a host SIGKILL still cannot take a lineage and all of its
+    replicas (every emitted group spans distinct hosts — a chunk that
+    per-host count skew folds onto one host is never kept as-is).
+    Rack remainders, racks too small to satisfy the cross-host
+    guarantee on their own, and rack-less hosts pool into the classic
+    global interleave, so a balanced flat topology (no ``/`` anywhere)
+    produces exactly the pre-rack plan; a skewed one scatters
+    skew-folded chunks across cross-host groups instead of keeping
+    them. A trailing remainder group of one is merged into its
+    predecessor (a group of one replicates nothing). Deterministic in
+    its inputs: every rank computes the identical plan from the same
+    gathered rank→host table."""
+    n = len(hosts_by_rank)
+    k = max(1, min(int(k), n))
+    # first-seen rack order keeps the plan a pure function of the table
+    racks = {}
+    rack_order = []
+    for r in range(n):
+        rk = rack_of(hosts_by_rank[r])
+        if rk not in racks:
+            racks[rk] = []
+            rack_order.append(rk)
+        racks[rk].append(r)
+    groups = []
+    pool = []
+    for rk in rack_order:
+        ranks = racks[rk]
+        hosts = {hosts_by_rank[r] for r in ranks}
+        if rk is None or len(hosts) < k or len(ranks) < k:
+            # cannot guarantee cross-host placement rack-locally —
+            # fall back to the global pool (the pre-rack behavior)
+            pool.extend(ranks)
+            continue
+        inter = _interleave_by_host(ranks, hosts_by_rank)
+        whole = (len(inter) // k) * k
+        for i in range(0, whole, k):
+            g = inter[i:i + k]
+            # host-count skew can fold a round-robin chunk onto ONE
+            # host (three ranks on h0 + one on h1 at k=2 interleaves
+            # to [0,3,1,2] and chunk [1,2] is all-h0) — such a chunk
+            # would let a host SIGKILL take a lineage and all of its
+            # replicas, so it rides the global pool instead
+            if len({hosts_by_rank[r] for r in g}) > 1:
+                groups.append(g)
+            else:
+                pool.extend(g)
+        pool.extend(inter[whole:])  # remainder rides the global pool
+    if pool:
+        inter = _interleave_by_host(pool, hosts_by_rank)
+        same_host = []
+        for i in range(0, len(inter), k):
+            g = inter[i:i + k]
+            if len(g) > 1 and len({hosts_by_rank[r] for r in g}) == 1:
+                same_host.append(g)
+            else:
+                groups.append(g)
+        # the same skew can fold a pool chunk too: scatter those ranks
+        # one-per-group across existing cross-host groups (adding a
+        # member keeps a group cross-host). Only a world without
+        # cross-host groups to absorb them (single-host topologies)
+        # keeps same-host groups — replication within the host is
+        # still better than none, and matches the pre-rack plan there.
+        spill = [r for g in same_host for r in g]
+        targets = [g for g in groups
+                   if len({hosts_by_rank[r] for r in g}) > 1]
+        if targets:
+            for j, r in enumerate(spill):
+                targets[j % len(targets)].append(r)
+        else:
+            groups.extend(same_host)
     if len(groups) > 1 and len(groups[-1]) == 1:
         groups[-2].extend(groups.pop())
     return [sorted(g) for g in groups]
@@ -641,12 +735,17 @@ class ReplicatedState(ObjectState):
                                                bootstrap=True)
             except ReplicaUnavailableError as e:
                 outcome, settle_err = "failed", e
-        # gang-wide consensus on outcomes: a single unrecoverable
-        # lineage makes partial recovery an inconsistent cut, so EVERY
-        # rank raises and the application falls back to its checkpoint
-        # together; likewise one rank taking its application fallback
-        # leaves the gang step-inconsistent unless EVERY rank restores
-        # from the same application cut
+        # gang-wide consensus on outcomes: a rank whose lineage is
+        # unrecoverable AND has no fallback fails the whole gang (any
+        # recovery the survivors kept would sit at a cut that rank can
+        # never reach). A rank that DID restore from its application
+        # fallback no longer drags the rest of the gang with it: with
+        # HVT_PARTIAL_FALLBACK (default on) the intact lineages keep
+        # their peer-rebuilt state at the cut and only the lost
+        # lineages pay the checkpoint — per-lineage blast radius
+        # (ROADMAP 5d) instead of all-or-nothing. HVT_PARTIAL_FALLBACK=0
+        # restores the old gang-wide semantics for gang-replicated
+        # application state (see partial_fallback_enabled).
         outs = c.allgather(outcome,
                            name="hvt.elastic.replica_outcome")
         if any(o == "failed" for o in outs):
@@ -659,14 +758,17 @@ class ReplicatedState(ObjectState):
                     f"{[i for i, o in enumerate(outs) if o == 'failed']} "
                     f"hold unrecoverable lineages; gang-wide fallback "
                     f"to application restore")
-        if any(o == "fallback" for o in outs) and outcome != "fallback":
+        fellback = [i for i, o in enumerate(outs) if o == "fallback"]
+        if fellback and outcome != "fallback" and \
+                not partial_fallback_enabled():
             if self._fallback is None:
                 self._last_recovery = {"phase": "rebuild",
                                        "outcome": "failed",
                                        "version": target}
                 raise ReplicaUnavailableError(
                     "a peer restored from its application fallback; "
-                    "this rank has none to match the gang's cut")
+                    "this rank has none to match the gang's cut "
+                    "(HVT_PARTIAL_FALLBACK=0)")
             self._fallback(self)
             self.save()
             self._version = target
@@ -714,6 +816,11 @@ class ReplicatedState(ObjectState):
                                "seconds": round(dt, 4)}
         if orphans_lost:
             self._last_recovery["orphans_lost"] = orphans_lost
+        if fellback:
+            # which ranks restored their lineage from the application
+            # fallback this round — the per-lineage recovery record
+            # (/statusz recovery rows and the partial-loss tests read it)
+            self._last_recovery["fallback_ranks"] = sorted(fellback)
         _note(seconds=dt)
         # RECOVERY flight-recorder stamping is owned by the caller's
         # episode (`elastic/run.py _Recovery`) — a second stamp here

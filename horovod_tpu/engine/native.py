@@ -200,6 +200,17 @@ STATS_EF_SCALARS = ("ef_residual_bytes", "ef_residuals_dropped")
 # label of hvt_link_reconnects_total — then the replay scalars
 STATS_LINK_PLANES = ("ctrl", "data")
 STATS_RECOVERY_SCALARS = ("frames_replayed", "replay_bytes")
+# per-lane execution pool scalars appended after the recovery block
+# (c_api.cc kStatsLanePoolScalars): responses executed by a pool worker
+# instead of the engine thread (counter), and the configured
+# HVT_LANE_WORKERS count (gauge; 0 = pool off)
+STATS_LANE_POOL_SCALARS = ("lane_pool_tasks", "lane_workers")
+# per-lane head-of-line telemetry appended after the pool scalars
+# (c_api.cc kStatsLaneHolGroups): ns a submission waited between
+# submit and the engine's queue pickup (the drain), per lane bucket,
+# plus the matching count — the in-rank blocking the
+# HVT_LANE_WORKERS pool removes (hvt_lane_hol_* on the metrics plane)
+STATS_LANE_HOL_GROUPS = ("lane_hol_ns", "lane_hol_count")
 
 
 def engine_stats() -> dict:
@@ -260,6 +271,12 @@ def engine_stats() -> dict:
     for key in STATS_RECOVERY_SCALARS:
         out[key] = vals[lbase]
         lbase += 1
+    for key in STATS_LANE_POOL_SCALARS:
+        out[key] = vals[lbase]
+        lbase += 1
+    for key in STATS_LANE_HOL_GROUPS:
+        out[key] = vals[lbase:lbase + STATS_LANE_SLOTS]
+        lbase += STATS_LANE_SLOTS
     return out
 
 
@@ -326,7 +343,9 @@ STATS_SLOT_COUNT = (len(STATS_SCALARS) + 4 * len(STATS_OPS)
                     + len(WIRE_CODECS) * len(STATS_OPS)
                     + len(STATS_EF_SCALARS)
                     + len(STATS_LINK_PLANES)
-                    + len(STATS_RECOVERY_SCALARS))
+                    + len(STATS_LANE_HOL_GROUPS) * STATS_LANE_SLOTS
+                    + len(STATS_RECOVERY_SCALARS)
+                    + len(STATS_LANE_POOL_SCALARS))
 
 
 def events_supported() -> bool:

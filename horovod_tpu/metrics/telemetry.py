@@ -1153,16 +1153,44 @@ class StatuszBuilder:
                     "age_sec": round(age, 1) if age is not None
                     else None}
 
-        # serving scope: per-rank ReplicaGang snapshots (direct pushes)
-        serving = {"ranks": 0, "inflight_max": 0, "shed_total": 0}
+        # serving scope: per-rank ReplicaGang snapshots. The entries get
+        # the same last-write-timestamp treatment as the rank records —
+        # a dead/shed rank's final push (the scope survives round
+        # resets by design, and the TTL sweep takes up to HVT_KV_TTL_SEC
+        # to retire it) reads as STALE and is excluded from the live
+        # backlog signal instead of pinning it high: the health
+        # engine's serving_backlog rule and the autoscaler both consume
+        # inflight_max, so a ghost lane here was a ghost scale-out
+        # there. Out-of-world rank ids (a re-shard shrank the gang) are
+        # excluded the same way.
+        serving = {"ranks": 0, "stale_ranks": 0, "inflight_max": 0,
+                   "shed_total": 0, "lanes": {}}
+        world_size = int(world.get("size") or 0)
         for key in store.keys("serving"):
             raw = store.get("serving", key)
+            age = _store_age(store, "serving", key, now)
             try:
                 body = json.loads(raw)
+                rank_id = int(body.get("rank", key))
+                ghost = ((age is not None and age > stale_after)
+                         or (world_size and rank_id >= world_size))
+                if ghost:
+                    serving["stale_ranks"] += 1
+                    continue
                 serving["ranks"] += 1
                 serving["inflight_max"] = max(serving["inflight_max"],
                                               int(body.get("inflight", 0)))
                 serving["shed_total"] += int(body.get("shed", 0))
+                lane = str(body.get("replica", "?"))
+                row = serving["lanes"].setdefault(
+                    lane, {"ranks": 0, "inflight_max": 0, "shed": 0,
+                           "p99_ms_max": 0.0})
+                row["ranks"] += 1
+                row["inflight_max"] = max(row["inflight_max"],
+                                          int(body.get("inflight", 0)))
+                row["shed"] += int(body.get("shed", 0))
+                row["p99_ms_max"] = max(row["p99_ms_max"],
+                                        float(body.get("p99_ms", 0.0)))
             except (ValueError, TypeError, AttributeError):
                 continue
 

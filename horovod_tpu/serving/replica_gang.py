@@ -14,31 +14,48 @@ replica lane:
   local timing), so replica members always agree on which requests
   entered the collective stream — a timing-based decision would let one
   member shed what its peers submitted and wedge the lane;
-- reaping waits on the oldest handle with the **admission deadline**
-  (``Handle.wait(timeout=)``); a deadline miss is recorded (the SLO
-  signal) and the wait then completes unbounded — the collective was
-  already submitted by every member and WILL finish, so the handle must
-  be drained to keep the window accounting aligned;
+- **request-level batching** (``HVT_SERVING_BATCH`` > 1): admitted
+  requests queue locally and every ``batch_window`` of them flush as
+  ONE fused lane submission (an engine fusion group — one negotiation,
+  one collective per window slot instead of one per request). Batch
+  boundaries are a pure function of the same aligned call history —
+  flush on the Nth admit, on a reap that finds only queued work, and on
+  ``drain()`` — so members stay in lockstep; ``flush()`` is public for
+  callers with their own cadence. ``HVT_SERVING_BATCH=1`` (default) is
+  the unbatched PR 6 wire shape, request-for-request;
+- reaping waits on the oldest slot with the **admission deadline**
+  (``Handle.wait(timeout=)``), accounted per REQUEST from its own
+  submit time; a deadline miss is recorded (the SLO signal) and the
+  wait then completes unbounded — the collective was already submitted
+  by every member and WILL finish, so the slot must be drained to keep
+  the window accounting aligned;
 - when an elastic rendezvous is configured (``HVT_RENDEZVOUS_ADDR``),
   :meth:`push_stats` PUTs the per-rank serving snapshot to
   ``/kv/serving/<rank>`` — the backlog/latency signal the autoscaler
   (``runner/elastic/autoscaler.py``) scales on.
 
+The collective machinery sits behind a small **engine seam**
+(``engine=``): anything with ``rank/size/submit/submit_batch/wait`` can
+stand in for the real eager engine, which is how the 64-rank serving
+soak (``benchmarks/serving_soak.py``) drives the exact same
+window/shed/batch discipline over bare-ctypes MiniEngine workers with
+no jax/numpy in the process. This module is import-light for the same
+reason: numpy is only touched by the default adapter.
+
 Knobs (overridable per instance): ``HVT_SERVING_ADMISSION_MS`` —
 admission deadline per request (default 1000); ``HVT_SERVING_MAX_BACKLOG``
-— in-flight window per replica member (default 32).
+— in-flight window per replica member, counted in REQUESTS (default
+32); ``HVT_SERVING_BATCH`` — requests coalesced per fused lane
+submission (default 1 = unbatched).
 """
 
 from __future__ import annotations
 
-import json
+import math
 import os
 import time
 
-import numpy as np
-
 from horovod_tpu.common.exceptions import HorovodTimeoutError
-from horovod_tpu.common.process_sets import ProcessSet, add_process_set
 
 
 def partition_replicas(world_size: int, num_replicas: int):
@@ -60,6 +77,20 @@ def partition_replicas(world_size: int, num_replicas: int):
     return out
 
 
+def _percentile(values, q: float) -> float:
+    """numpy.percentile's default linear interpolation, dependency-free
+    (MiniEngine soak workers carry no numpy)."""
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    k = (len(vals) - 1) * (q / 100.0)
+    f = math.floor(k)
+    c = math.ceil(k)
+    if f == c:
+        return float(vals[int(k)])
+    return float(vals[f] * (c - k) + vals[c] * (k - f))
+
+
 class ReplicaStats:
     """Per-rank serving counters + a bounded latency reservoir
     (Vitter's algorithm R: once full, each new observation replaces a
@@ -74,6 +105,7 @@ class ReplicaStats:
         self.shed = 0
         self.completed = 0
         self.deadline_miss = 0
+        self.batches = 0  # fused lane submissions (= window slots used)
         self.latencies_ms = []
         self._max_samples = max_samples
         self._seen = 0
@@ -93,9 +125,7 @@ class ReplicaStats:
                 self.latencies_ms[j] = latency_ms
 
     def percentile(self, q: float) -> float:
-        if not self.latencies_ms:
-            return 0.0
-        return float(np.percentile(np.asarray(self.latencies_ms), q))
+        return _percentile(self.latencies_ms, q)
 
     def snapshot(self) -> dict:
         elapsed = max(time.monotonic() - self.started_sec, 1e-9)
@@ -104,22 +134,87 @@ class ReplicaStats:
             "shed": self.shed,
             "completed": self.completed,
             "deadline_miss": self.deadline_miss,
+            "batches": self.batches,
             "p50_ms": round(self.percentile(50), 4),
             "p99_ms": round(self.percentile(99), 4),
             "throughput_rps": round(self.completed / elapsed, 3),
         }
 
 
+class HvtServingEngine:
+    """The default engine seam: the real eager engine through
+    collective_ops, with one registered :class:`ProcessSet` per member
+    list (PR 6's lanes). Anything with the same five methods can stand
+    in — the soak's MiniEngine adapter does, jax/numpy-free."""
+
+    def __init__(self):
+        from horovod_tpu.common import basics
+
+        self._basics = basics
+        self._sets = {}
+
+    def rank(self) -> int:
+        return self._basics.rank()
+
+    def size(self) -> int:
+        return self._basics.size()
+
+    def _lane(self, members):
+        from horovod_tpu.common.process_sets import (ProcessSet,
+                                                     add_process_set)
+
+        key = tuple(members)
+        ps = self._sets.get(key)
+        if ps is None:
+            ps = add_process_set(ProcessSet(list(members)))
+            self._sets[key] = ps
+        return ps
+
+    def _op(self, op):
+        from horovod_tpu.ops import collective_ops as co
+
+        return {"sum": co.Sum, "avg": co.Average, "min": co.Min,
+                "max": co.Max, "prod": co.Product,
+                "adasum": co.Adasum}[op]
+
+    def submit(self, name, tensor, members, op="sum"):
+        from horovod_tpu.ops.collective_ops import allreduce_async
+
+        return allreduce_async(tensor, op=self._op(op), name=name,
+                               process_set=self._lane(members))
+
+    def submit_batch(self, name, tensors, members, op="sum"):
+        """One fused lane submission for a whole request batch: the
+        engine negotiates the group atomically and ``FuseResponses``
+        merges it into ONE collective (the fusion path serving never
+        fed before request-level batching)."""
+        from horovod_tpu.ops.collective_ops import grouped_allreduce_async
+
+        return grouped_allreduce_async(tensors, op=self._op(op),
+                                       name=name,
+                                       process_set=self._lane(members))
+
+    def wait(self, handle, timeout=None):
+        if timeout is None:
+            return handle.wait()
+        return handle.wait(timeout=timeout)
+
+
 class ReplicaGang:
     """Partition the world into replica lanes and serve requests onto
     this rank's lane. See the module docstring for the semantics."""
 
-    def __init__(self, num_replicas: int, admission_timeout: float = None,
-                 max_backlog: int = None, name: str = "serve"):
-        from horovod_tpu.common import basics
+    # decision-log cap: the (admitted, shed, batch-boundary) tuple
+    # sequence is the cross-member determinism probe; past the cap the
+    # counters in `stats` remain exact while the log stops growing
+    DECISION_LOG_CAP = 65536
 
-        self._rank = basics.rank()
-        self._world = basics.size()
+    def __init__(self, num_replicas: int, admission_timeout: float = None,
+                 max_backlog: int = None, name: str = "serve",
+                 batch_window: int = None, engine=None, partition=None):
+        self._eng = engine if engine is not None else HvtServingEngine()
+        self._rank = self._eng.rank()
+        self._world = self._eng.size()
         self.num_replicas = num_replicas
         self.name = name
         if admission_timeout is None:
@@ -128,95 +223,209 @@ class ReplicaGang:
         if max_backlog is None:
             max_backlog = int(
                 os.environ.get("HVT_SERVING_MAX_BACKLOG", "32"))
+        if batch_window is None:
+            batch_window = int(os.environ.get("HVT_SERVING_BATCH", "1"))
         self.admission_timeout = admission_timeout
         self.max_backlog = max_backlog
+        self.batch_window = max(1, int(batch_window))
 
-        ranks = partition_replicas(self._world, num_replicas)
-        self.replicas = [add_process_set(ProcessSet(r)) for r in ranks]
+        # partition: an explicit list of member-rank lists (one per
+        # replica) for non-contiguous tenant shapes — the mixed-tenant
+        # soak's "column" lanes stride across hosts so every rank
+        # serves one row lane AND one column lane (sharing exactly one
+        # rank with each crossing lane, which is what the per-lane
+        # execution pool isolates). Must cover the world disjointly and
+        # be identical on every rank.
+        if partition is not None:
+            ranks = [sorted(int(x) for x in g) for g in partition]
+            if len(ranks) != num_replicas:
+                raise ValueError(
+                    f"partition has {len(ranks)} groups for "
+                    f"num_replicas={num_replicas}")
+            flat = sorted(x for g in ranks for x in g)
+            if flat != list(range(self._world)):
+                raise ValueError(
+                    f"partition must cover ranks 0..{self._world - 1} "
+                    f"disjointly, got {flat}")
+        else:
+            ranks = partition_replicas(self._world, num_replicas)
+        self.member_lists = ranks
+        self.replica_id = next(
+            i for i, r in enumerate(ranks) if self._rank in r)
+        self.my_members = ranks[self.replica_id]
         # cross-replica sync lane: the first rank of every replica (the
         # replica "leaders"); with one replica it degenerates to that
         # replica itself. Parameter refreshes / cache invalidations flow
         # here without touching the serving lanes.
-        leaders = sorted(r[0] for r in ranks)
-        self.sync_set = (self.replicas[0] if num_replicas == 1
-                         else add_process_set(ProcessSet(leaders)))
-        self.replica_id = next(
-            i for i, r in enumerate(ranks) if self._rank in r)
-        self.my_replica = self.replicas[self.replica_id]
+        self.sync_members = (self.my_members if num_replicas == 1
+                             else sorted(r[0] for r in ranks))
 
-        self._inflight = []  # [(seq, handle, submit_t)], oldest first
-        self._seq = 0
+        self._inflight = []  # [[first_seq, handle, [(seq, t)], n]]
+        self._batch = []     # [(seq, tensor, t)] queued, unflushed
+        self._seq = 0        # admitted-request counter (names)
+        self._req_idx = 0    # every submit_request call (decision log)
+        self._bseq = 0       # flushed-slot counter (batch names)
         self._sync_seq = 0
         self.stats = ReplicaStats()
+        # the aligned decision history: ("admit", req_idx) /
+        # ("shed", req_idx) / ("batch", first_seq, n_requests) — every
+        # member of a replica must produce the identical sequence
+        self.decisions = []
 
     # ------------------------------------------------------------ serving
 
+    def _note(self, *tup):
+        if len(self.decisions) < self.DECISION_LOG_CAP:
+            self.decisions.append(tup)
+
+    def _inflight_requests(self) -> int:
+        return sum(slot[3] for slot in self._inflight)
+
     def backlog(self) -> int:
-        return len(self._inflight)
+        """Requests occupying the window: in flight + queued batch."""
+        return self._inflight_requests() + len(self._batch)
 
     def submit_request(self, tensor, op=None):
         """Admit one request onto this rank's replica lane.
 
-        Returns the async handle, or ``None`` when the in-flight window
-        is full and the request was shed. Both outcomes are pure
+        Returns the async handle when the request was submitted (or
+        flushed a full batch), ``True`` when it was admitted into a
+        still-open batch, and ``None`` when the in-flight window was
+        full and the request was shed. All three outcomes are pure
         functions of the aligned call history, so every member of the
         replica takes the same branch for the same request index.
         """
-        from horovod_tpu.ops.collective_ops import Sum, allreduce_async
-
-        if len(self._inflight) >= self.max_backlog:
+        idx = self._req_idx
+        self._req_idx += 1
+        if self.backlog() >= self.max_backlog:
             self.stats.shed += 1
+            self._note("shed", idx)
             return None
         seq = self._seq
         self._seq += 1
-        # Cycle request names over 2x the window: slot seq-2W was reaped
-        # (hence released from the engine's pending table) before this
-        # submit could be admitted, so the name is free — and a REUSED
-        # name with identical params is a response-cache hit on the
-        # replica's lane, which is what lets steady-state serving skip
-        # negotiation entirely (the per-set-lane engine rework).
-        slot = seq % (2 * self.max_backlog)
-        h = allreduce_async(
-            tensor, op=op or Sum,
-            name=f"{self.name}.r{self.replica_id}.{slot}",
-            process_set=self.my_replica)
-        self._inflight.append((seq, h, time.monotonic()))
         self.stats.admitted += 1
+        self._note("admit", idx)
+        opname = self._opname(op)
+        if self.batch_window <= 1:
+            # unbatched fast path — the PR 6 wire shape exactly.
+            # Cycle request names over 2x the window: slot seq-2W was
+            # reaped (hence released from the engine's pending table)
+            # before this submit could be admitted, so the name is free
+            # — and a REUSED name with identical params is a
+            # response-cache hit on the replica's lane, which is what
+            # lets steady-state serving skip negotiation entirely (the
+            # per-set-lane engine rework).
+            slot = seq % (2 * self.max_backlog)
+            h = self._eng.submit(
+                f"{self.name}.r{self.replica_id}.{slot}", tensor,
+                self.my_members, op=opname)
+            now = time.monotonic()
+            self._inflight.append([seq, h, [(seq, now)], 1])
+            self.stats.batches += 1
+            self._note("batch", seq, 1)
+            return h
+        # a reduce-op change closes the open batch: one fused submission
+        # carries one op, and the op sequence is part of the aligned
+        # call history, so this boundary is member-identical too
+        if self._batch and self._batch[0][3] != opname:
+            self._flush()
+        self._batch.append((seq, tensor, time.monotonic(), opname))
+        if len(self._batch) >= self.batch_window:
+            return self._flush()
+        return True
+
+    def _opname(self, op):
+        """Canonical lowercase reduce-op name for the engine seam.
+        collective_ops ReduceOp instances map by their .name; an op the
+        seam cannot express raises instead of silently riding as sum
+        (Average coerced to sum would inflate results by the lane
+        size with no error)."""
+        if op is None:
+            return "sum"
+        name = op if isinstance(op, str) else getattr(
+            op, "name", getattr(op, "__name__", str(op)))
+        name = str(name).lower()
+        name = {"average": "avg", "product": "prod"}.get(name, name)
+        if name not in ("sum", "avg", "min", "max", "prod", "adasum"):
+            raise ValueError(f"unsupported serving reduce op: {op!r}")
+        return name
+
+    def flush(self):
+        """Flush the open batch (if any) as one fused lane submission.
+        Part of the aligned call history — call it at the same point in
+        every member's request stream."""
+        return self._flush()
+
+    def _flush(self):
+        if not self._batch:
+            return None
+        batch, self._batch = self._batch, []
+        first_seq = batch[0][0]
+        n = len(batch)
+        # batch slots cycle over 2x max_backlog, same name-reuse
+        # argument as the unbatched path (groups renegotiate as a unit,
+        # so this is about engine name uniqueness, not cache). The
+        # bound must assume ONE request per slot: partial flushes
+        # (reap-with-only-queued-work, op change, explicit flush())
+        # allow up to max_backlog single-request slots in flight, so a
+        # tighter ceil(backlog/window) cycle could resubmit a name
+        # whose prior submission is still pending in the engine
+        bslot = self._bseq % (2 * self.max_backlog)
+        self._bseq += 1
+        opname = batch[0][3]
+        h = self._eng.submit_batch(
+            f"{self.name}.r{self.replica_id}.b{bslot}",
+            [t for _, t, _, _ in batch], self.my_members, op=opname)
+        self._inflight.append(
+            [first_seq, h, [(s, t0) for s, _, t0, _ in batch], n])
+        self.stats.batches += 1
+        self._note("batch", first_seq, n)
         return h
 
     def reap(self):
-        """Wait out the oldest in-flight request against its admission
-        deadline; record its latency and whether it met the SLO.
-        Returns the request's result, or ``None`` with an empty window.
+        """Wait out the oldest in-flight slot against its admission
+        deadline; record each request's latency and whether it met the
+        SLO. Returns the slot's result (the single request's result
+        unbatched; the list of per-request results for a batch), or
+        ``None`` with an empty window.
 
-        The deadline runs from ADMISSION (submit time), not from this
-        call: a request that sat in the window past its budget is a
-        miss even when the wait itself returns instantly. The deadline
-        is an SLO, not a cancellation — every member already submitted
-        the collective, so it WILL complete and must be drained
-        unbounded to keep the window aligned."""
+        The deadline runs from each request's ADMISSION (submit time),
+        not from this call: a request that sat in the window — or in an
+        open batch — past its budget is a miss even when the wait
+        itself returns instantly. The deadline is an SLO, not a
+        cancellation — every member already submitted the collective,
+        so it WILL complete and must be drained unbounded to keep the
+        window aligned. A reap with nothing in flight flushes the open
+        batch first (a pure function of the call history)."""
         if not self._inflight:
-            return None
-        seq, h, t0 = self._inflight.pop(0)
-        met = True
-        budget = self.admission_timeout - (time.monotonic() - t0)
+            if not self._batch:
+                return None
+            self._flush()
+        first_seq, h, reqs, n = self._inflight.pop(0)
+        del first_seq
+        budget = self.admission_timeout - (time.monotonic() - reqs[0][1])
         try:
             if budget <= 0:
-                met = False
-                out = h.wait()
+                out = self._eng.wait(h)
             else:
-                out = h.wait(timeout=budget)
+                out = self._eng.wait(h, timeout=budget)
         except HorovodTimeoutError:
-            met = False
-            out = h.wait()
-        latency_ms = (time.monotonic() - t0) * 1e3
-        if latency_ms > self.admission_timeout * 1e3:
-            met = False
-        self.stats.observe(latency_ms, met)
+            out = self._eng.wait(h)
+        now = time.monotonic()
+        del n
+        for _seq, t0 in reqs:
+            latency_ms = (now - t0) * 1e3
+            # each request's SLO runs from ITS OWN submit time: a
+            # slot-level wait timeout means the OLDEST request blew its
+            # budget, not that batch-mates admitted later (whose own
+            # latency may be well inside the deadline) missed too
+            met = latency_ms <= self.admission_timeout * 1e3
+            self.stats.observe(latency_ms, met)
         return out
 
     def drain(self):
         """Reap every outstanding request (end-of-stream flush)."""
+        self._flush()
         while self._inflight:
             self.reap()
 
@@ -224,22 +433,22 @@ class ReplicaGang:
         """Cross-replica sync over the leader set (parameter refresh /
         eviction broadcast analog). Only leaders participate; other
         ranks return the input unchanged."""
-        from horovod_tpu.ops.collective_ops import Average, allreduce
-
-        if not self.sync_set.included():
+        if self._rank not in self.sync_members:
             return tensor
         self._sync_seq += 1
-        return allreduce(tensor, op=op or Average,
-                         name=f"{self.name}.sync.{self._sync_seq}",
-                         process_set=self.sync_set)
+        h = self._eng.submit(f"{self.name}.sync.{self._sync_seq}",
+                             tensor, self.sync_members,
+                             op="avg" if op is None else self._opname(op))
+        return self._eng.wait(h)
 
     # ---------------------------------------------------------- telemetry
 
     def snapshot(self) -> dict:
         s = self.stats.snapshot()
         s.update(rank=self._rank, replica=self.replica_id,
-                 inflight=len(self._inflight),
+                 inflight=self.backlog(),
                  max_backlog=self.max_backlog,
+                 batch_window=self.batch_window,
                  admission_ms=self.admission_timeout * 1e3,
                  # wall-clock stamp — informational, and it guarantees
                  # every push CHANGES the payload, which is how the

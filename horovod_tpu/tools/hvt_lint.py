@@ -268,7 +268,9 @@ def check_slots(root: Path):
                                    "STATS_TAIL_SCALARS", "WIRE_CODECS",
                                    "STATS_EF_SCALARS",
                                    "STATS_LINK_PLANES",
-                                   "STATS_RECOVERY_SCALARS"})
+                                   "STATS_RECOVERY_SCALARS",
+                                   "STATS_LANE_POOL_SCALARS",
+                                   "STATS_LANE_HOL_GROUPS"})
     missing = [k for k in ("STATS_SCALARS", "STATS_OPS",
                            "STATS_LAT_BUCKETS", "ABORT_CAUSES")
                if k not in consts]
@@ -287,6 +289,12 @@ def check_slots(root: Path):
     # optional on the same both-sides terms as the codec block
     planes = list(consts.get("STATS_LINK_PLANES", ()) or ())
     recovery = list(consts.get("STATS_RECOVERY_SCALARS", ()) or ())
+    # per-lane execution pool block (appended after the recovery
+    # scalars) — optional on the same both-sides terms as the others
+    lane_pool = list(consts.get("STATS_LANE_POOL_SCALARS", ()) or ())
+    # per-lane head-of-line block (appended after the pool scalars) —
+    # optional on the same both-sides terms as the others
+    lane_hol = list(consts.get("STATS_LANE_HOL_GROUPS", ()) or ())
     expected = list(consts["STATS_SCALARS"])
     for grp in SLOT_OP_GROUPS:
         expected += [f"{grp}[{op}]" for op in consts["STATS_OPS"]]
@@ -306,6 +314,9 @@ def check_slots(root: Path):
     expected += ef
     expected += [f"link_reconnects[{p}]" for p in planes]
     expected += recovery
+    expected += lane_pool
+    for grp in lane_hol:
+        expected += [f"{grp}[{i}]" for i in range(lane_slots)]
     if names != expected:
         diffs = [i for i, (a, b) in enumerate(zip(names, expected))
                  if a != b]
@@ -331,6 +342,18 @@ def check_slots(root: Path):
     c_ef = _c_int_const(c_api, "kStatsEfScalars") or 0
     c_planes = _c_int_const(c_api, "kStatsLinkPlanes") or 0
     c_recovery = _c_int_const(c_api, "kStatsRecoveryScalars") or 0
+    c_lane_pool = _c_int_const(c_api, "kStatsLanePoolScalars") or 0
+    if c_lane_pool != len(lane_pool):
+        vios.append(
+            f"slots: {C_API_CC} kStatsLanePoolScalars={c_lane_pool} but "
+            f"{NATIVE_PY} STATS_LANE_POOL_SCALARS has {len(lane_pool)} "
+            f"entries — the lane-pool scalar block would decode shifted")
+    c_lane_hol = _c_int_const(c_api, "kStatsLaneHolGroups") or 0
+    if c_lane_hol != len(lane_hol):
+        vios.append(
+            f"slots: {C_API_CC} kStatsLaneHolGroups={c_lane_hol} but "
+            f"{NATIVE_PY} STATS_LANE_HOL_GROUPS has {len(lane_hol)} "
+            f"entries — the head-of-line block would decode shifted")
     if c_planes != len(planes):
         vios.append(
             f"slots: {C_API_CC} kStatsLinkPlanes={c_planes} but "
@@ -370,7 +393,8 @@ def check_slots(root: Path):
                    + len(SLOT_HISTS) * (lat + 1 + 2) + causes
                    + (1 + len(SLOT_LANE_GROUPS) * c_lanes
                       if c_lanes else 0) + c_tail
-                   + c_codecs * ops + c_ef + c_planes + c_recovery)
+                   + c_codecs * ops + c_ef + c_planes + c_recovery
+                   + c_lane_pool + c_lane_hol * c_lanes)
         if declared is not None and c_count != declared:
             vios.append(
                 f"slots: {C_API_CC}: C++ layout emits {c_count} slots "
@@ -403,6 +427,8 @@ def check_slots(root: Path):
     if planes:
         claimed += ["link_reconnects"]
     claimed += recovery
+    claimed += lane_pool
+    claimed += lane_hol
     for key in claimed:
         if f'"{key}"' not in basics:
             vios.append(

@@ -122,11 +122,26 @@ def render(statusz: dict, now_str: str = None) -> str:
             for d in stragglers[:5])
         lines.append(f"stragglers: {top}")
     serving = s.get("serving") or {}
-    if serving.get("ranks"):
+    if serving.get("ranks") or serving.get("stale_ranks"):
+        # stale entries (dead/shed ranks whose final push is aging out
+        # of the swept KV) are shown as stale, never as live lanes
+        stale = serving.get("stale_ranks", 0)
         lines.append(
-            f"serving: {serving['ranks']} rank(s), backlog max "
-            f"{serving.get('inflight_max', 0)}, sheds "
+            f"serving: {serving.get('ranks', 0)} rank(s)"
+            + (f" (+{stale} stale)" if stale else "")
+            + f", backlog max {serving.get('inflight_max', 0)}, sheds "
             f"{serving.get('shed_total', 0)}")
+        lanes = serving.get("lanes") or {}
+        if lanes:
+            row = ", ".join(
+                f"lane {lid}: p99 {d.get('p99_ms_max', 0):.1f}ms "
+                f"bkl {d.get('inflight_max', 0)}"
+                for lid, d in sorted(
+                    lanes.items(),
+                    key=lambda kv: (0, int(kv[0]))
+                    if str(kv[0]).lstrip("-").isdigit()
+                    else (1, str(kv[0])))[:6])
+            lines.append(f"  {row}")
     missing = s.get("missing_ranks") or []
     if missing:
         shown = ",".join(str(r) for r in missing[:16])

@@ -83,6 +83,7 @@ _SLOW_MODULES = {
     "test_flash_attention",      # pallas interpret mode is slow on CPU
     "test_sequence_parallel",    # ring/ulysses 8-device compiles
     "test_serving",              # 4-proc serving gangs + loadgen replay
+    "test_serving_soak",         # mixed-tenant MiniEngine soak smoke
     "test_models",               # GPT/ResNet init + flash paths
     "test_sanitizers",           # TSAN/ASAN rebuilds
     "test_self_healing",         # reconnect/replay chaos gangs

@@ -129,6 +129,62 @@ def test_build_replica_groups_cross_host():
         assert len({hosts[r] for r in g}) == 2, f"group {g} same-host"
 
 
+def test_build_replica_groups_rack_aware_placement():
+    """Topology-weighted planning (ROADMAP 5b): with a rack dimension
+    in HVT_TOPO_HOST ("rack/host"), groups prefer same-rack peers on
+    DIFFERENT hosts — replication stays rack-local while a host
+    SIGKILL can never take a lineage and all of its replicas."""
+    hosts = ["r0/h0", "r0/h0", "r0/h1", "r0/h1",
+             "r1/h2", "r1/h2", "r1/h3", "r1/h3"]
+    groups = build_replica_groups(hosts, 2)
+    assert sorted(r for g in groups for r in g) == list(range(8))
+    for g in groups:
+        assert len(g) == 2
+        # the SIGKILL safety: never two members on one host
+        assert len({hosts[r] for r in g}) == 2, f"group {g} same-host"
+        # the rack preference: both members in one rack
+        assert len({hosts[r].split("/")[0] for r in g}) == 1, \
+            f"group {g} crosses racks"
+
+    # a rack with a single host cannot satisfy cross-host placement
+    # alone — its ranks pool globally and still land cross-host
+    hosts2 = ["r0/h0", "r0/h0", "r1/h1", "r1/h1"]
+    groups2 = build_replica_groups(hosts2, 2)
+    assert sorted(r for g in groups2 for r in g) == list(range(4))
+    for g in groups2:
+        assert len({hosts2[r] for r in g}) == 2, f"group {g} same-host"
+
+    # no rack separator anywhere → exactly the flat-topology plan
+    flat = ["h0", "h0", "h1", "h1", "h2", "h2", "h3", "h3"]
+    assert build_replica_groups(flat, 2) == \
+        [[0, 2], [4, 6], [1, 3], [5, 7]]
+
+
+def test_build_replica_groups_skewed_hosts_stay_cross_host():
+    """Host-count skew folds round-robin chunks onto one host (three
+    ranks on h0 + one on h1 interleave to [0,3,1,2]; chunk [1,2] is
+    all-h0) — such a chunk must never be kept as a replica group while
+    a cross-host group exists to absorb its ranks, or a host SIGKILL
+    takes a lineage and all of its replicas."""
+    # rack form: r0 is skewed 3:1, r1 balanced
+    hosts = ["r0/h0", "r0/h0", "r0/h0", "r0/h1",
+             "r1/h2", "r1/h2", "r1/h3", "r1/h3"]
+    groups = build_replica_groups(hosts, 2)
+    assert sorted(r for g in groups for r in g) == list(range(8))
+    for g in groups:
+        assert len({hosts[r] for r in g}) > 1, f"group {g} same-host"
+
+    # flat form with the same skew
+    flat = ["h0", "h0", "h0", "h1"]
+    for g in build_replica_groups(flat, 2):
+        assert len({flat[r] for r in g}) > 1, f"group {g} same-host"
+
+    # single-host world: nowhere cross-host to spill — groups are
+    # kept (within-host replication beats none)
+    one = build_replica_groups(["h0"] * 4, 2)
+    assert sorted(r for g in one for r in g) == list(range(4))
+
+
 def test_build_replica_groups_remainder_and_clamp():
     # 5 ranks, k=2: the trailing singleton merges into its predecessor
     groups = build_replica_groups(["h0", "h1", "h2", "h0", "h1"], 2)
@@ -389,14 +445,55 @@ def test_stale_shard_version_rejected():
     assert s.replica_info()["held"][peer_owner] == good_versions
 
 
-def test_crc_mismatch_falls_back_to_application_restore():
-    """A corrupt replica sends the WHOLE gang to the application
-    restore: one rank reloading its checkpoint alone would leave the
-    gang at a mixed step cut, so the fallback outcome propagates
-    through the sync consensus and every rank restores together."""
+def test_crc_mismatch_falls_back_per_lineage():
+    """Per-lineage blast radius (ROADMAP 5d): a corrupt replica sends
+    ONLY the lost lineage to the application restore — intact lineages
+    keep their peer-rebuilt state at the cut, and the fallback ranks
+    surface in last_recovery["fallback_ranks"] on every member."""
     states = _committed_gang(steps=2)
     # corrupt owner 3's shard everywhere it is held, then replace rank
     # 3 with a fresh spawn; every rank has an application fallback
+    for s in states:
+        gens = s._peer_shards.get(3)
+        if gens:
+            s._peer_shards[3] = [
+                (v, b[:-1] + bytes([b[-1] ^ 0xFF])) for v, b in gens]
+    w2 = _ThreadWorld(4)
+    fellback = []
+
+    def fallback(st):
+        fellback.append(True)
+        st.x = 99
+        st.series = ["from-checkpoint"]
+
+    fresh = ReplicatedState(collectives=w2.collectives(3, _HOSTS4[3]),
+                            fallback=fallback, x=0, series=[])
+    survivors_x = [states[r].x for r in range(3)]
+    for s in states:
+        s._fallback = fallback
+
+    def resync(r):
+        if r == 3:
+            fresh.sync()
+        else:
+            states[r]._collectives = w2.collectives(r, _HOSTS4[r])
+            states[r].sync()
+
+    _gang(resync, 4)
+    assert len(fellback) == 1              # ONLY the lost lineage
+    assert fresh.x == 99
+    assert fresh.last_recovery["outcome"] == "fallback"
+    for r, s in enumerate(states[:3]):
+        assert s.x == survivors_x[r]       # peer-rebuilt state kept
+        assert s.last_recovery["fallback_ranks"] == [3]
+
+
+def test_crc_mismatch_gang_wide_fallback_when_disabled(monkeypatch):
+    """HVT_PARTIAL_FALLBACK=0 restores the pre-r15 all-or-nothing
+    semantics: one lost lineage sends EVERY rank to the application
+    restore together (gang-replicated application state)."""
+    monkeypatch.setenv("HVT_PARTIAL_FALLBACK", "0")
+    states = _committed_gang(steps=2)
     for s in states:
         gens = s._peer_shards.get(3)
         if gens:
@@ -425,9 +522,53 @@ def test_crc_mismatch_falls_back_to_application_restore():
     _gang(resync, 4)
     assert len(fellback) == 4              # the gang restores TOGETHER
     assert fresh.x == 99
-    assert fresh.last_recovery["outcome"] == "fallback"
     for s in states[:3]:
         assert s.x == 99
+
+
+def test_partial_loss_two_lineages_fallback_only_those():
+    """The satellite pin: TWO lineages lose every intact replica; both
+    (and only both) checkpoint-restore while the intact lineages
+    re-enter peer rebuild — member-identical fallback_ranks everywhere."""
+    states = _committed_gang(steps=3)
+    for s in states:
+        for owner in (2, 3):
+            gens = s._peer_shards.get(owner)
+            if gens:
+                s._peer_shards[owner] = [
+                    (v, b[:-1] + bytes([b[-1] ^ 0xFF])) for v, b in gens]
+    w2 = _ThreadWorld(4)
+    fellback = []
+
+    def fallback(st):
+        fellback.append(True)
+        st.x = 77
+        st.series = ["from-checkpoint"]
+
+    fresh = {
+        r: ReplicatedState(collectives=w2.collectives(r, _HOSTS4[r]),
+                           fallback=fallback, x=0, series=[])
+        for r in (2, 3)}
+    survivors_x = {r: states[r].x for r in (0, 1)}
+    for s in states:
+        s._fallback = fallback
+
+    def resync(r):
+        if r in fresh:
+            fresh[r].sync()
+        else:
+            states[r]._collectives = w2.collectives(r, _HOSTS4[r])
+            states[r].sync()
+
+    _gang(resync, 4)
+    assert len(fellback) == 2              # exactly the lost lineages
+    for r in (2, 3):
+        assert fresh[r].x == 77
+        assert fresh[r].last_recovery["outcome"] == "fallback"
+        assert fresh[r].last_recovery["fallback_ranks"] == [2, 3]
+    for r in (0, 1):
+        assert states[r].x == survivors_x[r]
+        assert states[r].last_recovery["fallback_ranks"] == [2, 3]
 
 
 def test_replica_unavailable_without_fallback_raises():

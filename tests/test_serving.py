@@ -128,6 +128,110 @@ def test_replica_gang_shed_is_deterministic_single_proc():
     assert strict.stats.deadline_miss == 3
 
 
+class _RecordingEngine:
+    """Single-rank engine seam stub recording every submission name/op;
+    handles complete instantly with the input (world of one)."""
+
+    def __init__(self):
+        self.submits = []  # (name, n_tensors, op)
+
+    def rank(self):
+        return 0
+
+    def size(self):
+        return 1
+
+    def submit(self, name, tensor, members, op="sum"):
+        self.submits.append((name, 1, op))
+        return [tensor]
+
+    def submit_batch(self, name, tensors, members, op="sum"):
+        self.submits.append((name, len(tensors), op))
+        return list(tensors)
+
+    def wait(self, handle, timeout=None):
+        return handle if len(handle) > 1 else handle[0]
+
+
+def test_batch_op_change_closes_the_open_batch():
+    """A fused batch submission carries ONE reduce op: a request with a
+    different op must close the open batch first (an aligned-history
+    boundary, so members stay in lockstep) instead of silently riding
+    the first request's op."""
+    from horovod_tpu.serving import ReplicaGang
+
+    eng = _RecordingEngine()
+    gang = ReplicaGang(1, admission_timeout=1.0, max_backlog=8,
+                       batch_window=4, engine=eng)
+    x = np.ones(8, np.float32)
+    gang.submit_request(x, op="sum")
+    gang.submit_request(x, op="sum")
+    gang.submit_request(x, op="avg")   # boundary: flushes the 2 sums
+    gang.submit_request(x, op="avg")
+    gang.drain()
+    assert [(n, o) for _, n, o in eng.submits] == [(2, "sum"), (2, "avg")]
+    assert [d for d in gang.decisions if d[0] == "batch"] == \
+        [("batch", 0, 2), ("batch", 2, 2)]
+
+
+def test_opname_maps_reduce_ops_and_rejects_unknown():
+    """collective_ops ReduceOp INSTANCES (no __name__) must map by
+    their .name — Average silently coerced to "sum" would inflate
+    results by the lane size — and an op the seam cannot express
+    raises instead of riding as sum."""
+    from horovod_tpu.ops import collective_ops as co
+    from horovod_tpu.serving import ReplicaGang
+
+    gang = ReplicaGang(1, admission_timeout=1.0, max_backlog=4,
+                       engine=_RecordingEngine())
+    assert gang._opname(None) == "sum"
+    assert gang._opname("avg") == "avg"
+    assert gang._opname(co.Average) == "avg"
+    assert gang._opname(co.Sum) == "sum"
+    assert gang._opname(co.Min) == "min"
+    assert gang._opname(co.Max) == "max"
+    assert gang._opname(co.Product) == "prod"
+    with pytest.raises(ValueError, match="unsupported"):
+        gang._opname("xor")
+
+
+def test_batched_reap_slo_is_per_request():
+    """One slot-level wait timeout (the OLDEST request's blown budget)
+    must not mark batch-mates admitted later — whose own latency sits
+    inside the deadline — as misses too."""
+    from horovod_tpu.serving import ReplicaGang
+
+    gang = ReplicaGang(1, admission_timeout=0.05, max_backlog=8,
+                       batch_window=2, engine=_RecordingEngine())
+    x = np.ones(4, np.float32)
+    gang.submit_request(x)
+    time.sleep(0.08)             # first request blows its own budget
+    gang.submit_request(x)       # second flushes the batch, fresh clock
+    gang.drain()
+    assert gang.stats.completed == 2
+    assert gang.stats.deadline_miss == 1, gang.stats.deadline_miss
+
+
+def test_batch_slot_names_unique_across_partial_flush_window():
+    """Partial flushes (explicit flush()/op changes) can put up to
+    max_backlog single-request slots in flight at once — batch names
+    must not cycle back onto a slot that is still pending. Regression:
+    the cycle was 2*ceil(backlog/window), which collided from the
+    (2*ceil+1)th unreaped partial flush on."""
+    from horovod_tpu.serving import ReplicaGang
+
+    eng = _RecordingEngine()
+    gang = ReplicaGang(1, admission_timeout=1.0, max_backlog=8,
+                       batch_window=8, engine=eng)
+    x = np.ones(4, np.float32)
+    for _ in range(gang.max_backlog):   # fill the window, never reap
+        gang.submit_request(x)
+        gang.flush()
+    names = [n for n, _, _ in eng.submits]
+    assert len(names) == gang.max_backlog
+    assert len(set(names)) == len(names), names
+
+
 def test_replica_stats_reservoir_keeps_tracking_after_cap():
     """The latency reservoir must keep sampling the whole stream once
     full — a frozen early-life p99 would blind the SLO signal the
@@ -694,6 +798,154 @@ def test_concurrent_disjoint_sets_4proc():
                 (idle_p99, busy_p99)
     """, np_=4, timeout=240)
     assert "P99-RATIO" in out
+
+
+@needs_engine
+def test_batching_determinism_under_clock_skew():
+    """ISSUE 15 satellite: every replica member computes the identical
+    (admitted, shed, batch-boundary) tuple sequence under bursty load
+    even when HVT_FAULT_INJECT=delay_ms skews one member's clock — the
+    decisions are pure functions of the aligned call history, never of
+    timing — and the batched path's results are bit-identical to the
+    unbatched path's. Replicas are 2-wide, so fp32 addition is
+    commutative-exact and bitwise comparison is safe for arbitrary
+    floats."""
+    out = run_workers("""
+        import zlib
+        from horovod_tpu.ops.functions import allgather_object
+        from horovod_tpu.serving import ReplicaGang
+
+        rng = np.random.default_rng(7)
+        payloads = [rng.standard_normal(192).astype(np.float32)
+                    for _ in range(36)]
+
+        def drive(gang):
+            outs = []
+            k = 0
+            # bursty: 7 submits back-to-back (window 5 → sheds), then
+            # reap down; the SEQUENCE is identical on every member
+            while k < len(payloads):
+                for _ in range(min(7, len(payloads) - k)):
+                    gang.submit_request(payloads[k])
+                    k += 1
+                while gang.backlog() > 2:
+                    res = gang.reap()
+                    outs.extend(res if isinstance(res, list) else [res])
+            gang.flush()
+            while gang.backlog():
+                res = gang.reap()
+                outs.extend(res if isinstance(res, list) else [res])
+            return outs
+
+        batched = ReplicaGang(2, admission_timeout=2.0, max_backlog=5,
+                              batch_window=3, name="bd.b")
+        outs_b = drive(batched)
+        hvt.barrier()
+        unbatched = ReplicaGang(2, admission_timeout=2.0, max_backlog=5,
+                                batch_window=1, name="bd.u")
+        outs_u = drive(unbatched)
+        hvt.barrier()
+
+        # decision tuples member-identical (delay_ms skews rank 1's
+        # clock; see extra_env), batch boundaries included
+        recs = allgather_object(
+            {"rank": r, "replica": batched.replica_id,
+             "decisions": list(batched.decisions),
+             "admitted": batched.stats.admitted,
+             "shed": batched.stats.shed,
+             "batches": batched.stats.batches},
+            name="bd.gather")
+        if r == 0:
+            by_rep = {}
+            for rec in recs:
+                by_rep.setdefault(rec["replica"], []).append(rec)
+            for rep, members in by_rep.items():
+                base = members[0]
+                for mbr in members[1:]:
+                    assert mbr["decisions"] == base["decisions"], \
+                        (rep, mbr["rank"])
+                    assert (mbr["admitted"], mbr["shed"],
+                            mbr["batches"]) == (base["admitted"],
+                                                base["shed"],
+                                                base["batches"])
+            assert base["shed"] > 0, "burst 7 > window 5 must shed"
+            assert base["batches"] < base["admitted"], \
+                "batching must coalesce requests into fewer submissions"
+        # bit-identity: batched results == unbatched results, in order
+        assert len(outs_b) == len(outs_u) == batched.stats.completed
+        crc_b = zlib.crc32(b"".join(np.asarray(o).tobytes()
+                                    for o in outs_b))
+        crc_u = zlib.crc32(b"".join(np.asarray(o).tobytes()
+                                    for o in outs_u))
+        assert crc_b == crc_u, (crc_b, crc_u)
+        if r == 0:
+            print("BATCH-DETERMINISM-OK", flush=True)
+    """, np_=4, timeout=240,
+        extra_env={"HVT_FAULT_INJECT": "delay_ms:rank=1:20"})
+    assert "BATCH-DETERMINISM-OK" in out
+
+
+@needs_engine
+def test_lane_pool_parity_and_engagement():
+    """HVT_LANE_WORKERS A/B on a real 3-rank gang with two overlapping
+    lanes ({0,1} hot, {0,2} idle — they share only rank 0, so the pool
+    may run them concurrently): results are bit-identical to the
+    single-thread engine, and the pool actually executed tasks (the
+    isolation RATIO is pinned by benchmarks/serving_soak.py under
+    controlled load, not by this CI box)."""
+    body = """
+        import zlib
+        from horovod_tpu.common.process_sets import ProcessSet, add_process_set
+        from horovod_tpu.engine import native
+        from horovod_tpu.ops import collective_ops as C
+
+        laneA = add_process_set(ProcessSet([0, 1]))
+        laneB = add_process_set(ProcessSet([0, 2]))
+        crc = 0
+        for k in range(30):
+            hs = []
+            if r in (0, 1):
+                hs.append(C.allreduce_async(
+                    np.full(4096, np.float32(r + 1 + k % 3)), op=C.Sum,
+                    name=f"lp.a.{k % 6}", process_set=laneA))
+            if r in (0, 2):
+                hs.append(C.allreduce_async(
+                    np.full(64, np.float32(r + 2)), op=C.Sum,
+                    name=f"lp.b.{k % 6}", process_set=laneB))
+            for h in hs:
+                crc = zlib.crc32(np.asarray(C.synchronize(h)).tobytes(),
+                                 crc)
+        res = np.asarray(C.allreduce(np.float32([crc % 65521]),
+                                     op=C.Sum, name="lp.fin"))
+        st = native.engine_stats()
+        print(f"LANE-CRC rank={r} crc={crc} pool={st['lane_pool_tasks']}"
+              f" workers={st['lane_workers']}", flush=True)
+    """
+    env0 = {"HVT_LANE_WORKERS": "0", "HVT_SHM_ALLREDUCE": "0"}
+    env2 = {"HVT_LANE_WORKERS": "2", "HVT_SHM_ALLREDUCE": "0"}
+    out0 = run_workers(body, np_=3, timeout=240, extra_env=env0)
+    out2 = run_workers(body, np_=3, timeout=240, extra_env=env2)
+
+    def crcs(out):
+        found = {}
+        for line in out.splitlines():
+            if "LANE-CRC" not in line:
+                continue  # launcher prefixes "[rank] " to worker lines
+            fields = line[line.index("LANE-CRC"):].split()[1:]
+            parts = dict(p.split("=") for p in fields)
+            found[int(parts["rank"])] = (parts["crc"],
+                                         int(parts["pool"]),
+                                         int(parts["workers"]))
+        return found
+
+    c0, c2 = crcs(out0), crcs(out2)
+    assert set(c0) == set(c2) == {0, 1, 2}
+    for rank in c0:
+        assert c0[rank][0] == c2[rank][0], \
+            f"rank {rank}: pool changed results"  # bit-identical
+    assert all(v[1] == 0 and v[2] == 0 for v in c0.values())
+    assert all(v[2] == 2 for v in c2.values())
+    assert c2[0][1] > 0, "pool never engaged on the shared rank"
 
 
 @needs_engine

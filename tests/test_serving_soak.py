@@ -1,0 +1,192 @@
+"""Serving-soak harness tests (ISSUE 15 tentpole c).
+
+Quick units pin the lane plans, the op-count fault-arming formula, and
+the artifact claim gates on synthetic records; the slow-marked smoke
+runs the real 8-rank mixed-tenant soak (MiniEngine workers + chaos +
+host kill + autoscaler re-shard) — the same run ``ci.sh --servesoak``
+drives. The module is in conftest's slow list so tier-1 stays inside
+its window."""
+
+import copy
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks import serving_soak as ssk  # noqa: E402
+
+LIB = os.path.join(REPO, "horovod_tpu", "csrc", "build",
+                   "libhvt_core.so")
+needs_engine = pytest.mark.skipif(not os.path.exists(LIB),
+                                  reason="engine .so not built")
+
+
+def test_lane_partitions_share_exactly_one_rank():
+    """The mixed-tenant grid: any row lane and any column lane
+    intersect in exactly ONE rank — the shape the per-lane execution
+    pool can isolate (two or more shared ranks would share a socket
+    pair and must serialize)."""
+    rows = ssk.row_partition(64, 8)
+    cols = ssk.col_partition(64, 8)
+    assert sorted(r for g in rows for r in g) == list(range(64))
+    assert sorted(r for g in cols for r in g) == list(range(64))
+    for row in rows:
+        for col in cols:
+            assert len(set(row) & set(col)) == 1, (row, col)
+
+
+def test_fault_arming_lands_inside_its_phase():
+    spec = ssk._spec(smoke=True)
+    ssk._fill_fault_ops(spec)
+    f = spec["faults"]
+    assert f["flaky_after_ops"] > ssk._ops_before(spec, "fire")
+    fire_end = ssk._ops_before(spec, "fire") + \
+        2 * (spec["phases"]["fire"] // spec["batch"]) + 1
+    assert f["flaky_after_ops"] < fire_end
+    assert f["partition"]["after_ops"] > ssk._ops_before(spec, "storm")
+
+
+def _synthetic_record():
+    lanes = {
+        "row:0": {"tenant": "row", "members": [0, 1],
+                  "member_identical": True, "admitted": 48, "shed": 4,
+                  "batches": 12, "p99_ms_max": 2.0},
+        "col:0": {"tenant": "col", "members": [0, 2, 4, 6],
+                  "member_identical": True, "admitted": 48, "shed": 0,
+                  "batches": 12, "p99_ms_max": 2.0},
+    }
+
+    def phase(col_p99):
+        p = copy.deepcopy(lanes)
+        p["col:0"]["p99_ms_max"] = col_p99
+        return {"lanes": p,
+                "engine": {"aborts": 0, "pool_tasks": 10,
+                           "reconnects": 2, "lane_workers": 4},
+                "ranks": 8}
+
+    soak = {
+        "arm": "soak", "np": 8, "hosts": 4, "lane_workers": 4,
+        "phases": {"warm": phase(2.0), "baseline": phase(2.0),
+                   "fire": phase(2.2), "storm": phase(2.4)},
+        "alerts_by_phase": {
+            "fire": {"reconnect_storm": ["links"]},
+            "recovered": {"push_stale": ["rank 6", "rank 7"]}},
+        "killed_host": "h3", "world_after": 6,
+        "autoscaler_decisions": ["shed"],
+        "time_to_recovered_sec": 5.0,
+    }
+    iso_pool = copy.deepcopy(soak)
+    iso_pool["arm"] = "iso_pool"
+    iso_nopool = copy.deepcopy(soak)
+    iso_nopool["arm"] = "iso_nopool"
+    iso_nopool["phases"]["fire"] = phase(6.0)
+    return {
+        "schema": ssk.SCHEMA, "mode": "smoke",
+        "config": {"per_host": 2, "np": 8,
+                   "faults": {"flaky_rank": 3}},
+        "arms": {"soak": soak, "iso_pool": iso_pool,
+                 "iso_nopool": iso_nopool},
+        "claims": {
+            # the gated isolation pair: idle-lane exec-start overlap
+            # with the hot lane's open exec span, pool vs nopool (the
+            # nopool arm must be exactly 0 — single-thread engines
+            # cannot hold two spans open)
+            "idle_col_overlap_frac_pool": 0.8,
+            "idle_col_overlap_frac_nopool": 0.0,
+            "idle_col_hol_us_fire_pool": 40.0,
+            "idle_col_hol_us_fire_nopool": 900.0,
+            "nopool_hol_over_pool_hol": 22.5,
+            "hot_row_exec_us_fire_pool": 1000.0,
+            "hot_row_exec_us_fire_nopool": 1000.0,
+            # report-only wall-clock ratios
+            "idle_col_exec_fire_over_baseline_pool": 1.1,
+            "idle_col_exec_fire_over_baseline_nopool": 3.0,
+            "idle_col_p50_fire_over_baseline_pool": 1.1,
+            "idle_col_p50_fire_over_baseline_nopool": 1.9,
+            "nopool_over_pool": 1.7,
+            "idle_col_p99_fire_over_baseline_pool": 1.4,
+            "idle_col_p99_fire_over_baseline_nopool": 3.2,
+            "soak_col_exec_fire_over_baseline": 1.3,
+            "zero_aborts_transient": True,
+            "pool_engaged_tasks": 10,
+            "iso_pool_engaged_tasks": 10,
+            "member_identical_decisions": True,
+            "batching_coalesced": True,
+            "baseline_alert_rules": [],
+            "observed_alert_rules": ["push_stale", "reconnect_storm"],
+            "push_stale_subjects_killed_only": True,
+            "reconnect_storm_seen": True,
+            "push_stale_seen": True,
+            "autoscaler_shed": True,
+            "reshard_world": 6, "reshard_expected": 6,
+            "time_to_recovered_sec": 5.0,
+        },
+    }
+
+
+def test_check_passes_clean_record_and_fails_each_gate(capsys):
+    rec = _synthetic_record()
+    assert ssk.check_record(rec) == 0
+    for mutate, _why in (
+            (lambda r: r["claims"].__setitem__(
+                "idle_col_overlap_frac_pool", 0.05), "pool isolation"),
+            (lambda r: r["claims"].__setitem__(
+                "idle_col_overlap_frac_nopool", 0.2),
+             "nopool structural zero"),
+            (lambda r: r["claims"].__setitem__(
+                "nopool_hol_over_pool_hol", 1.2), "hol A/B bound"),
+            (lambda r: r["claims"].__setitem__(
+                "zero_aborts_transient", False), "aborts"),
+            (lambda r: r["claims"].__setitem__(
+                "member_identical_decisions", False), "identity"),
+            (lambda r: r["claims"].__setitem__(
+                "baseline_alert_rules", ["straggler"]), "clean gang"),
+            (lambda r: r["claims"].__setitem__(
+                "observed_alert_rules", ["weird_rule"]), "rule set"),
+            (lambda r: r["claims"].__setitem__(
+                "push_stale_subjects_killed_only", False), "subjects"),
+            (lambda r: r["claims"].__setitem__("reshard_world", 99),
+             "reshard"),
+            (lambda r: r["claims"].__setitem__("autoscaler_shed",
+                                               False), "autoscaler"),
+            (lambda r: r["arms"].pop("iso_nopool"), "arms"),
+    ):
+        bad = _synthetic_record()
+        mutate(bad)
+        assert ssk.check_record(bad) == 1, _why
+    # capture mode tightens the pool overlap floor 0.15 → 0.3 and the
+    # hol A/B bound 2x → 4x; the nopool structural zero stays exact in
+    # both modes
+    cap = _synthetic_record()
+    cap["mode"] = "capture"
+    assert ssk.check_record(cap) == 0
+    cap["claims"]["idle_col_overlap_frac_pool"] = 0.2
+    assert ssk.check_record(cap) == 1
+    cap = _synthetic_record()
+    cap["mode"] = "capture"
+    cap["claims"]["nopool_hol_over_pool_hol"] = 3.0
+    assert ssk.check_record(cap) == 1
+    capsys.readouterr()
+
+
+def test_committed_artifact_passes_check():
+    path = os.path.join(REPO, "benchmarks", "r15_serving_soak.json")
+    if not os.path.exists(path):
+        pytest.skip("committed r15 artifact not present")
+    assert ssk.check(path) == 0
+
+
+@pytest.mark.slow
+@needs_engine
+def test_serving_soak_smoke_end_to_end(tmp_path):
+    """The full 8-rank mixed-tenant soak (both arms): chaos, host
+    kill, re-shard, claims — the exact run ``ci.sh --servesoak``
+    gates on."""
+    out = tmp_path / "soak.json"
+    rec, rc = ssk.capture(str(out), smoke=True)
+    assert rc == 0, json.dumps(rec.get("claims"), indent=1)
+    assert ssk.check(str(out)) == 0

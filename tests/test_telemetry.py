@@ -459,6 +459,47 @@ def test_statusz_marks_stale_before_ttl_drops(monkeypatch):
         server.stop()
 
 
+def test_statusz_serving_excludes_ghost_lanes(monkeypatch):
+    """A dead rank's final serving snapshot (kept across round resets,
+    not yet TTL-swept) and an out-of-world rank id from a re-shard must
+    NOT feed the live backlog signal — the serving fold applies the
+    same last-write-timestamp staleness as the rank records, so
+    /statusz, hvt_top and the autoscaler's alert feed stop displaying
+    the ghost lane."""
+    monkeypatch.setenv("HVT_KV_TTL_SEC", "1000")
+    server, addr = _mk_server(np_=2)
+    try:
+        base = 1000.0
+        live = {"rank": 0, "replica": 0, "inflight": 2, "shed": 1,
+                "p99_ms": 3.0}
+        ghost = {"rank": 1, "replica": 1, "inflight": 99, "shed": 50,
+                 "p99_ms": 9.0}
+        shrunk = {"rank": 7, "replica": 3, "inflight": 88, "shed": 10,
+                  "p99_ms": 9.0}
+        server.store.put("serving", "0", json.dumps(live).encode(),
+                         now=base - 1)
+        server.store.put("serving", "1", json.dumps(ghost).encode(),
+                         now=base - 500)  # long dead, inside the TTL
+        server.store.put("serving", "7", json.dumps(shrunk).encode(),
+                         now=base - 1)    # fresh but outside the world
+        builder = T.StatuszBuilder(T.HealthEngine(alert_counter=False))
+        doc = builder.build(server.store, {"size": 2}, 1, now=base)
+        serving = doc["serving"]
+        assert serving["ranks"] == 1
+        assert serving["stale_ranks"] == 2
+        assert serving["inflight_max"] == 2      # ghost 99/88 excluded
+        assert serving["shed_total"] == 1
+        assert set(serving["lanes"]) == {"0"}    # only the live lane
+        assert serving["lanes"]["0"]["p99_ms_max"] == 3.0
+        # hvt_top renders the stale count, not a live ghost lane
+        from horovod_tpu.tools import hvt_top
+
+        text = hvt_top.render(doc)
+        assert "+2 stale" in text and "backlog max 2" in text
+    finally:
+        server.stop()
+
+
 # ---------------------------------------------------------- health engine
 
 def test_health_rules_fire_and_clear():
