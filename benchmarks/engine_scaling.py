@@ -28,6 +28,20 @@ claims (int8 ≥3.5x inter-host wire-byte reduction, per-codec relerr
 bounds, EF recovering the int8 convergence bias):
     python benchmarks/engine_scaling.py --codec [--quick] [--out r.json]
     python benchmarks/engine_scaling.py --check r.json
+
+Link-backend sweep (PR 18 artifact): transport-level full-duplex
+ping-pong through the exact PumpDuplex seam the engine uses
+(``hvt_transport_bench``), A/B'ing the io_uring data plane against the
+poll+sendmsg TCP baseline per payload size — p50/mean latency plus the
+measured syscalls-per-step column the io_uring plane exists to shrink.
+``--check`` dispatches on the artifact's ``harness`` field, so the same
+flag validates r09 and r18 artifacts:
+    python benchmarks/engine_scaling.py --uring [--quick] [--out r.json]
+    python benchmarks/engine_scaling.py --check benchmarks/r18_uring_sweep.json
+``--sweep`` additionally takes ``--link-backend {tcp,io_uring,both}``
+to pin (or A/B) the engine-level sweep's transport backend, and its
+per-size rows carry a syscalls-per-op column from the engine's pump
+counters.
 """
 
 from __future__ import annotations
@@ -70,6 +84,14 @@ CODEC_PLANES = {
     "int8": {"env": "none,int8", "tol": 5e-2},
     "fp8": {"env": "none,fp8", "tol": 2e-1},
 }
+
+# --uring payload BYTES per direction per step (the transport bench
+# moves raw bytes, not fp32 elements) and per-size step counts — 16 MB
+# full-duplex steps move 32 MB each, so fewer iterations suffice for a
+# stable median
+URING_SIZES = {"4KB": 4096, "64KB": 65536, "1MB": 1 << 20,
+               "16MB": 1 << 24}
+URING_ITERS = {"4KB": 400, "64KB": 300, "1MB": 100, "16MB": 20}
 
 
 def worker():
@@ -131,16 +153,25 @@ def sweep_worker():
 
     hvt.init()
     r = hvt.rank()
+    from horovod_tpu.engine import native
+
     sizes = json.loads(os.environ["HVT_BENCH_SIZES"])
     iters = int(os.environ.get("HVT_BENCH_ITERS", "30"))
     out = {}
     relerr = {}
+    syscalls_per_op = {}
     for label, numel in sizes.items():
         x = (np.arange(numel, dtype=np.float32) % 1001) * 0.5 + r
         # small payloads: more warmup + 5x the samples — µs-scale p50s
         # on a shared box are dominated by scheduler warmup otherwise
         small = numel <= (1 << 18)
         warmup, timed = (5, iters * 5) if small else (1, iters)
+        # per-size pump-syscall delta (local counters, rank 0's view):
+        # poll/sendmsg/recv from the generic loop plus io_uring_enter
+        # calls — the column the io_uring backend exists to shrink.
+        # Includes whatever CTRL-plane chatter lands inside the window,
+        # which is why it's quoted per-op, not as an absolute.
+        st0 = native.engine_stats() if r == 0 else None
         for _ in range(1 + warmup):
             hvt.allreduce(x, op=hvt.Sum, name=f"sweep.{label}")
         samples = []
@@ -172,13 +203,19 @@ def sweep_worker():
                 f"documented {inter or 'none'} bound {tol}")
         relerr[label] = err
         out[label] = sorted(samples)
+        if r == 0:
+            st1 = native.engine_stats()
+            ops = 1 + warmup + timed
+            delta = sum(st1.get(k, 0) - st0.get(k, 0)
+                        for k in ("pump_syscalls", "uring_enters"))
+            syscalls_per_op[label] = round(delta / ops, 1)
     if r == 0:
-        from horovod_tpu.engine import native
-
         st = native.engine_stats()
         print("HVT_BENCH_RESULT " + json.dumps(
             {"samples_s": out,
              "relerr": relerr,
+             "syscalls_per_op": syscalls_per_op,
+             "link_backend": st.get("link_backend", 0),
              "wire_tx_bytes": st.get("wire_tx_bytes", {}),
              "wire_tx_comp_bytes": st.get("wire_tx_comp_bytes", {}),
              "codec_tx_bytes": st.get("codec_tx_bytes", {})}),
@@ -232,6 +269,15 @@ def sweep_main():
     sizes = ({"4KB": 1 << 10, "16MB": 1 << 22} if quick
              else dict(SWEEP_SIZES))
     planes = dict(SWEEP_PLANES)
+    # --link-backend: pin every plane's transport backend, or "both" to
+    # collapse the sweep into a tcp-vs-io_uring A/B of the default plane
+    lb = argval("--link-backend", "")
+    if lb == "both":
+        planes = {"link_tcp": {"HVT_LINK_BACKEND": "tcp"},
+                  "link_io_uring": {"HVT_LINK_BACKEND": "io_uring"}}
+    elif lb:
+        planes = {p: dict(e, HVT_LINK_BACKEND=lb)
+                  for p, e in planes.items()}
     # optional: measure a pre-PR-3 libhvt_core.so (built from the seed
     # commit) through the same harness — the honest tentpole baseline,
     # since HVT_EVENT_DRIVEN/HVT_RING_PIPELINE only unwind part of it
@@ -247,6 +293,7 @@ def sweep_main():
     # samples spreads the drift across every plane alike.
     pooled = {p: {label: [] for label in sizes} for p in planes}
     by_round = {p: {label: [] for label in sizes} for p in planes}
+    sysc = {p: {label: [] for label in sizes} for p in planes}
     wire = {p: {} for p in planes}
     for rnd in range(rounds):
         for plane, extra in planes.items():
@@ -255,6 +302,9 @@ def sweep_main():
                 pooled[plane][label].extend(samples)
                 by_round[plane][label].append(
                     round(_pctl(sorted(samples), 0.50) * 1e3, 3))
+                spo = res.get("syscalls_per_op", {}).get(label)
+                if spo is not None:
+                    sysc[plane][label].append(spo)
             wire[plane] = {
                 "wire_tx_bytes": res.get("wire_tx_bytes", {}),
                 "wire_tx_comp_bytes": res.get("wire_tx_comp_bytes", {}),
@@ -280,16 +330,20 @@ def sweep_main():
                 "round_p50_ms": rounds_p50,
                 "best_p50_ms": min(rounds_p50),
             }
+            if sysc[plane][label]:
+                rows[label]["syscalls_per_op"] = sorted(
+                    sysc[plane][label])[len(sysc[plane][label]) // 2]
             print(json.dumps({"plane": plane, "size": label,
                               **rows[label]}), flush=True)
         record["planes"][plane] = {"env": extra, "sizes": rows,
                                    **wire[plane]}
-    print("\n| plane | size | p50 ms | p99 ms | GB/s |")
-    print("|---|---|---|---|---|")
+    print("\n| plane | size | p50 ms | p99 ms | GB/s | syscalls/op |")
+    print("|---|---|---|---|---|---|")
     for plane, pr in record["planes"].items():
         for label, row in pr["sizes"].items():
             print(f"| {plane} | {label} | {row['p50_ms']} | "
-                  f"{row['p99_ms']} | {row['gbps']} |")
+                  f"{row['p99_ms']} | {row['gbps']} | "
+                  f"{row.get('syscalls_per_op', '-')} |")
     if out_path:
         with open(out_path, "w") as f:
             json.dump(record, f, indent=1, sort_keys=True)
@@ -447,6 +501,237 @@ def codec_check(path):
     return 0
 
 
+def tbench_worker():
+    """HVT_TBENCH_ROLE mode: one side of the transport-level ping-pong.
+    Calls straight into ``hvt_transport_bench`` — no engine, no control
+    plane, just the PumpDuplex seam over a fresh socket pair."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from horovod_tpu.engine import native
+
+    role = int(os.environ["HVT_TBENCH_ROLE"])
+    res = native.transport_bench(
+        role, "127.0.0.1", int(os.environ["HVT_TBENCH_PORT"]),
+        int(os.environ["HVT_TBENCH_PAYLOAD"]),
+        int(os.environ["HVT_TBENCH_ITERS"]),
+        int(os.environ["HVT_TBENCH_BACKEND"]))
+    if res is None:
+        print("HVT_TBENCH_FAILED", flush=True)
+        sys.exit(3)
+    p50_ns, mean_ns, syscalls, steps = res
+    print("HVT_TBENCH_RESULT " + json.dumps(
+        {"role": role, "p50_ns": p50_ns, "mean_ns": mean_ns,
+         "syscalls": syscalls, "steps": steps}), flush=True)
+
+
+def run_tbench_cell(backend_id, payload, iters, port, repo):
+    """Spawn the listener (role 0) then the dialer (role 1) for one
+    (backend, payload) cell; returns role 0's result dict."""
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "HVT_TBENCH_PORT": str(port),
+        "HVT_TBENCH_PAYLOAD": str(payload),
+        "HVT_TBENCH_ITERS": str(iters),
+        "HVT_TBENCH_BACKEND": str(backend_id),
+    })
+    procs = []
+    for role in (0, 1):
+        e = dict(env, HVT_TBENCH_ROLE=str(role))
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=e, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+        if role == 0:
+            time.sleep(0.3)  # let the listener bind before the dial
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=600)
+        if p.returncode != 0:
+            for q in procs:
+                q.kill()
+            raise RuntimeError(
+                f"tbench backend={backend_id} payload={payload} "
+                f"port={port} failed:\n{out}\n{err}")
+        outs.append(out)
+    for line in outs[0].splitlines():
+        if line.startswith("HVT_TBENCH_RESULT "):
+            return json.loads(line.split(" ", 1)[1])
+    raise RuntimeError(f"no tbench result line:\n{outs[0]}")
+
+
+def uring_main():
+    """--uring: the PR 18 link-backend artifact. Transport-level
+    full-duplex ping-pong (hvt_transport_bench) per backend x payload,
+    medians over interleaved repetitions; claims are the per-size
+    syscall-reduction ratios plus latency/bandwidth parity bands —
+    the honest shape of the win on a host where turnaround latency is
+    scheduler-bound (see docs/performance.md §transport backends)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    from horovod_tpu.engine import native
+
+    quick = "--quick" in sys.argv
+
+    def argval(flag, dflt):
+        return (sys.argv[sys.argv.index(flag) + 1]
+                if flag in sys.argv else dflt)
+
+    out_path = argval("--out", "")
+    reps = int(argval("--reps", "3" if quick else "5"))
+    sizes = ({"4KB": URING_SIZES["4KB"], "16MB": URING_SIZES["16MB"]}
+             if quick else dict(URING_SIZES))
+    supported = native.uring_supported()
+    backends = [("tcp", 0)] + ([("io_uring", 1)] if supported else [])
+    if not supported:
+        print("uring: kernel probe failed — measuring tcp plane only",
+              flush=True)
+    record = {"harness": "r18 uring sweep r1", "reps": reps,
+              "host_cpus": os.cpu_count(),
+              "uring_supported": supported,
+              "payload_bytes": dict(sizes), "planes": {}}
+    cells = {name: {label: [] for label in sizes} for name, _ in backends}
+    port_base = 19000 + (os.getpid() % 400)
+    # interleave backends within each rep (same rationale as --sweep:
+    # machine-state drift must hit both planes alike)
+    for rep in range(reps):
+        for name, bid in backends:
+            for j, (label, payload) in enumerate(sizes.items()):
+                port = port_base + rep * 37 + bid * 13 + j
+                it = URING_ITERS[label] // (4 if quick else 1)
+                res = run_tbench_cell(bid, payload, it, port, repo)
+                cells[name][label].append(res)
+            print(f"rep {rep + 1}/{reps} plane {name} done", flush=True)
+
+    def med(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    for name, _ in backends:
+        rows = {}
+        for label, rs in cells[name].items():
+            p50_ns = med([r["p50_ns"] for r in rs])
+            spo = med([r["syscalls"] / max(r["steps"], 1) for r in rs])
+            # full-duplex: each step moves payload bytes BOTH ways
+            gbps = (2 * sizes[label] / (p50_ns / 1e9) / 1e9
+                    if p50_ns else 0.0)
+            rows[label] = {
+                "p50_us": round(p50_ns / 1e3, 2),
+                "mean_us": round(
+                    med([r["mean_ns"] for r in rs]) / 1e3, 2),
+                "syscalls_per_step": round(spo, 2),
+                "gbps": round(gbps, 3),
+            }
+            print(json.dumps({"plane": name, "size": label,
+                              **rows[label]}), flush=True)
+        record["planes"][name] = {"sizes": rows}
+    if supported:
+        t, u = (record["planes"]["tcp"]["sizes"],
+                record["planes"]["io_uring"]["sizes"])
+        record["claims"] = {
+            label: {
+                "syscall_reduction": round(
+                    t[label]["syscalls_per_step"]
+                    / max(u[label]["syscalls_per_step"], 1e-9), 2),
+                "p50_ratio": round(
+                    t[label]["p50_us"]
+                    / max(u[label]["p50_us"], 1e-9), 2),
+                "bw_ratio": round(
+                    u[label]["gbps"]
+                    / max(t[label]["gbps"], 1e-9), 2),
+            }
+            for label in sizes
+        }
+        print(json.dumps(record["claims"], indent=1))
+    print("\n| plane | size | p50 us | syscalls/step | GB/s |")
+    print("|---|---|---|---|---|")
+    for name, pr in record["planes"].items():
+        for label, row in pr["sizes"].items():
+            print(f"| {name} | {label} | {row['p50_us']} | "
+                  f"{row['syscalls_per_step']} | {row['gbps']} |")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+        print(f"wrote {out_path}")
+    return record
+
+
+def uring_check(path):
+    """--check (r18 artifacts): schema + committed-claim gates. The
+    gates pin what the io_uring plane actually delivers on this class
+    of host — fewer kernel crossings at latency/bandwidth parity:
+    syscalls/step reduction >= 1.25x at 4KB and >= 1.7x at 16MB, p50
+    within 2x of tcp everywhere, 16MB bandwidth within [0.5x, 2.5x].
+    (Turnaround latency itself is scheduler-bound on shared/1-CPU hosts
+    — two context switches per step dwarf the syscall cost — so a
+    latency-multiple gate would pin noise, not the transport.)"""
+    with open(path) as f:
+        rec = json.load(f)
+    errs = []
+    for key in ("harness", "planes", "payload_bytes", "uring_supported",
+                "host_cpus"):
+        if key not in rec:
+            errs.append(f"missing key {key!r}")
+    planes = rec.get("planes", {})
+    labels = list(rec.get("payload_bytes", {}))
+    if "tcp" not in planes:
+        errs.append("missing plane 'tcp'")
+    for name, p in planes.items():
+        for label in labels:
+            row = p.get("sizes", {}).get(label)
+            if not row:
+                errs.append(f"plane {name}: missing size {label!r}")
+                continue
+            if row.get("p50_us", 0) <= 0:
+                errs.append(f"plane {name}/{label}: no p50")
+            if row.get("syscalls_per_step", 0) <= 0:
+                errs.append(f"plane {name}/{label}: no syscall count")
+    if not rec.get("uring_supported"):
+        # a tcp-only artifact from an unsupported kernel is schema-valid
+        # but carries no claims to gate
+        if errs:
+            for e in errs:
+                print(f"uring-check: {e}")
+            print(f"uring-check: FAILED ({len(errs)} problem(s)) — {path}")
+            return 1
+        print(f"uring-check: OK (tcp-only, io_uring unsupported) — {path}")
+        return 0
+    if "io_uring" not in planes:
+        errs.append("uring_supported but no io_uring plane")
+    claims = rec.get("claims", {})
+    small = min(labels, key=lambda l: rec["payload_bytes"].get(l, 0)) \
+        if labels else None
+    big = max(labels, key=lambda l: rec["payload_bytes"].get(l, 0)) \
+        if labels else None
+    for label in labels:
+        c = claims.get(label)
+        if not c:
+            errs.append(f"missing claims for {label!r}")
+            continue
+        floor = 1.7 if label == big else 1.25
+        if c.get("syscall_reduction", 0) < floor:
+            errs.append(
+                f"{label}: syscall reduction {c.get('syscall_reduction')} "
+                f"< {floor}x gate")
+        if c.get("p50_ratio", 0) < 0.5:
+            errs.append(f"{label}: io_uring p50 more than 2x tcp "
+                        f"(ratio {c.get('p50_ratio')})")
+    if big and claims.get(big, {}).get("bw_ratio") is not None:
+        bw = claims[big]["bw_ratio"]
+        if not 0.5 <= bw <= 2.5:
+            errs.append(f"{big}: bandwidth ratio {bw} outside parity "
+                        f"band [0.5, 2.5]")
+    if errs:
+        for e in errs:
+            print(f"uring-check: {e}")
+        print(f"uring-check: FAILED ({len(errs)} problem(s)) — {path}")
+        return 1
+    reds = {l: claims[l]["syscall_reduction"] for l in labels}
+    print(f"uring-check: OK — {path} (syscall reduction {reds}, "
+          f"{small} p50 ratio {claims[small]['p50_ratio']})")
+    return 0
+
+
 def run_job(np_, shm, sizes, iters, repo):
     env = dict(os.environ)
     env.update({
@@ -500,10 +785,18 @@ def main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("HVT_BENCH_WORKER"):
+    if os.environ.get("HVT_TBENCH_ROLE") is not None:
+        tbench_worker()
+    elif os.environ.get("HVT_BENCH_WORKER"):
         sweep_worker() if os.environ.get("HVT_BENCH_SWEEP") else worker()
     elif "--check" in sys.argv:
-        sys.exit(codec_check(sys.argv[sys.argv.index("--check") + 1]))
+        path = sys.argv[sys.argv.index("--check") + 1]
+        with open(path) as f:
+            harness = json.load(f).get("harness", "")
+        sys.exit(uring_check(path) if harness.startswith("r18 uring")
+                 else codec_check(path))
+    elif "--uring" in sys.argv:
+        uring_main()
     elif "--codec" in sys.argv:
         codec_main()
     elif "--sweep" in sys.argv:

@@ -50,6 +50,11 @@
 #                      # + a 16-rank kill-a-host smoke capture and
 #                      # schema --check of the fresh AND committed
 #                      # benchmarks/r14_elastic_recovery.json
+#   ./ci.sh --uring    # build + a quick transport-level link-backend
+#                      # A/B (tcp vs io_uring ping-pong through the
+#                      # PumpDuplex seam, syscalls-per-step column) +
+#                      # claim --check of the fresh AND committed
+#                      # benchmarks/r18_uring_sweep.json artifacts
 #   ./ci.sh --obs      # build + the fleet-telemetry smoke: an 8-rank
 #                      # direct-vs-leader-aggregated push pair over a
 #                      # live /statusz rendezvous server, incl. the
@@ -83,6 +88,7 @@ SOAK=0
 OBS=0
 ELASTIC=0
 SERVESOAK=0
+URING_LANE=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
@@ -95,6 +101,7 @@ SERVESOAK=0
 [[ "${1:-}" == "--obs" ]] && OBS=1
 [[ "${1:-}" == "--elastic" ]] && ELASTIC=1
 [[ "${1:-}" == "--servesoak" ]] && SERVESOAK=1
+[[ "${1:-}" == "--uring" ]] && URING_LANE=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -151,22 +158,62 @@ for sym in $REQUIRED_SYMS; do
 done
 echo "C API symbol check OK ($(echo $REQUIRED_SYMS | wc -w) symbols)"
 
+# io_uring kernel-capability probe (PR 18): decides whether the chaos /
+# soak lanes can run their specs under BOTH link backends. A failed
+# probe (old kernel, seccomp, container policy) is not an error — the
+# engine falls back to tcp and the io_uring arms are skipped.
+URING_OK=$(python -c "from horovod_tpu.engine import native; \
+print(1 if native.uring_supported() else 0)")
+if [[ "$URING_OK" == "1" ]]; then
+  echo "io_uring kernel probe: supported (chaos/soak run both backends)"
+else
+  echo "io_uring kernel probe: unsupported (tcp-only)"
+fi
+
 if [[ "$CHAOS" == "1" ]]; then
   echo "=== [2/2] chaos / failure-containment suite ==="
-  run_pytest tests/test_failure_containment.py -q
+  run_pytest tests/test_failure_containment.py \
+    tests/test_transport_backends.py -q
+  if [[ "$URING_OK" == "1" ]]; then
+    echo "--- chaos pass 2: HVT_LINK_BACKEND=io_uring ---"
+    HVT_LINK_BACKEND=io_uring run_pytest \
+      tests/test_failure_containment.py -q
+  fi
   echo "CI OK (chaos)"
   exit 0
 fi
 
 if [[ "$SOAK" == "1" ]]; then
   echo "=== [2/3] self-healing reconnect gang suite ==="
+  # the session-layer specs are parameterized over both link backends
+  # inside the suite (io_uring variants self-skip on a failed probe)
   run_pytest tests/test_self_healing.py -q
   echo "=== [3/3] seeded transient-fault soak ==="
   ART=$(mktemp /tmp/hvt_soak_XXXX.json)
   timeout -k 30 "$PYTEST_GUARD_SEC" \
     python benchmarks/soak_transient.py --rounds 4 --out "$ART"
   echo "soak artifact: $ART"
+  if [[ "$URING_OK" == "1" ]]; then
+    echo "--- soak pass 2: HVT_LINK_BACKEND=io_uring ---"
+    ART2=$(mktemp /tmp/hvt_soak_uring_XXXX.json)
+    HVT_LINK_BACKEND=io_uring timeout -k 30 "$PYTEST_GUARD_SEC" \
+      python benchmarks/soak_transient.py --rounds 2 --out "$ART2"
+    echo "io_uring soak artifact: $ART2"
+  fi
   echo "CI OK (soak)"
+  exit 0
+fi
+
+if [[ "$URING_LANE" == "1" ]]; then
+  echo "=== [2/2] link-backend sweep smoke (transport-level A/B) ==="
+  ART=$(mktemp /tmp/hvt_uring_XXXX.json)
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    python benchmarks/engine_scaling.py --uring --quick --out "$ART"
+  python benchmarks/engine_scaling.py --check "$ART"
+  # the committed artifact must also still satisfy its claim gates
+  python benchmarks/engine_scaling.py --check \
+    benchmarks/r18_uring_sweep.json
+  echo "CI OK (uring)"
   exit 0
 fi
 

@@ -628,6 +628,37 @@ def poll_engine_stats(registry=None):
         hol_n.labels(lane=lane).set_total(
             lane_hol_cnt[i] if i < len(lane_hol_cnt) else 0)
 
+    # transport backend (csrc/uring_link.h): which data-plane link
+    # implementation this gang resolved HVT_LINK_BACKEND to, as an
+    # info-style gauge (1 on the active backend's label), plus the
+    # per-backend syscall economics — the generic pump's poll/send/recv
+    # count vs the io_uring ring's SQE/enter/CQE counters. The sweep's
+    # syscalls-per-op column is pump_syscalls (tcp) or uring_enters
+    # (io_uring) over exec_count.
+    backend_id = stats.get("link_backend", 0)
+    link_backend = reg.gauge(
+        "hvt_link_backend",
+        "resolved data-plane link backend (HVT_LINK_BACKEND; 1 on the "
+        "active backend's label)", ("backend",))
+    for i, name in enumerate(native.LINK_BACKENDS):
+        link_backend.labels(backend=name).set(
+            1 if backend_id == i else 0)
+    bridge("hvt_pump_syscalls_total",
+           "syscalls (poll/send/recv) issued by the generic duplex "
+           "pump fallback loop",
+           "pump_syscalls")
+    bridge("hvt_uring_sqes_total",
+           "io_uring submission-queue entries prepared by the "
+           "IoUringLink data plane",
+           "uring_sqes")
+    bridge("hvt_uring_enters_total",
+           "io_uring_enter submit/wait syscalls issued by the "
+           "IoUringLink data plane",
+           "uring_enters")
+    bridge("hvt_uring_cqes_total",
+           "io_uring completions reaped by the IoUringLink data plane",
+           "uring_cqes")
+
     # failure containment: coordinated aborts by cause + the sticky
     # broken flag (alerts page on either; the cause label says whether
     # it was a deadline, a dropped peer, a missed heartbeat, or a

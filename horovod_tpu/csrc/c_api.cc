@@ -1,10 +1,13 @@
 // extern "C" surface for the ctypes bridge (horovod_tpu/engine/native.py) —
 // the counterpart of the reference's C API (horovod/common/operations.cc:
 // 708-896 horovod_init/rank/size + per-framework enqueue entry points).
+#include <algorithm>
+#include <chrono>
 #include <cstring>
 
 #include "engine.h"
 #include "stats_slots.h"
+#include "uring_link.h"
 
 using hvt::DataType;
 using hvt::Engine;
@@ -244,6 +247,12 @@ int hvt_engine_flags() {
 //   140..147 lane_hol_ns per lane bucket (submit → engine-queue
 //          pickup head-of-line wait — hvt_lane_hol_seconds_total)
 //   148..155 lane_hol_count per lane bucket
+//   156    link_backend (info gauge: resolved HVT_LINK_BACKEND —
+//          0 = tcp, 1 = io_uring — hvt_link_backend)
+//   157    pump_syscalls (generic duplex-pump poll/send/recv syscalls)
+//   158    uring_sqes (io_uring SQEs submitted by the batched pump)
+//   159    uring_enters (io_uring_enter syscalls, incl. spin flushes)
+//   160    uring_cqes (io_uring completions reaped)
 // Returns the number of slots the engine knows about; fills at most
 // max_n. Callers sizing the buffer off the return value stay compatible
 // with a newer .so that appends fields.
@@ -265,6 +274,10 @@ constexpr int kStatsLanePoolScalars = 2;
 // lane_hol_ns + lane_hol_count, kLaneSlots each (the in-rank
 // response-ready → exec-start wait the lane pool removes)
 constexpr int kStatsLaneHolGroups = 2;
+// transport-backend scalars appended after the lane-hol block:
+// link_backend info gauge + the per-backend pump syscall/SQE counters
+// (slots 156-160)
+constexpr int kStatsUringScalars = 5;
 static_assert(kStatsLinkPlanes == hvt::kLinkPlanes,
               "transport.h kLinkPlanes drifted from the stats layout");
 constexpr int kStatsHist = hvt::kLatBuckets + 1 + 2;  // buckets+sum+count
@@ -276,7 +289,8 @@ constexpr int kStatsSlotCount = kStatsScalars + 4 * hvt::kStatsOps +
                                 kStatsEfScalars + kStatsLinkPlanes +
                                 kStatsRecoveryScalars +
                                 kStatsLanePoolScalars +
-                                kStatsLaneHolGroups * hvt::kLaneSlots;
+                                kStatsLaneHolGroups * hvt::kLaneSlots +
+                                kStatsUringScalars;
 static_assert(kStatsSlotCount == HVT_STATS_SLOT_COUNT,
               "hvt_engine_stats layout drifted from stats_slots.h — the "
               "slot ABI is append-only: add new slots to the end of the "
@@ -338,6 +352,11 @@ int hvt_engine_stats(long long* out, int max_n) {
     v[base++] = s.lane_hol_ns[i].load(std::memory_order_relaxed);
   for (int i = 0; i < hvt::kLaneSlots; ++i)
     v[base++] = s.lane_hol_count[i].load(std::memory_order_relaxed);
+  v[base++] = s.link_backend.load(std::memory_order_relaxed);
+  v[base++] = s.pump_syscalls.load(std::memory_order_relaxed);
+  v[base++] = s.uring_sqes.load(std::memory_order_relaxed);
+  v[base++] = s.uring_enters.load(std::memory_order_relaxed);
+  v[base++] = s.uring_cqes.load(std::memory_order_relaxed);
   for (int i = 0; i < kStatsSlotCount && i < max_n; ++i) out[i] = v[i];
   return kStatsSlotCount;
 }
@@ -441,6 +460,118 @@ int hvt_record_event(int kind, const char* name, int op, int arg,
       static_cast<hvt::EventKind>(kind), name ? name : "", op, arg,
       static_cast<int64_t>(arg2));
   return 0;
+}
+
+// ---- transport backend introspection -------------------------------------
+
+// 1 when this kernel passes the io_uring capability probe (ring setup,
+// EXT_ARG timed waits, SEND/RECV/ASYNC_CANCEL opcodes) — i.e. when
+// HVT_LINK_BACKEND=auto resolves to io_uring. The probe result is
+// cached per process; safe to call without an initialized engine.
+int hvt_uring_supported() { return hvt::UringSupported() ? 1 : 0; }
+
+// getsockopt probe for the registered link on `plane` (0 ctrl, 1 data)
+// to rank `peer`: fills out3 = {TCP_NODELAY, SO_SNDBUF, SO_RCVBUF}.
+// Returns 0, or -1 when no live link matches. Pins socket-option
+// continuity across transparent heals — every re-dial/re-accept path
+// must re-apply TCP_NODELAY + HVT_SOCK_BUF to the fresh socket.
+int hvt_link_sockopt_probe(int plane, int peer, long long* out3) {
+  if (!out3) return -1;
+  return Engine::Get().LinkSockoptProbe(plane, peer, out3);
+}
+
+// Transport-level ping-pong micro-benchmark, isolated from the engine
+// (no control plane, no negotiation — it measures exactly the layer
+// HVT_LINK_BACKEND swaps): role 0 listens on `port`, role 1 dials
+// `host:port`; both sides run `iters` timed full-duplex steps of
+// `payload` bytes each direction over ONE link. backend 0 = TcpLink
+// driven by the generic poll/send/recv loop (the engine Duplex
+// fallback, replicated step-for-step), 1 = IoUringLink::PumpDuplex
+// with the same fallback tail. Fills out[0..3] = {p50_ns, mean_ns,
+// syscalls, steps}; syscalls covers the measured steps only —
+// poll/send/recv for the generic loop plus io_uring_enter for the
+// ring. Returns 0, or -1 on setup/transfer failure. Benchmark-only
+// surface: benchmarks/engine_scaling.py --uring drives it pairwise
+// for the committed r18_uring_sweep.json speedup claims.
+int hvt_transport_bench(int role, const char* host, int port,
+                        long long payload, int iters, int backend,
+                        long long* out) {
+  if (!out || iters <= 0 || payload <= 0) return -1;
+  try {
+    hvt::Listener lis;
+    hvt::Sock s;
+    if (role == 0) {
+      lis.Listen(port);
+      s = lis.Accept(30);
+    } else {
+      s = hvt::Sock::Connect(host ? host : "127.0.0.1", port, 30);
+    }
+    if (!s.valid()) return -1;
+    hvt::ReconnectHub hub;
+    std::atomic<int64_t> sqes{0}, enters{0}, cqes{0};
+    hub.uring_sqes = &sqes;
+    hub.uring_enters = &enters;
+    hub.uring_cqes = &cqes;
+    std::unique_ptr<hvt::TcpLink> link;
+    if (backend == hvt::kLinkBackendUring)
+      link.reset(new hvt::IoUringLink(std::move(s),
+                                      hvt::LinkPlane::DATA, 1 - role,
+                                      &hub));
+    else
+      link.reset(new hvt::TcpLink(std::move(s), hvt::LinkPlane::DATA,
+                                  1 - role, &hub));
+    const size_t n = static_cast<size_t>(payload);
+    std::vector<uint8_t> sbuf(n, static_cast<uint8_t>(role + 1));
+    std::vector<uint8_t> rbuf(n);
+    long long syscalls = 0;
+    std::vector<long long> ns;
+    ns.reserve(static_cast<size_t>(iters));
+    const int warm = iters / 10 + 8;
+    for (int it = 0; it < warm + iters; ++it) {
+      if (it == warm) {
+        syscalls = 0;
+        enters.store(0);
+      }
+      const auto t0 = std::chrono::steady_clock::now();
+      size_t sent = 0, rcvd = 0;
+      link->PumpDuplex(*link, sbuf.data(), n, rbuf.data(), n, n, sent,
+                       rcvd, nullptr);
+      while (sent < n || rcvd < n) {  // the engine Duplex fallback
+        struct pollfd pd {link->fd(), 0, 0};
+        if (sent < n) pd.events |= POLLOUT;
+        if (rcvd < n) pd.events |= POLLIN;
+        if (pd.fd >= 0) {
+          ::poll(&pd, 1, 1000);
+          ++syscalls;
+        } else {  // banked multishot spill: drain it directly
+          pd.revents = POLLIN;
+        }
+        if ((pd.revents & POLLOUT) && sent < n) {
+          sent += link->SendSome(sbuf.data() + sent, n - sent);
+          ++syscalls;
+        }
+        if ((pd.revents & (POLLIN | POLLHUP | POLLERR)) && rcvd < n) {
+          rcvd += link->RecvSome(rbuf.data() + rcvd, n - rcvd);
+          ++syscalls;
+        }
+      }
+      const auto t1 = std::chrono::steady_clock::now();
+      if (it >= warm)
+        ns.push_back(std::chrono::duration_cast<std::chrono::nanoseconds>(
+                         t1 - t0)
+                         .count());
+    }
+    std::sort(ns.begin(), ns.end());
+    long long sum = 0;
+    for (long long v : ns) sum += v;
+    out[0] = ns[ns.size() / 2];
+    out[1] = sum / static_cast<long long>(ns.size());
+    out[2] = syscalls + enters.load();
+    out[3] = iters;
+    return 0;
+  } catch (...) {
+    return -1;
+  }
 }
 
 // JSON diagnostics snapshot: engine queue depth, pending tensors with

@@ -1,6 +1,7 @@
 #include "engine.h"
 
 #include "logging.h"
+#include "uring_link.h"
 
 #include <climits>
 #include <csignal>
@@ -259,6 +260,9 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   hub_.reconnects = stats_.link_reconnects;
   hub_.frames_replayed = &stats_.frames_replayed;
   hub_.replay_bytes = &stats_.replay_bytes;
+  hub_.uring_sqes = &stats_.uring_sqes;
+  hub_.uring_enters = &stats_.uring_enters;
+  hub_.uring_cqes = &stats_.uring_cqes;
   hub_.events = &events_;
   hub_.stop = &shutdown_requested_;
   // abort sniffing: sibling sweeps peek queued control frames for this
@@ -330,8 +334,31 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
       }
       // full data mesh: i connects to j for i < j; acceptor learns the
       // peer's rank from a 4-byte hello. Each socket is wrapped into a
-      // TcpLink with the same dial/accept role for reconnects (the
-      // data listener stays open for the engine's lifetime).
+      // link with the same dial/accept role for reconnects (the data
+      // listener stays open for the engine's lifetime). The DATA plane
+      // is where the backend choice lands (HVT_LINK_BACKEND resolved
+      // through the kernel probe): IoUringLink inherits the whole
+      // TcpLink session layer, so both backends share replay/heal
+      // state bit-for-bit. Control links stay TcpLink — their traffic
+      // is small frames where the batched pump buys nothing.
+      const bool uring =
+          ResolveLinkBackend() == kLinkBackendUring;
+      auto make_data_link = [&](Sock s, int peer_rank,
+                                const std::string& host, int port,
+                                Listener* listener) -> LinkPtr {
+        if (uring)
+          return std::make_unique<IoUringLink>(
+              std::move(s), LinkPlane::DATA, peer_rank, &hub_, host,
+              port, listener);
+        return std::make_unique<TcpLink>(std::move(s), LinkPlane::DATA,
+                                         peer_rank, &hub_, host, port,
+                                         listener);
+      };
+      HVT_LOG(INFO, rank_) << "data-plane link backend: "
+                           << (uring ? "io_uring" : "tcp")
+                           << " (HVT_LINK_BACKEND, kernel probe "
+                           << (UringSupported() ? "ok" : "failed")
+                           << ")";
       std::vector<std::unique_ptr<Transport>> peers(size_);
       int to_accept = rank_;  // ranks below me dial in
       for (int j = rank_ + 1; j < size_; ++j) {
@@ -341,16 +368,15 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
         Sock s = Sock::Connect(host, port);
         int32_t me = rank_;
         s.SendAll(&me, 4);
-        peers[static_cast<size_t>(j)] = std::make_unique<TcpLink>(
-            std::move(s), LinkPlane::DATA, j, &hub_, host, port);
+        peers[static_cast<size_t>(j)] =
+            make_data_link(std::move(s), j, host, port, nullptr);
       }
       for (int k = 0; k < to_accept; ++k) {
         Sock s = data_listener_.Accept();
         int32_t who = -1;
         s.RecvAll(&who, 4);
-        peers[static_cast<size_t>(who)] = std::make_unique<TcpLink>(
-            std::move(s), LinkPlane::DATA, who, &hub_, "", 0,
-            &data_listener_);
+        peers[static_cast<size_t>(who)] =
+            make_data_link(std::move(s), who, "", 0, &data_listener_);
       }
       data_ = std::make_unique<DataPlane>(rank_, size_, std::move(peers));
 
@@ -393,6 +419,11 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   resp_seq_ = 0;
   stats_.Reset();  // fresh telemetry per (re-)init — an elastic restart
                    // starts a new scrape epoch on every rank
+  // info gauge (hvt_link_backend): which backend this gang's data
+  // links actually resolved to — 1-rank gangs have no data links, so
+  // report the resolution the mesh WOULD use (same probe path)
+  stats_.link_backend.store(static_cast<int64_t>(ResolveLinkBackend()),
+                            std::memory_order_relaxed);
   // per-lane execution pool (HVT_LANE_WORKERS; 0 = off, bit-identical
   // single-thread engine)
   StartLanePool();
@@ -408,6 +439,7 @@ Status Engine::Init(int rank, int size, const std::string& master_addr,
   // DataPlane down
   data_->BindTxCounters(stats_.wire_tx_bytes, stats_.wire_tx_comp_bytes);
   data_->BindCodecTxCounters(stats_.codec_tx_bytes);
+  data_->BindPumpCounters(&stats_.pump_syscalls);
   // wire-phase spans land in the flight-recorder ring, which (like the
   // stats block) is engine-owned and outlives data_
   data_->BindEvents(&events_);
@@ -666,6 +698,30 @@ void Engine::FailAll(const std::string& why) {
   MutexLock lk(queue_mu_);
   for (auto& e : submitted_) CompleteEntry(e, Status::Aborted(why));
   submitted_.clear();
+}
+
+int Engine::LinkSockoptProbe(int plane, int peer, long long out3[3]) {
+  for (TcpLink* l : hub_.links) {
+    if (static_cast<int>(l->plane()) != plane || l->peer_rank() != peer)
+      continue;
+    const int fd = l->fd();
+    if (fd < 0) return -1;
+    int nodelay = 0, sndbuf = 0, rcvbuf = 0;
+    socklen_t n = sizeof(int);
+    if (::getsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nodelay, &n) != 0)
+      return -1;
+    n = sizeof(int);
+    if (::getsockopt(fd, SOL_SOCKET, SO_SNDBUF, &sndbuf, &n) != 0)
+      return -1;
+    n = sizeof(int);
+    if (::getsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcvbuf, &n) != 0)
+      return -1;
+    out3[0] = nodelay;
+    out3[1] = sndbuf;
+    out3[2] = rcvbuf;
+    return 0;
+  }
+  return -1;
 }
 
 // --------------------------------------------------------------------------

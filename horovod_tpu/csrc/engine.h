@@ -273,6 +273,17 @@ struct EngineStats {
   // event-driven coalescing tick (≤ cycle_ms) + scheduler quanta.
   std::atomic<int64_t> lane_hol_ns[kLaneSlots]{};
   std::atomic<int64_t> lane_hol_count[kLaneSlots]{};
+  // transport backend telemetry (stats slots 156-160): the resolved
+  // HVT_LINK_BACKEND as an info gauge (0 = tcp, 1 = io_uring, set at
+  // Init after Reset), the generic duplex pump's syscall tally
+  // (poll+send/recv — the tcp side of syscalls-per-op), and the
+  // io_uring ring counters (SQEs submitted, enter syscalls,
+  // completions reaped) flushed per pump via the hub sinks
+  std::atomic<int64_t> link_backend{0};
+  std::atomic<int64_t> pump_syscalls{0};
+  std::atomic<int64_t> uring_sqes{0};
+  std::atomic<int64_t> uring_enters{0};
+  std::atomic<int64_t> uring_cqes{0};
   LatencyHist cycle_hist;   // RunCycle wall time (includes the
                             // control-plane wait for peers)
   LatencyHist wakeup_hist;  // submit → engine-drain coalescing latency
@@ -309,6 +320,11 @@ struct EngineStats {
     lane_workers = 0;
     for (auto& l : lane_hol_ns) l = 0;
     for (auto& l : lane_hol_count) l = 0;
+    link_backend = 0;
+    pump_syscalls = 0;
+    uring_sqes = 0;
+    uring_enters = 0;
+    uring_cqes = 0;
     cycle_hist.Reset();
     wakeup_hist.Reset();
   }
@@ -436,6 +452,17 @@ class Engine {
   EventRing& events() { return events_; }
   // JSON stall/queue snapshot for hvt_diagnostics (thread-safe).
   std::string DiagnosticsJson() EXCLUDES(diag_mu_, broken_mu_);
+
+  // getsockopt probe over the live link registry — pins socket-option
+  // continuity across heals (every accept/dial path must re-apply
+  // TCP_NODELAY + HVT_SOCK_BUF; tests/test_transport_backends.py).
+  // Fills out3 = {TCP_NODELAY, SO_SNDBUF, SO_RCVBUF} for the
+  // registered link on `plane` (LinkPlane id) to `peer`; returns 0,
+  // or -1 when no registered link matches / its socket is down. The
+  // registry itself is stable between Init and Shutdown (links
+  // register in their ctors), so walking it from a client thread is
+  // safe while the engine is up.
+  int LinkSockoptProbe(int plane, int peer, long long out3[3]);
 
   // Sticky broken state (coordinated abort landed). Submits fail fast
   // and waits return errors until Shutdown() + a fresh Init().

@@ -305,6 +305,23 @@ void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
   if (events_ && wire_bytes > 0)
     events_->Record(EventKind::WIRE_BEGIN, cx.wire_name, cx.stat_op, 0,
                     wire_bytes, cx.wire_lane);
+  // Batched fast path (transport.h Transport::PumpDuplex — a no-op on
+  // TcpLink, the one-enter-per-step ring pump on IoUringLink): moves
+  // as much of the transfer as the backend can handle, firing the
+  // chunk callback as receive completions land. Best-effort by
+  // contract — whatever remains (including every session-layer event:
+  // replay, heal, chaos cut, escalation) is finished by the generic
+  // poll+Some() loop below, which is also the whole pump under the
+  // tcp backend.
+  out.PumpDuplex(in, send_buf, send_n, recv_buf, recv_n, chunk_bytes,
+                 sent, rcvd, [&] { flush_chunks(); });
+  // the pump ran its own progress deadline; re-arm ours fresh
+  if (deadline >= 0) deadline = NowMs() + timeout_ms;
+  // generic-loop syscall tally (poll + each nonblocking send/recv),
+  // flushed into the caller-owned sink at the end — the tcp side of
+  // the syscalls-per-op comparison (the io_uring side counts enters
+  // in the hub's uring sinks instead)
+  int64_t pump_syscalls = 0;
   while (sent < send_n || rcvd < recv_n) {
     // a link mid-reconnect reports fd < 0: drive its Some() op directly
     // (the call heals the link or escalates) instead of parking an
@@ -313,11 +330,13 @@ void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
     // made none, but the link just proved the peer alive.
     if (sent < send_n && out.fd() < 0) {
       sent += out.SendSome(send_buf + sent, send_n - sent);
+      ++pump_syscalls;
       if (deadline >= 0) deadline = NowMs() + timeout_ms;
     }
     if (rcvd < recv_n && in.fd() < 0) {
       rcvd += in.RecvSome(recv_buf + rcvd,
                           std::min(recv_n - rcvd, 2 * chunk_bytes));
+      ++pump_syscalls;
       if (deadline >= 0) deadline = NowMs() + timeout_ms;
     }
     struct pollfd fds[2];
@@ -342,6 +361,7 @@ void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
     }
     if (wait_ms < 0 || wait_ms > 200) wait_ms = 200;
     int prc = ::poll(fds, 2, wait_ms);
+    ++pump_syscalls;
     if (prc < 0) {
       if (errno == EINTR) continue;
       throw PeerLostError("hvt: poll failed on data socket");
@@ -365,10 +385,12 @@ void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
         (fds[1].revents & (POLLIN | POLLERR | POLLHUP))) {
       size_t want = std::min(recv_n - rcvd, 2 * chunk_bytes);
       rcvd += in.RecvSome(recv_buf + rcvd, want);
+      ++pump_syscalls;
     }
     if (sent < send_n &&
         (fds[0].revents & (POLLOUT | POLLERR | POLLHUP))) {
       sent += out.SendSome(send_buf + sent, send_n - sent);
+      ++pump_syscalls;
     }
     // progress re-arms the deadline — and so does a heal that happened
     // INSIDE a Some() call (generation bump): the reconnect may have
@@ -383,6 +405,8 @@ void DataPlane::Duplex(Transport& out, const uint8_t* send_buf,
     flush_chunks();
   }
   flush_chunks();
+  if (pump_sink_ && pump_syscalls)
+    pump_sink_->fetch_add(pump_syscalls, std::memory_order_relaxed);
   if (events_ && wire_bytes > 0)
     events_->Record(EventKind::WIRE_END, cx.wire_name, cx.stat_op, 0,
                     wire_bytes, cx.wire_lane);

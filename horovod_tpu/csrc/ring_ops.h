@@ -173,6 +173,15 @@ class DataPlane {
   // HVT_RING_PIPELINE=0 parity baseline and the shm backend are not
   // spanned. Fused units attribute their spans to the first member name.
   void BindEvents(EventRing* ring) { events_ = ring; }
+  // Syscall counter for the generic duplex pump
+  // (hvt_pump_syscalls_total): every poll/send/recv the fallback loop
+  // issues, flushed once per Duplex. Together with the hub's
+  // uring_enters sink this is the per-backend syscalls-per-op story
+  // the r18 sweep reports (blocking HVT_RING_PIPELINE=0 transfers and
+  // control frames are not counted). Caller-owned, like the tx sinks.
+  void BindPumpCounters(std::atomic<int64_t>* pump_syscalls) {
+    pump_sink_ = pump_syscalls;
+  }
   void set_wire_ctx(const std::string& name, int lane) {
     PlaneCtx& cx = Ctx();
     cx.wire_name = name;
@@ -238,6 +247,7 @@ class DataPlane {
   std::atomic<int64_t>* txc_sink_ = nullptr;  // [kWireOps], caller-owned
   // [kWireCodecCount * kWireOps] codec-major, caller-owned
   std::atomic<int64_t>* codec_tx_sink_ = nullptr;
+  std::atomic<int64_t>* pump_sink_ = nullptr;  // caller-owned scalar
   EventRing* events_ = nullptr;               // caller-owned (engine)
   std::mutex ctx_mu_;
   std::unordered_map<std::thread::id, std::unique_ptr<PlaneCtx>> ctxs_;

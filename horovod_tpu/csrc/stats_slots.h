@@ -21,7 +21,7 @@
 // `hist.count`.
 #pragma once
 
-#define HVT_STATS_SLOT_COUNT 156
+#define HVT_STATS_SLOT_COUNT 161
 
 // X-macro: HVT_STATS_SLOT(index, "name")
 #define HVT_STATS_SLOTS(X)                  \
@@ -180,4 +180,9 @@
   X(152, "lane_hol_count[4]")              \
   X(153, "lane_hol_count[5]")              \
   X(154, "lane_hol_count[6]")              \
-  X(155, "lane_hol_count[7]")
+  X(155, "lane_hol_count[7]")             \
+  X(156, "link_backend")                  \
+  X(157, "pump_syscalls")                 \
+  X(158, "uring_sqes")                    \
+  X(159, "uring_enters")                  \
+  X(160, "uring_cqes")

@@ -42,6 +42,7 @@
 #include <algorithm>
 #include <atomic>
 #include <deque>
+#include <functional>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -235,6 +236,31 @@ class Transport {
   // underneath me" — the latter must re-arm progress deadlines, since
   // the heal just proved the peer alive.
   virtual int64_t Generation() const { return 0; }
+  // Optional whole-transfer fast path for the full-duplex ring pump:
+  // stream send_n bytes out of THIS transport while receiving recv_n
+  // from `in` (which may be this same object on 2-member rings),
+  // advancing `sent`/`rcvd` and firing on_progress after each receive
+  // completion so chunk reduces overlap the transfer. Best-effort by
+  // contract: a backend may return at ANY point with partial progress
+  // — the caller's generic poll+Some() loop (ring_ops.cc Duplex) owns
+  // every session-layer event (replay, heal, escalation) and finishes
+  // the remainder. The base transport has no batched path; IoUringLink
+  // (uring_link.h) overrides this with the one-enter-per-step pump.
+  virtual void PumpDuplex(Transport& in, const uint8_t* send_buf,
+                          size_t send_n, uint8_t* recv_buf,
+                          size_t recv_n, size_t chunk_bytes,
+                          size_t& sent, size_t& rcvd,
+                          const std::function<void()>& on_progress) {
+    (void)in;
+    (void)send_buf;
+    (void)send_n;
+    (void)recv_buf;
+    (void)recv_n;
+    (void)chunk_bytes;
+    (void)sent;
+    (void)rcvd;
+    (void)on_progress;
+  }
 };
 
 class TcpLink;
@@ -262,6 +288,11 @@ struct ReconnectHub {
   std::atomic<int64_t>* reconnects = nullptr;
   std::atomic<int64_t>* frames_replayed = nullptr;
   std::atomic<int64_t>* replay_bytes = nullptr;
+  // io_uring backend telemetry (uring_link.cc flushes per pump; null
+  // under the tcp backend or in unit-test contexts)
+  std::atomic<int64_t>* uring_sqes = nullptr;
+  std::atomic<int64_t>* uring_enters = nullptr;
+  std::atomic<int64_t>* uring_cqes = nullptr;
   EventRing* events = nullptr;
   // engine gates
   std::atomic<bool>* stop = nullptr;    // engine shutdown_requested_
@@ -538,14 +569,7 @@ class TcpLink : public Transport {
       HandleFailure("send");
       return 0;
     }
-    ring_.Append(p, k);
-    tx_ += k;
-    if (cut_after_ >= 0 && tx_ >= cut_after_) {
-      // chaos: flaky_conn armed a mid-transfer cut; both sides see the
-      // reset and heal through the replay handshake
-      cut_after_ = -1;
-      sock_.Close();
-    }
+    AccountTx(p, k);
     return static_cast<size_t>(k);
   }
   size_t RecvSome(void* p, size_t n) override {
@@ -553,11 +577,7 @@ class TcpLink : public Transport {
     if (!EnsureUsable("recv")) return 0;
     ssize_t k = ::recv(sock_.fd(), p, n, MSG_DONTWAIT);
     if (k > 0) {
-      rx_ += k;
-      if (cut_after_rx_ >= 0 && rx_ >= cut_after_rx_) {
-        cut_after_rx_ = -1;  // chaos: drop the link mid-receive
-        sock_.Close();
-      }
+      AccountRx(k);
       return static_cast<size_t>(k);
     }
     if (k < 0 &&
@@ -590,7 +610,38 @@ class TcpLink : public Transport {
     return b;
   }
 
- private:
+ protected:
+  // Everything below is protected rather than private for exactly one
+  // subclass: IoUringLink (uring_link.h) reuses the WHOLE session
+  // layer — sockets, replay ring, stream counters, heal machinery —
+  // and only replaces how bytes move while a duplex ring step is in
+  // flight. Its reaped completions account through the two helpers
+  // here so both backends keep bit-identical session state.
+
+  // Stream accounting for k bytes just handed to the kernel from p:
+  // replay-ring append, tx_ advance, and the armed chaos cut — the
+  // exact side effects of the SendSome syscall path.
+  void AccountTx(const void* p, int64_t k) {
+    ring_.Append(p, k);
+    tx_ += k;
+    if (cut_after_ >= 0 && tx_ >= cut_after_) {
+      // chaos: flaky_conn armed a mid-transfer cut; both sides see the
+      // reset and heal through the replay handshake
+      cut_after_ = -1;
+      sock_.Close();
+    }
+  }
+  // Stream accounting for k bytes durably delivered to the caller (or
+  // its spill buffer): rx_ is what the reconnect handshake reports, so
+  // it must count exactly the bytes this side will never re-request.
+  void AccountRx(int64_t k) {
+    rx_ += k;
+    if (cut_after_rx_ >= 0 && rx_ >= cut_after_rx_) {
+      cut_after_rx_ = -1;  // chaos: drop the link mid-receive
+      sock_.Close();
+    }
+  }
+
   // poll for `events` on the current fd, also flushing pending replay
   // whenever the socket turns writable; throws OpTimeoutError at the
   // deadline (NOT retried — stalled-but-alive is a containment case).

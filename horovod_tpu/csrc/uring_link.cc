@@ -1,0 +1,754 @@
+// io_uring data-plane backend — see uring_link.h for the design notes.
+//
+// Raw-syscall io_uring (no liburing): ring setup/teardown, SQE prep,
+// batched submit with a spin-then-block completion wait, a registered
+// provided-buffer ring for multishot recv, and the PumpDuplex override
+// that moves a full-duplex ring step through one ring instead of
+// poll+send+recv per chunk. Constants newer than the toolchain's
+// <linux/io_uring.h> are shimmed below under #ifndef so the same
+// source builds against old headers and probes the running kernel for
+// what it actually has.
+
+#include "uring_link.h"
+
+#include <linux/io_uring.h>
+#include <linux/time_types.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <memory>
+#include <thread>
+
+#include "common.h"
+
+// ---- shims for pre-5.19 toolchain headers (kernel support is probed
+// at runtime; these only name the ABI) --------------------------------------
+#ifndef __NR_io_uring_setup
+#define __NR_io_uring_setup 425
+#endif
+#ifndef __NR_io_uring_enter
+#define __NR_io_uring_enter 426
+#endif
+#ifndef __NR_io_uring_register
+#define __NR_io_uring_register 427
+#endif
+#ifndef IORING_FEAT_EXT_ARG
+#define IORING_FEAT_EXT_ARG (1U << 8)
+#endif
+#ifndef IORING_ENTER_EXT_ARG
+#define IORING_ENTER_EXT_ARG (1U << 3)
+#endif
+#ifndef IORING_RECV_MULTISHOT
+#define IORING_RECV_MULTISHOT (1U << 1)  // sqe->ioprio flag
+#endif
+#ifndef IORING_CQE_F_BUFFER
+#define IORING_CQE_F_BUFFER (1U << 0)
+#endif
+#ifndef IORING_CQE_F_MORE
+#define IORING_CQE_F_MORE (1U << 1)
+#endif
+#ifndef IORING_CQE_BUFFER_SHIFT
+#define IORING_CQE_BUFFER_SHIFT 16
+#endif
+#ifndef IORING_REGISTER_PBUF_RING
+#define IORING_REGISTER_PBUF_RING 22
+#endif
+#ifndef IORING_UNREGISTER_PBUF_RING
+#define IORING_UNREGISTER_PBUF_RING 23
+#endif
+// IORING_OP_SEND_ZC's opcode number doubles as the capability
+// heuristic: a kernel whose probe knows it (6.0+) has multishot recv
+// and provided-buffer rings (5.19+); the pbuf registration is still
+// verified by doing it.
+#ifndef IORING_OP_SEND_ZC
+#define IORING_OP_SEND_ZC 47
+#endif
+
+namespace hvt {
+namespace {
+
+inline int64_t NowUs() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+int UringSetupSys(unsigned entries, io_uring_params* p) {
+  return static_cast<int>(syscall(__NR_io_uring_setup, entries, p));
+}
+int UringEnterSys(int fd, unsigned to_submit, unsigned min_complete,
+                  unsigned flags, const void* arg, size_t argsz) {
+  return static_cast<int>(syscall(__NR_io_uring_enter, fd, to_submit,
+                                  min_complete, flags, arg, argsz));
+}
+int UringRegisterSys(int fd, unsigned opcode, void* arg, unsigned nr) {
+  return static_cast<int>(syscall(__NR_io_uring_register, fd, opcode,
+                                  arg, nr));
+}
+
+// Local mirrors of the 5.19 provided-buffer-ring ABI (absent from old
+// headers; layout is fixed kernel ABI). The ring is an array of
+// 16-byte entries whose entry 0 overlays the header — its last __u16
+// is the producer tail.
+struct HvtUringBuf {
+  __u64 addr;
+  __u32 len;
+  __u16 bid;
+  __u16 resv;
+};
+struct HvtUringBufReg {
+  __u64 ring_addr;
+  __u32 ring_entries;
+  __u16 bgid;
+  __u16 pad;
+  __u64 resv[3];
+};
+
+constexpr unsigned kPbufCount = 32;        // power of two (ring ABI)
+constexpr size_t kPbufBytes = 64 << 10;    // per-buffer; 2 MiB arena
+constexpr unsigned kPbufGroup = 0;
+
+// One ring per executing thread (engine thread + each lane worker),
+// created lazily on the first PumpDuplex that thread runs and torn
+// down when the thread exits. All state is thread-confined.
+struct Ring {
+  int fd = -1;
+  unsigned sq_entries = 0, cq_entries = 0;
+  unsigned sq_mask = 0, cq_mask = 0;
+  unsigned* sq_head = nullptr;
+  unsigned* sq_tail = nullptr;
+  unsigned* sq_array = nullptr;
+  io_uring_sqe* sqes = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  io_uring_cqe* cq_cqes = nullptr;
+  void* sq_ring_ptr = nullptr;
+  size_t sq_ring_sz = 0;
+  void* cq_ring_ptr = nullptr;  // == sq_ring_ptr under FEAT_SINGLE_MMAP
+  size_t cq_ring_sz = 0;
+  void* sqe_ptr = nullptr;
+  size_t sqe_sz = 0;
+  unsigned to_submit = 0;   // queued SQEs not yet handed to the kernel
+  uint64_t next_ud = 1;     // user_data tags (ring empty between pumps)
+  bool mshot_ok = false;    // kernel has multishot recv + pbuf rings
+  // provided-buffer pool (multishot recv lands here, copied out to the
+  // caller; recycled immediately after each completion)
+  HvtUringBuf* bufring = nullptr;
+  size_t bufring_sz = 0;
+  uint8_t* arena = nullptr;
+  size_t arena_sz = 0;
+  unsigned pbuf_tail = 0;  // local producer cursor (mirrored to shared)
+  bool pbuf_ok = false;
+  // telemetry accumulators, flushed into the hub sinks per pump
+  int64_t sqes_n = 0, enters_n = 0, cqes_n = 0;
+};
+
+void RingDestroy(Ring& r) {
+  if (r.fd >= 0 && r.pbuf_ok) {
+    HvtUringBufReg reg{};
+    reg.bgid = kPbufGroup;
+    UringRegisterSys(r.fd, IORING_UNREGISTER_PBUF_RING, &reg, 1);
+  }
+  if (r.bufring) munmap(r.bufring, r.bufring_sz);
+  if (r.arena) munmap(r.arena, r.arena_sz);
+  if (r.sqe_ptr) munmap(r.sqe_ptr, r.sqe_sz);
+  if (r.cq_ring_ptr && r.cq_ring_ptr != r.sq_ring_ptr)
+    munmap(r.cq_ring_ptr, r.cq_ring_sz);
+  if (r.sq_ring_ptr) munmap(r.sq_ring_ptr, r.sq_ring_sz);
+  if (r.fd >= 0) ::close(r.fd);
+  r = Ring{};
+  r.fd = -1;
+}
+
+// Recycle/provide buffer `bid` to the kernel pool.
+void PbufAdd(Ring& r, unsigned bid) {
+  HvtUringBuf* e = &r.bufring[r.pbuf_tail & (kPbufCount - 1)];
+  e->addr = reinterpret_cast<uint64_t>(r.arena + bid * kPbufBytes);
+  e->len = kPbufBytes;
+  e->bid = static_cast<uint16_t>(bid);
+  ++r.pbuf_tail;
+  // entry 0's resv overlays the shared tail word (ring ABI)
+  __atomic_store_n(&r.bufring[0].resv,
+                   static_cast<uint16_t>(r.pbuf_tail), __ATOMIC_RELEASE);
+}
+
+bool RingInitPbuf(Ring& r) {
+  r.bufring_sz = kPbufCount * sizeof(HvtUringBuf);
+  r.arena_sz = kPbufCount * kPbufBytes;
+  void* ringp = mmap(nullptr, r.bufring_sz, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (ringp == MAP_FAILED) return false;
+  void* arenap = mmap(nullptr, r.arena_sz, PROT_READ | PROT_WRITE,
+                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (arenap == MAP_FAILED) {
+    munmap(ringp, r.bufring_sz);
+    return false;
+  }
+  r.bufring = static_cast<HvtUringBuf*>(ringp);
+  r.arena = static_cast<uint8_t*>(arenap);
+  memset(r.bufring, 0, r.bufring_sz);
+  HvtUringBufReg reg{};
+  reg.ring_addr = reinterpret_cast<uint64_t>(r.bufring);
+  reg.ring_entries = kPbufCount;
+  reg.bgid = kPbufGroup;
+  if (UringRegisterSys(r.fd, IORING_REGISTER_PBUF_RING, &reg, 1) < 0) {
+    munmap(r.bufring, r.bufring_sz);
+    munmap(r.arena, r.arena_sz);
+    r.bufring = nullptr;
+    r.arena = nullptr;
+    return false;
+  }
+  for (unsigned i = 0; i < kPbufCount; ++i) PbufAdd(r, i);
+  return true;
+}
+
+bool RingInit(Ring& r, unsigned entries) {
+  io_uring_params p;
+  memset(&p, 0, sizeof(p));
+  int fd = UringSetupSys(entries, &p);
+  if (fd < 0) return false;
+  r.fd = fd;
+  // the pump depends on the timed EXT_ARG wait (no TIMEOUT SQE path)
+  if (!(p.features & IORING_FEAT_EXT_ARG)) {
+    RingDestroy(r);
+    return false;
+  }
+  r.sq_entries = p.sq_entries;
+  r.cq_entries = p.cq_entries;
+  r.sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+  r.cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe);
+  if (p.features & IORING_FEAT_SINGLE_MMAP) {
+    r.sq_ring_sz = r.cq_ring_sz = std::max(r.sq_ring_sz, r.cq_ring_sz);
+  }
+  r.sq_ring_ptr = mmap(nullptr, r.sq_ring_sz, PROT_READ | PROT_WRITE,
+                       MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+  if (r.sq_ring_ptr == MAP_FAILED) {
+    r.sq_ring_ptr = nullptr;
+    RingDestroy(r);
+    return false;
+  }
+  if (p.features & IORING_FEAT_SINGLE_MMAP) {
+    r.cq_ring_ptr = r.sq_ring_ptr;
+  } else {
+    r.cq_ring_ptr =
+        mmap(nullptr, r.cq_ring_sz, PROT_READ | PROT_WRITE,
+             MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_CQ_RING);
+    if (r.cq_ring_ptr == MAP_FAILED) {
+      r.cq_ring_ptr = nullptr;
+      RingDestroy(r);
+      return false;
+    }
+  }
+  r.sqe_sz = p.sq_entries * sizeof(io_uring_sqe);
+  r.sqe_ptr = mmap(nullptr, r.sqe_sz, PROT_READ | PROT_WRITE,
+                   MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES);
+  if (r.sqe_ptr == MAP_FAILED) {
+    r.sqe_ptr = nullptr;
+    RingDestroy(r);
+    return false;
+  }
+  auto* sqb = static_cast<uint8_t*>(r.sq_ring_ptr);
+  r.sq_head = reinterpret_cast<unsigned*>(sqb + p.sq_off.head);
+  r.sq_tail = reinterpret_cast<unsigned*>(sqb + p.sq_off.tail);
+  r.sq_mask = *reinterpret_cast<unsigned*>(sqb + p.sq_off.ring_mask);
+  r.sq_array = reinterpret_cast<unsigned*>(sqb + p.sq_off.array);
+  r.sqes = static_cast<io_uring_sqe*>(r.sqe_ptr);
+  auto* cqb = static_cast<uint8_t*>(r.cq_ring_ptr);
+  r.cq_head = reinterpret_cast<unsigned*>(cqb + p.cq_off.head);
+  r.cq_tail = reinterpret_cast<unsigned*>(cqb + p.cq_off.tail);
+  r.cq_mask = *reinterpret_cast<unsigned*>(cqb + p.cq_off.ring_mask);
+  r.cq_cqes = reinterpret_cast<io_uring_cqe*>(cqb + p.cq_off.cqes);
+
+  // opcode probe: everything the pump submits must be supported
+  const unsigned nprobe = 64;
+  std::vector<uint8_t> pb(sizeof(io_uring_probe) +
+                              nprobe * sizeof(io_uring_probe_op),
+                          0);
+  auto* probe = reinterpret_cast<io_uring_probe*>(pb.data());
+  if (UringRegisterSys(fd, IORING_REGISTER_PROBE, probe, nprobe) < 0) {
+    RingDestroy(r);
+    return false;
+  }
+  auto op_ok = [&](unsigned op) {
+    return op <= probe->last_op &&
+           (probe->ops[op].flags & IO_URING_OP_SUPPORTED);
+  };
+  if (!op_ok(IORING_OP_SEND) || !op_ok(IORING_OP_RECV) ||
+      !op_ok(IORING_OP_ASYNC_CANCEL)) {
+    RingDestroy(r);
+    return false;
+  }
+  // multishot recv + pbuf rings landed in 5.19; a kernel that knows
+  // IORING_OP_SEND_ZC (6.0) definitely has both — then prove the pbuf
+  // registration by doing it (falls back to single-shot recv if not)
+  r.mshot_ok = op_ok(IORING_OP_SEND_ZC);
+  r.pbuf_ok = r.mshot_ok && RingInitPbuf(r);
+  return true;
+}
+
+// SQE prep: fill the slot, then release the tail so the next enter
+// picks it up. false = SQ full (caller submits first and retries).
+io_uring_sqe* NextSqe(Ring& r) {
+  unsigned tail = *r.sq_tail;
+  unsigned head = __atomic_load_n(r.sq_head, __ATOMIC_ACQUIRE);
+  if (tail - head >= r.sq_entries) return nullptr;
+  io_uring_sqe* sqe = &r.sqes[tail & r.sq_mask];
+  memset(sqe, 0, sizeof(*sqe));
+  r.sq_array[tail & r.sq_mask] = tail & r.sq_mask;
+  return sqe;
+}
+void CommitSqe(Ring& r) {
+  __atomic_store_n(r.sq_tail, *r.sq_tail + 1, __ATOMIC_RELEASE);
+  ++r.to_submit;
+  ++r.sqes_n;
+}
+
+bool PrepSend(Ring& r, int fd, const void* buf, size_t len,
+              uint64_t ud) {
+  io_uring_sqe* sqe = NextSqe(r);
+  if (!sqe) return false;
+  sqe->opcode = IORING_OP_SEND;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(buf);
+  sqe->len = static_cast<uint32_t>(
+      std::min<size_t>(len, 1u << 30));
+  sqe->msg_flags = MSG_NOSIGNAL;
+  sqe->user_data = ud;
+  CommitSqe(r);
+  return true;
+}
+bool PrepRecv(Ring& r, int fd, void* buf, size_t len, uint64_t ud) {
+  io_uring_sqe* sqe = NextSqe(r);
+  if (!sqe) return false;
+  sqe->opcode = IORING_OP_RECV;
+  sqe->fd = fd;
+  sqe->addr = reinterpret_cast<uint64_t>(buf);
+  sqe->len = static_cast<uint32_t>(
+      std::min<size_t>(len, 1u << 30));
+  sqe->user_data = ud;
+  CommitSqe(r);
+  return true;
+}
+bool PrepRecvMultishot(Ring& r, int fd, uint64_t ud) {
+  io_uring_sqe* sqe = NextSqe(r);
+  if (!sqe) return false;
+  sqe->opcode = IORING_OP_RECV;
+  sqe->fd = fd;
+  sqe->ioprio = IORING_RECV_MULTISHOT;
+  sqe->flags = IOSQE_BUFFER_SELECT;
+  sqe->buf_group = kPbufGroup;
+  sqe->user_data = ud;
+  CommitSqe(r);
+  return true;
+}
+bool PrepCancel(Ring& r, uint64_t target_ud, uint64_t ud) {
+  io_uring_sqe* sqe = NextSqe(r);
+  if (!sqe) return false;
+  sqe->opcode = IORING_OP_ASYNC_CANCEL;
+  sqe->fd = -1;
+  sqe->addr = target_ud;
+  sqe->user_data = ud;
+  CommitSqe(r);
+  return true;
+}
+
+bool PeekCqe(Ring& r, io_uring_cqe* out) {
+  unsigned head = *r.cq_head;
+  unsigned tail = __atomic_load_n(r.cq_tail, __ATOMIC_ACQUIRE);
+  if (head == tail) return false;
+  *out = r.cq_cqes[head & r.cq_mask];
+  __atomic_store_n(r.cq_head, head + 1, __ATOMIC_RELEASE);
+  ++r.cqes_n;
+  return true;
+}
+
+// Submit queued SQEs and/or flush completions. min_complete > 0 blocks
+// up to wait_ms for a completion (timed EXT_ARG wait). Returns false
+// only on a non-retryable enter failure (ring unusable).
+bool Enter(Ring& r, unsigned min_complete, int wait_ms) {
+  while (true) {
+    unsigned flags = IORING_ENTER_GETEVENTS;
+    io_uring_getevents_arg arg;
+    __kernel_timespec ts;
+    const void* argp = nullptr;
+    size_t argsz = 0;
+    if (min_complete > 0 && wait_ms >= 0) {
+      memset(&arg, 0, sizeof(arg));
+      ts.tv_sec = wait_ms / 1000;
+      ts.tv_nsec = static_cast<long long>(wait_ms % 1000) * 1000000;
+      arg.ts = reinterpret_cast<uint64_t>(&ts);
+      argp = &arg;
+      argsz = sizeof(arg);
+      flags |= IORING_ENTER_EXT_ARG;
+    }
+    int rc = UringEnterSys(r.fd, r.to_submit, min_complete, flags, argp,
+                           argsz);
+    ++r.enters_n;
+    if (rc >= 0) {
+      r.to_submit -= std::min<unsigned>(r.to_submit,
+                                        static_cast<unsigned>(rc));
+      return true;
+    }
+    if (errno == EINTR) continue;
+    if (errno == ETIME) {
+      // timed wait expired: not an error — and the submit phase ran
+      // before the wait, so the batch is in the kernel's hands
+      r.to_submit = 0;
+      return true;
+    }
+    if (errno == EBUSY || errno == EAGAIN) {
+      // CQ backed up: a GETEVENTS pass without submission drains it
+      if (UringEnterSys(r.fd, 0, 0, IORING_ENTER_GETEVENTS, nullptr,
+                        0) >= 0) {
+        ++r.enters_n;
+        continue;
+      }
+    }
+    return false;
+  }
+}
+
+Ring* ThreadRing() {
+  struct Holder {
+    Ring r;
+    bool ok = false;
+    bool tried = false;
+    ~Holder() {
+      if (ok) RingDestroy(r);
+    }
+  };
+  thread_local Holder h;
+  if (!h.tried) {
+    h.tried = true;
+    h.ok = UringSupported() &&
+           RingInit(h.r, static_cast<unsigned>(UringDepth()));
+  }
+  return h.ok ? &h.r : nullptr;
+}
+
+}  // namespace
+
+int64_t UringDepth() {
+  static const int64_t d = [] {
+    int64_t v = EnvInt("HVT_URING_DEPTH", 64);
+    if (v < 8) v = 8;
+    if (v > 4096) v = 4096;
+    return v;
+  }();
+  return d;
+}
+int64_t UringSpinUs() {
+  // Spinning only helps when the peer can make progress WHILE we spin
+  // — on a single-CPU host it actively hurts (the spin burns the
+  // timeslice the peer needs to produce our completion), so the
+  // default is 0 there and the pump goes straight to the fused
+  // submit+block enter.
+  static const int64_t v = EnvInt(
+      "HVT_URING_SPIN_US",
+      std::thread::hardware_concurrency() > 1 ? 40 : 0);
+  return v < 0 ? 0 : v;
+}
+int64_t UringMultishotMax() {
+  static const int64_t v = EnvInt("HVT_URING_MULTISHOT_MAX", 256 << 10);
+  return v < 0 ? 0 : v;
+}
+
+bool UringSupported() {
+  static const bool ok = [] {
+    Ring r;
+    if (!RingInit(r, 8)) return false;
+    RingDestroy(r);
+    return true;
+  }();
+  return ok;
+}
+
+int ResolveLinkBackend() {
+  static const int be = [] {
+    const char* v = getenv("HVT_LINK_BACKEND");
+    std::string s = v ? v : "auto";
+    if (s == "tcp") return kLinkBackendTcp;
+    if (s == "io_uring" || s == "auto")
+      return UringSupported() ? kLinkBackendUring : kLinkBackendTcp;
+    return kLinkBackendTcp;  // unknown value: the safe backend
+  }();
+  return be;
+}
+
+IoUringLink::~IoUringLink() = default;
+
+size_t IoUringLink::TakeSpill(void* p, size_t n) {
+  size_t have = spill_.size() - spill_off_;
+  if (have == 0) return 0;
+  size_t k = std::min(have, n);
+  memcpy(p, spill_.data() + spill_off_, k);
+  spill_off_ += k;
+  if (spill_off_ == spill_.size()) {
+    spill_.clear();
+    spill_off_ = 0;
+  }
+  return k;
+}
+
+size_t IoUringLink::RecvSome(void* p, size_t n) {
+  Claim claim(this);
+  // spill bytes were rx_-counted when reaped off the ring — serve them
+  // before touching the socket so the stream order is preserved
+  size_t k = TakeSpill(p, n);
+  if (k) return k;
+  return TcpLink::RecvSome(p, n);
+}
+
+void IoUringLink::Recv(void* p, size_t n, int64_t timeout_ms) {
+  Claim claim(this);
+  auto* dst = static_cast<uint8_t*>(p);
+  size_t got = TakeSpill(dst, n);
+  if (got < n) TcpLink::Recv(dst + got, n - got, timeout_ms);
+}
+
+void IoUringLink::PumpDuplex(Transport& in_t, const uint8_t* send_buf,
+                             size_t send_n, uint8_t* recv_buf,
+                             size_t recv_n, size_t chunk_bytes,
+                             size_t& sent, size_t& rcvd,
+                             const std::function<void()>& on_progress) {
+  (void)chunk_bytes;
+  auto* in = dynamic_cast<IoUringLink*>(&in_t);
+  if (!in) return;  // mixed backends: the generic loop handles it
+  Ring* r = ThreadRing();
+  if (!r) return;
+  Claim claim_out(this);
+  Claim claim_in(in);
+
+  // Overrun bytes a previous pump's multishot recv banked belong to
+  // the head of this transfer — consume them before the socket.
+  if (rcvd < recv_n) {
+    size_t k = in->TakeSpill(recv_buf + rcvd, recv_n - rcvd);
+    if (k) {
+      rcvd += k;
+      if (on_progress) on_progress();
+    }
+  }
+
+  // Session-layer conditions the pump does not handle: pending replay,
+  // a link mid-heal, a closed socket. The generic loop's Some() path
+  // owns all of them.
+  auto pumpable = [&]() {
+    return state() == LinkState::HEALTHY && sock_.valid() &&
+           replay_from_ < 0 && in->state() == LinkState::HEALTHY &&
+           in->sock_.valid() && in->replay_from_ < 0;
+  };
+  if (!pumpable()) return;
+
+  const int out_fd = sock_.fd();
+  const int in_fd = in->sock_.fd();
+  const bool use_mshot =
+      r->pbuf_ok && recv_n > 0 &&
+      recv_n <= static_cast<size_t>(UringMultishotMax());
+  uint64_t ud_send = 0, ud_recv = 0, ud_mshot = 0;
+  std::vector<uint64_t> cancel_uds;
+  bool failed = false;
+
+  // flush the ring telemetry into the hub sinks on every exit path
+  struct Flush {
+    Ring* r;
+    ReconnectHub* hub;
+    ~Flush() {
+      if (hub) {
+        if (hub->uring_sqes)
+          hub->uring_sqes->fetch_add(r->sqes_n,
+                                     std::memory_order_relaxed);
+        if (hub->uring_enters)
+          hub->uring_enters->fetch_add(r->enters_n,
+                                       std::memory_order_relaxed);
+        if (hub->uring_cqes)
+          hub->uring_cqes->fetch_add(r->cqes_n,
+                                     std::memory_order_relaxed);
+      }
+      r->sqes_n = r->enters_n = r->cqes_n = 0;
+    }
+  } flush{r, hub_};
+
+  // Reap every posted completion: account bytes exactly like the
+  // SendSome/RecvSome syscall paths (replay ring, tx_/rx_, chaos
+  // cuts), bank multishot overrun in the spill, recycle pbufs.
+  auto reap = [&]() -> size_t {
+    size_t moved = 0;
+    io_uring_cqe cqe;
+    while (PeekCqe(*r, &cqe)) {
+      if (cqe.user_data == ud_send) {
+        ud_send = 0;
+        if (cqe.res > 0) {
+          AccountTx(send_buf + sent, cqe.res);
+          sent += static_cast<size_t>(cqe.res);
+          moved += static_cast<size_t>(cqe.res);
+          if (!sock_.valid()) failed = true;  // chaos cut tripped
+        } else if (cqe.res != -ECANCELED) {
+          failed = true;
+        }
+      } else if (cqe.user_data == ud_recv) {
+        ud_recv = 0;
+        if (cqe.res > 0) {
+          in->AccountRx(cqe.res);
+          rcvd += static_cast<size_t>(cqe.res);
+          moved += static_cast<size_t>(cqe.res);
+          if (!in->sock_.valid()) failed = true;
+        } else if (cqe.res != -ECANCELED) {
+          failed = true;  // 0 = EOF, <0 = socket error
+        }
+      } else if (cqe.user_data == ud_mshot) {
+        if (cqe.res > 0 && (cqe.flags & IORING_CQE_F_BUFFER)) {
+          unsigned bid = cqe.flags >> IORING_CQE_BUFFER_SHIFT;
+          const uint8_t* src = r->arena + bid * kPbufBytes;
+          size_t k = static_cast<size_t>(cqe.res);
+          in->AccountRx(static_cast<int64_t>(k));
+          size_t take = std::min(k, recv_n - rcvd);
+          memcpy(recv_buf + rcvd, src, take);
+          rcvd += take;
+          moved += take;
+          if (k > take) {
+            // the peer ran ahead into the next ring step: bank the
+            // overrun (already rx_-counted) for the next receive
+            in->spill_.insert(in->spill_.end(), src + take,
+                              src + take + (k - take));
+          }
+          PbufAdd(*r, bid);
+          if (!in->sock_.valid()) failed = true;
+        } else if (cqe.res <= 0 && cqe.res != -ECANCELED &&
+                   cqe.res != -ENOBUFS) {
+          failed = true;
+        }
+        if (!(cqe.flags & IORING_CQE_F_MORE))
+          ud_mshot = 0;  // terminated (done, canceled, or ENOBUFS)
+      } else {
+        for (size_t i = 0; i < cancel_uds.size(); ++i)
+          if (cancel_uds[i] == cqe.user_data) {
+            cancel_uds.erase(cancel_uds.begin() +
+                             static_cast<long>(i));
+            break;
+          }
+      }
+    }
+    return moved;
+  };
+
+  // Cancel + reap until nothing is in flight: no SQE may reference the
+  // caller's buffers (or deliver unaccounted bytes) after we return.
+  auto drain = [&]() {
+    const int64_t give_up = NowMs() + 5000;
+    while (ud_send || ud_recv || ud_mshot || r->to_submit ||
+           !cancel_uds.empty()) {
+      // (re)issue cancels for whatever is still armed — idempotent:
+      // a cancel for a completed ud reports -ENOENT on its own CQE
+      if (cancel_uds.empty()) {
+        for (uint64_t target : {ud_send, ud_recv, ud_mshot})
+          if (target) {
+            uint64_t ud = r->next_ud++;
+            if (PrepCancel(*r, target, ud)) cancel_uds.push_back(ud);
+          }
+      }
+      if (!Enter(*r, 1, 50)) break;  // ring unusable: nothing to wait on
+      reap();
+      if (NowMs() >= give_up) break;  // pathological; see header note
+    }
+  };
+
+  const int64_t timeout_ms = OpTimeoutMs();
+  int64_t deadline = timeout_ms > 0 ? NowMs() + timeout_ms : -1;
+  const int64_t spin_us = UringSpinUs();
+
+  try {
+    while (sent < send_n || rcvd < recv_n) {
+      if (failed || !pumpable()) {
+        drain();
+        return;  // partial progress: the generic loop finishes/heals
+      }
+      // top up the submission batch (both directions in one enter)
+      if (sent < send_n && !ud_send) {
+        uint64_t ud = r->next_ud++;
+        if (PrepSend(*r, out_fd, send_buf + sent, send_n - sent, ud))
+          ud_send = ud;
+      }
+      if (rcvd < recv_n) {
+        if (use_mshot) {
+          if (!ud_mshot) {
+            uint64_t ud = r->next_ud++;
+            if (PrepRecvMultishot(*r, in_fd, ud)) ud_mshot = ud;
+          }
+        } else if (!ud_recv) {
+          uint64_t ud = r->next_ud++;
+          if (PrepRecv(*r, in_fd, recv_buf + rcvd, recv_n - rcvd, ud))
+            ud_recv = ud;
+        }
+      }
+      // Completion strategy by host shape. Poll-armed socket CQEs are
+      // posted by kernel task work, which (measured) runs only when
+      // THIS task enters the kernel — a pure userspace CQ-tail poll
+      // never observes them. With a spin window (multi-CPU default)
+      // the whole batch is submitted nonblocking and the window
+      // alternates a free CQ peek with a ~0.3 µs GETEVENTS enter that
+      // runs the pending task work — catching a loopback turnaround
+      // without the sleep/wake of a blocking wait. Without a window
+      // (single-CPU default: spinning would burn the timeslice the
+      // peer needs) submit and wait FUSE into one timed enter — one
+      // syscall per full-duplex ring step, against the generic loop's
+      // poll+send+recv per chunk.
+      size_t moved = 0;
+      if (spin_us > 0) {
+        if (!Enter(*r, 0, -1)) {
+          failed = true;
+          continue;
+        }
+        moved = reap();
+        const int64_t spin_end = NowUs() + spin_us;
+        while (!moved && NowUs() < spin_end) {
+          moved = reap();  // free peek: may already be posted
+          if (moved) break;
+          if (!Enter(*r, 0, -1)) {
+            failed = true;
+            break;
+          }
+          moved = reap();
+        }
+      }
+      if (!moved && !failed) {
+        int wait_ms = 200;
+        if (deadline >= 0) {
+          int64_t left = deadline - NowMs();
+          if (left <= 0) {
+            drain();
+            throw OpTimeoutError(
+                "hvt: data-plane transfer made no progress for " +
+                std::to_string(timeout_ms) + " ms (HVT_OP_TIMEOUT_MS)");
+          }
+          if (left < wait_ms) wait_ms = static_cast<int>(left);
+        }
+        if (!Enter(*r, 1, wait_ms)) {
+          failed = true;
+          continue;
+        }
+        moved = reap();
+        if (!moved) {
+          // idle round: service the engine's other broken links, same
+          // as the generic loop's poll timeout
+          ServiceSiblingLinks(hub_, this);
+        }
+      }
+      if (moved) {
+        if (deadline >= 0) deadline = NowMs() + timeout_ms;
+        if (on_progress) on_progress();
+      }
+    }
+    // transfer complete — the standing multishot recv (if any) must
+    // not outlive the pump: a later blocking Recv would otherwise park
+    // in poll() while the kernel consumes the socket into our pbufs
+    drain();
+  } catch (...) {
+    drain();
+    throw;
+  }
+}
+
+}  // namespace hvt

@@ -1,0 +1,120 @@
+// IoUringLink — the io_uring data-plane backend behind the Transport
+// seam (ROADMAP item 5; selected by HVT_LINK_BACKEND={tcp,io_uring,auto}).
+//
+// What it changes and what it keeps:
+//
+// - The SESSION layer is inherited, not reimplemented: IoUringLink IS a
+//   TcpLink, so per-direction stream sequence numbers, the bounded
+//   replay ring, transparent reconnect by rendezvous role (re-dial /
+//   re-accept / parked-dial adoption), owner-token claims,
+//   Abort-as-shutdown-without-close, and every chaos hook behave
+//   bit-identically under both backends. Only the duplex PUMP — how
+//   bytes move while a ring step is in flight — is replaced.
+//
+// - The pump override (Transport::PumpDuplex) batches a full-duplex
+//   ring step into ONE io_uring_enter per wait: the send direction is
+//   a single IORING_OP_SEND submitted straight from the fusion/chunk
+//   scratch (no staging copy), the receive direction is either a
+//   direct IORING_OP_RECV into the caller's buffer (large transfers)
+//   or a multishot recv (IORING_RECV_MULTISHOT) draining into a
+//   registered provided-buffer ring (IORING_REGISTER_PBUF_RING), so
+//   many arriving chunks complete against one standing SQE. The old
+//   poll+sendmsg+recv-per-chunk loop remains as the fallback and the
+//   failure path: the pump is best-effort and returns partial progress
+//   whenever the link needs the session machinery (replay pending,
+//   reconnect, chaos cut), letting the battle-tested generic loop and
+//   its heal/escalation semantics finish the transfer.
+//
+// - One ring per executing thread (engine thread + each HVT_LANE_WORKERS
+//   lane worker, mirroring DataPlane's per-thread PlaneCtx): rings are
+//   thread_local and lazily created, so disjoint serving lanes pump
+//   disjoint link sets with no shared ring state and no locks.
+//
+// - Completion wait is spin-then-block: after submitting, the pump
+//   polls the CQ from user space with cheap non-blocking
+//   io_uring_enter(GETEVENTS) flushes for up to HVT_URING_SPIN_US
+//   before arming a timed blocking wait (IORING_ENTER_EXT_ARG). On a
+//   same-host gang the completion usually lands inside the spin
+//   window, which removes the sleep/wake scheduler hop that dominates
+//   the small-payload p50 (see docs/performance.md §transport-backends).
+//
+// Everything is raw syscalls (io_uring_setup/enter/register + mmap):
+// the build does not depend on liburing, and constants newer than the
+// toolchain's <linux/io_uring.h> are shimmed locally in uring_link.cc.
+// Kernel support is probed once (UringSupported): ring setup +
+// IORING_REGISTER_PROBE for SEND/RECV/ASYNC_CANCEL, and the provided
+// buffer ring is verified by actually registering one. Callers (engine
+// backend selection, tests, ci.sh) treat a failed probe as "use tcp".
+#pragma once
+
+#include "transport.h"
+
+namespace hvt {
+
+// One-time cached kernel-capability probe: true when a ring can be set
+// up and every opcode the pump submits is supported. auto-selection,
+// `hvt_uring_supported`, and the test/CI skips all key off this.
+bool UringSupported();
+
+// Resolved HVT_LINK_BACKEND: 0 = tcp, 1 = io_uring. The default is
+// `auto` — io_uring wherever the kernel probe passes, with graceful
+// fallback to tcp (and tcp for unknown values), so the fast path is on
+// by default and a locked-down kernel/seccomp profile degrades to the
+// seed behavior instead of failing.
+int ResolveLinkBackend();
+constexpr int kLinkBackendTcp = 0;
+constexpr int kLinkBackendUring = 1;
+
+// HVT_URING_DEPTH (default 64): SQ entries per per-thread ring. Bounds
+// the SQE batch a single enter can submit; the pump needs at most a
+// handful per step, so this only matters for many links per thread.
+int64_t UringDepth();
+// HVT_URING_SPIN_US (default 40): completion-wait spin window before
+// the pump arms a blocking timed wait. 0 = always block immediately
+// (lowest CPU, re-adds the wakeup hop to small-payload latency).
+int64_t UringSpinUs();
+// HVT_URING_MULTISHOT_MAX (default 262144): receive transfers at or
+// under this many bytes use multishot recv through the registered
+// provided-buffer pool (one standing SQE, bytes copied out of ring
+// buffers); larger transfers use direct single-shot recv into the
+// caller's buffer (zero-copy, one SQE per completion).
+int64_t UringMultishotMax();
+
+class IoUringLink : public TcpLink {
+ public:
+  using TcpLink::TcpLink;  // same roles/session state as the TCP link
+  ~IoUringLink() override;
+
+  // The batched pump (see the file comment). Best-effort: advances
+  // `sent`/`rcvd`, fires `on_progress` after each receive completion
+  // so chunk reduces overlap the in-flight transfer, and returns early
+  // (having canceled and reaped every in-flight SQE — nothing may
+  // reference the caller's buffers after return) whenever the session
+  // layer must take over. Throws OpTimeoutError on a no-progress
+  // deadline exactly like the generic loop.
+  void PumpDuplex(Transport& in, const uint8_t* send_buf, size_t send_n,
+                  uint8_t* recv_buf, size_t recv_n, size_t chunk_bytes,
+                  size_t& sent, size_t& rcvd,
+                  const std::function<void()>& on_progress) override;
+
+  // Multishot recv can overshoot the current transfer (the peer runs
+  // ahead into the next ring step); the overrun bytes — already
+  // rx_-counted when reaped, so the replay handshake stays exact —
+  // wait in a spill buffer that every receive path consumes first.
+  size_t RecvSome(void* p, size_t n) override;
+  void Recv(void* p, size_t n, int64_t timeout_ms = -1) override;
+  // While spill bytes are pending the link reports fd() < 0 so the
+  // generic Duplex loop drives RecvSome directly (its heal path)
+  // instead of parking in poll() on a socket that owes nothing.
+  int fd() const override {
+    return spill_off_ < spill_.size() ? -1 : TcpLink::fd();
+  }
+
+ private:
+  size_t TakeSpill(void* p, size_t n);
+  friend struct UringPump;
+  std::vector<uint8_t> spill_;
+  size_t spill_off_ = 0;
+};
+
+}  // namespace hvt

@@ -211,6 +211,17 @@ STATS_LANE_POOL_SCALARS = ("lane_pool_tasks", "lane_workers")
 # plus the matching count — the in-rank blocking the
 # HVT_LANE_WORKERS pool removes (hvt_lane_hol_* on the metrics plane)
 STATS_LANE_HOL_GROUPS = ("lane_hol_ns", "lane_hol_count")
+# transport-backend telemetry appended after the HOL groups (c_api.cc
+# kStatsUringScalars): the resolved HVT_LINK_BACKEND as an info gauge
+# (0 tcp, 1 io_uring — LINK_BACKENDS maps ids to names), the generic
+# duplex pump's syscall counter (poll/send/recv issued by the fallback
+# loop), and the io_uring ring counters — SQEs prepared, io_uring_enter
+# submit/wait calls, CQEs reaped. syscalls-per-op for each backend is
+# pump_syscalls (tcp) vs uring_enters (io_uring) over exec_count.
+STATS_URING_SCALARS = ("link_backend", "pump_syscalls", "uring_sqes",
+                       "uring_enters", "uring_cqes")
+# index == backend wire id (csrc/uring_link.h kLinkBackend*)
+LINK_BACKENDS = ("tcp", "io_uring")
 
 
 def engine_stats() -> dict:
@@ -277,6 +288,9 @@ def engine_stats() -> dict:
     for key in STATS_LANE_HOL_GROUPS:
         out[key] = vals[lbase:lbase + STATS_LANE_SLOTS]
         lbase += STATS_LANE_SLOTS
+    for key in STATS_URING_SCALARS:
+        out[key] = vals[lbase]
+        lbase += 1
     return out
 
 
@@ -345,7 +359,8 @@ STATS_SLOT_COUNT = (len(STATS_SCALARS) + 4 * len(STATS_OPS)
                     + len(STATS_LINK_PLANES)
                     + len(STATS_LANE_HOL_GROUPS) * STATS_LANE_SLOTS
                     + len(STATS_RECOVERY_SCALARS)
-                    + len(STATS_LANE_POOL_SCALARS))
+                    + len(STATS_LANE_POOL_SCALARS)
+                    + len(STATS_URING_SCALARS))
 
 
 def events_supported() -> bool:
@@ -456,6 +471,58 @@ def engine_broken():
     buf = ctypes.create_string_buffer(4096)
     rc = int(lib.hvt_engine_broken(buf, len(buf)))
     return bool(rc), buf.value.decode(errors="replace")
+
+
+def uring_supported() -> bool:
+    """True when this kernel passes the io_uring capability probe
+    (``hvt_uring_supported``): ring setup, EXT_ARG timed waits, and the
+    SEND/RECV/ASYNC_CANCEL opcodes the :class:`IoUringLink` data plane
+    needs — i.e. when ``HVT_LINK_BACKEND=auto`` resolves to io_uring.
+    False when the library or symbol is absent (stale .so degrades to
+    tcp, matching the engine's own fallback)."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_uring_supported", None) is None:
+        return False
+    return bool(lib.hvt_uring_supported())
+
+
+def link_sockopt_probe(plane: int, peer: int):
+    """``getsockopt`` snapshot ``(nodelay, sndbuf, rcvbuf)`` of the live
+    registered link on ``plane`` (0 ctrl, 1 data) to rank ``peer``, or
+    ``None`` when no such link is up (or the symbol is absent). Pins
+    socket-option continuity across transparent heals — every
+    re-dial/re-accept path must re-apply ``TCP_NODELAY`` +
+    ``HVT_SOCK_BUF`` to the fresh socket
+    (tests/test_transport_backends.py)."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_link_sockopt_probe", None) is None:
+        return None
+    out = (ctypes.c_longlong * 3)()
+    if int(lib.hvt_link_sockopt_probe(int(plane), int(peer), out)) != 0:
+        return None
+    return int(out[0]), int(out[1]), int(out[2])
+
+
+def transport_bench(role: int, host: str, port: int, payload: int,
+                    iters: int, backend: int):
+    """Transport-level ping-pong micro-benchmark
+    (``hvt_transport_bench``) — measures exactly the layer
+    ``HVT_LINK_BACKEND`` swaps, with no engine/control plane in the
+    loop. Role 0 listens on ``port``, role 1 dials ``host:port``; both
+    sides run ``iters`` timed full-duplex steps of ``payload`` bytes
+    each direction. Returns ``(p50_ns, mean_ns, syscalls, steps)`` or
+    ``None`` on setup failure / missing symbol. Drive it pairwise from
+    two processes (benchmarks/engine_scaling.py --uring does)."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_transport_bench", None) is None:
+        return None
+    out = (ctypes.c_longlong * 4)()
+    rc = int(lib.hvt_transport_bench(
+        int(role), (host or "127.0.0.1").encode(), int(port),
+        ctypes.c_longlong(int(payload)), int(iters), int(backend), out))
+    if rc != 0:
+        return None
+    return tuple(int(v) for v in out)
 
 
 def engine_rank() -> int:

@@ -270,7 +270,8 @@ def check_slots(root: Path):
                                    "STATS_LINK_PLANES",
                                    "STATS_RECOVERY_SCALARS",
                                    "STATS_LANE_POOL_SCALARS",
-                                   "STATS_LANE_HOL_GROUPS"})
+                                   "STATS_LANE_HOL_GROUPS",
+                                   "STATS_URING_SCALARS"})
     missing = [k for k in ("STATS_SCALARS", "STATS_OPS",
                            "STATS_LAT_BUCKETS", "ABORT_CAUSES")
                if k not in consts]
@@ -295,6 +296,9 @@ def check_slots(root: Path):
     # per-lane head-of-line block (appended after the pool scalars) —
     # optional on the same both-sides terms as the others
     lane_hol = list(consts.get("STATS_LANE_HOL_GROUPS", ()) or ())
+    # transport-backend block (appended after the head-of-line groups)
+    # — optional on the same both-sides terms as the others
+    uring = list(consts.get("STATS_URING_SCALARS", ()) or ())
     expected = list(consts["STATS_SCALARS"])
     for grp in SLOT_OP_GROUPS:
         expected += [f"{grp}[{op}]" for op in consts["STATS_OPS"]]
@@ -317,6 +321,7 @@ def check_slots(root: Path):
     expected += lane_pool
     for grp in lane_hol:
         expected += [f"{grp}[{i}]" for i in range(lane_slots)]
+    expected += uring
     if names != expected:
         diffs = [i for i, (a, b) in enumerate(zip(names, expected))
                  if a != b]
@@ -354,6 +359,13 @@ def check_slots(root: Path):
             f"slots: {C_API_CC} kStatsLaneHolGroups={c_lane_hol} but "
             f"{NATIVE_PY} STATS_LANE_HOL_GROUPS has {len(lane_hol)} "
             f"entries — the head-of-line block would decode shifted")
+    c_uring = _c_int_const(c_api, "kStatsUringScalars") or 0
+    if c_uring != len(uring):
+        vios.append(
+            f"slots: {C_API_CC} kStatsUringScalars={c_uring} but "
+            f"{NATIVE_PY} STATS_URING_SCALARS has {len(uring)} "
+            f"entries — the transport-backend block would decode "
+            f"shifted")
     if c_planes != len(planes):
         vios.append(
             f"slots: {C_API_CC} kStatsLinkPlanes={c_planes} but "
@@ -394,7 +406,7 @@ def check_slots(root: Path):
                    + (1 + len(SLOT_LANE_GROUPS) * c_lanes
                       if c_lanes else 0) + c_tail
                    + c_codecs * ops + c_ef + c_planes + c_recovery
-                   + c_lane_pool + c_lane_hol * c_lanes)
+                   + c_lane_pool + c_lane_hol * c_lanes + c_uring)
         if declared is not None and c_count != declared:
             vios.append(
                 f"slots: {C_API_CC}: C++ layout emits {c_count} slots "
@@ -429,6 +441,7 @@ def check_slots(root: Path):
     claimed += recovery
     claimed += lane_pool
     claimed += lane_hol
+    claimed += uring
     for key in claimed:
         if f'"{key}"' not in basics:
             vios.append(

@@ -584,4 +584,4 @@ def test_stats_slot_count_matches_python_bridge():
 
     text = (REPO_ROOT / hvt_lint.STATS_SLOTS_H).read_text()
     m = hvt_lint._SLOT_COUNT_RE.search(text)
-    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 156
+    assert m and int(m.group(1)) == native.STATS_SLOT_COUNT == 161

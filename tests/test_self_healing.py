@@ -22,9 +22,27 @@ pytestmark = pytest.mark.skipif(
     reason="C++ engine not built (make -C horovod_tpu/csrc)")
 
 
+def _uring_ok():
+    try:
+        from horovod_tpu.engine import native
+        return native.uring_supported()
+    except Exception:
+        return False
+
+
+# The session-layer contracts (replay after drop, epoch handshake,
+# abort/recovery boundary, shutdown-during-reconnect) must hold
+# verbatim under every link backend — IoUringLink swaps only the byte
+# movement under PumpDuplex, so the specs below run per backend, with
+# io_uring skipped cleanly where the kernel probe fails.
+BACKENDS = ["tcp", pytest.param("io_uring", marks=pytest.mark.skipif(
+    not _uring_ok(), reason="io_uring kernel probe failed"))]
+
+
 # ------------------------------------------------------- transient heals
 
-def test_flaky_conn_heals_bit_identical(tmp_path):
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_flaky_conn_heals_bit_identical(tmp_path, backend):
     """The acceptance gang: flaky_conn cuts rank 1's links mid-allreduce
     (tx- and rx-side, twice). Every rank must finish all ops with
     bit-exact results, ≥1 RECONNECT event recorded on the cut ranks,
@@ -52,6 +70,7 @@ def test_flaky_conn_heals_bit_identical(tmp_path):
     procs, logs = spawn_gang(
         body, np=4, tmp_path=tmp_path,
         extra_env={"HVT_FAULT_INJECT": "flaky_conn:rank=1:count=2:after_ops=3",
+                   "HVT_LINK_BACKEND": backend,
                    "HVT_OP_TIMEOUT_MS": "30000"})
     codes, outs = finish_gang(procs, logs, timeout=150)
     for rank in range(4):
@@ -220,7 +239,8 @@ def test_tree_mode_member_link_heals_via_leader_reaccept(tmp_path):
 
 # ------------------------------------------------- abort/recovery boundary
 
-def test_replay_budget_exhaustion_escalates(tmp_path):
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_replay_budget_exhaustion_escalates(tmp_path, backend):
     """An rx-side cut mid-4MB-transfer loses far more than a 256-byte
     replay ring can cover: the link must ESCALATE into the coordinated
     abort with a reason naming the peer and HVT_REPLAY_BUDGET_BYTES —
@@ -241,6 +261,7 @@ def test_replay_budget_exhaustion_escalates(tmp_path):
     procs, logs = spawn_gang(
         body, np=4, tmp_path=tmp_path,
         extra_env={"HVT_FAULT_INJECT": "flaky_conn:rank=1:count=2:after_ops=2",
+                   "HVT_LINK_BACKEND": backend,
                    "HVT_REPLAY_BUDGET_BYTES": "256",
                    "HVT_SOCK_BUF": "262144",
                    "HVT_OP_TIMEOUT_MS": "15000",
@@ -287,7 +308,8 @@ def test_reconnect_disabled_restores_pr4_abort(tmp_path):
     assert caught >= 1, outs
 
 
-def test_shutdown_during_inflight_reconnect_exits_cleanly(tmp_path):
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_shutdown_during_inflight_reconnect_exits_cleanly(tmp_path, backend):
     """A partition with a long hold parks the engine thread inside a
     reconnect episode; hvt.shutdown() must cut it short (the hub stop
     gate) and the process must exit 0 promptly — no join hang, no
@@ -325,6 +347,7 @@ def test_shutdown_during_inflight_reconnect_exits_cleanly(tmp_path):
             "HVT_HIERARCHICAL_ALLREDUCE": "0",
             "HVT_TOPO_HOST": "hA" if rank == 0 else "hB",
             "HVT_FAULT_INJECT": "partition:hosts=hA|hB:ms=60000",
+            "HVT_LINK_BACKEND": backend,
             "HVT_OP_TIMEOUT_MS": "30000",
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "",
@@ -381,7 +404,8 @@ def test_sigkill_still_converges_one_deadline(tmp_path):
 
 # --------------------------------------------------------- observability
 
-def test_diagnostics_reports_link_state(tmp_path):
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_diagnostics_reports_link_state(tmp_path, backend):
     """hvt.diagnostics()['links'] / debugz: every link carries
     peer/plane/state/retries/epoch/in_state_sec, and a healed link
     shows a bumped session epoch."""
@@ -410,6 +434,7 @@ def test_diagnostics_reports_link_state(tmp_path):
     procs, logs = spawn_gang(
         body, np=3, tmp_path=tmp_path,
         extra_env={"HVT_FAULT_INJECT": "flaky_conn:rank=1:count=1:after_ops=3",
+                   "HVT_LINK_BACKEND": backend,
                    "HVT_OP_TIMEOUT_MS": "30000"})
     codes, outs = finish_gang(procs, logs, timeout=120)
     for rank in range(3):

@@ -885,14 +885,28 @@ def test_batching_determinism_under_clock_skew():
     assert "BATCH-DETERMINISM-OK" in out
 
 
+def _uring_ok():
+    try:
+        from horovod_tpu.engine import native
+        return native.uring_supported()
+    except Exception:
+        return False
+
+
 @needs_engine
-def test_lane_pool_parity_and_engagement():
+@pytest.mark.parametrize("backend", [
+    "tcp", pytest.param("io_uring", marks=pytest.mark.skipif(
+        not _uring_ok(), reason="io_uring kernel probe failed"))])
+def test_lane_pool_parity_and_engagement(backend):
     """HVT_LANE_WORKERS A/B on a real 3-rank gang with two overlapping
     lanes ({0,1} hot, {0,2} idle — they share only rank 0, so the pool
     may run them concurrently): results are bit-identical to the
     single-thread engine, and the pool actually executed tasks (the
     isolation RATIO is pinned by benchmarks/serving_soak.py under
-    controlled load, not by this CI box)."""
+    controlled load, not by this CI box). Parameterized over link
+    backends — concurrent lane workers pumping overlapping links is
+    the owner-token-claim contract (ProbeAndRepair must SKIP a link
+    another thread drives), which each backend's pump must honor."""
     body = """
         import zlib
         from horovod_tpu.common.process_sets import ProcessSet, add_process_set
@@ -921,8 +935,10 @@ def test_lane_pool_parity_and_engagement():
         print(f"LANE-CRC rank={r} crc={crc} pool={st['lane_pool_tasks']}"
               f" workers={st['lane_workers']}", flush=True)
     """
-    env0 = {"HVT_LANE_WORKERS": "0", "HVT_SHM_ALLREDUCE": "0"}
-    env2 = {"HVT_LANE_WORKERS": "2", "HVT_SHM_ALLREDUCE": "0"}
+    env0 = {"HVT_LANE_WORKERS": "0", "HVT_SHM_ALLREDUCE": "0",
+            "HVT_LINK_BACKEND": backend}
+    env2 = {"HVT_LANE_WORKERS": "2", "HVT_SHM_ALLREDUCE": "0",
+            "HVT_LINK_BACKEND": backend}
     out0 = run_workers(body, np_=3, timeout=240, extra_env=env0)
     out2 = run_workers(body, np_=3, timeout=240, extra_env=env2)
 
