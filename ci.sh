@@ -61,6 +61,12 @@
 #                      # hvt_top --once --json round-trip, plus schema
 #                      # --check of the fresh AND committed
 #                      # benchmarks/r13_telemetry_scaling.json
+#   ./ci.sh --fuzz     # wire-protocol lane: the hvt_lint proto pass
+#                      # (grammar extraction gate), a UBSan decoder
+#                      # build, the seeded deterministic frame-fuzz
+#                      # campaign (fixed mutant count per decoder
+#                      # family) and the committed tests/corpus replay
+#                      # through hvt_decode_probe
 #
 # Stages:
 #   1. build the C++ core engine (csrc -> libhvt_core.so) + the clang
@@ -89,6 +95,7 @@ OBS=0
 ELASTIC=0
 SERVESOAK=0
 URING_LANE=0
+FUZZ=0
 [[ "${1:-}" == "--fast" ]] && FAST=1
 [[ "${1:-}" == "--chaos" ]] && CHAOS=1
 [[ "${1:-}" == "--sanitize" ]] && SANITIZE=1
@@ -102,6 +109,7 @@ URING_LANE=0
 [[ "${1:-}" == "--elastic" ]] && ELASTIC=1
 [[ "${1:-}" == "--servesoak" ]] && SERVESOAK=1
 [[ "${1:-}" == "--uring" ]] && URING_LANE=1
+[[ "${1:-}" == "--fuzz" ]] && FUZZ=1
 
 if [[ "${1:-}" == "--lint" ]]; then
   # pure text analysis — no build, no jax session, ~1 s
@@ -214,6 +222,36 @@ if [[ "$URING_LANE" == "1" ]]; then
   python benchmarks/engine_scaling.py --check \
     benchmarks/r18_uring_sweep.json
   echo "CI OK (uring)"
+  exit 0
+fi
+
+if [[ "$FUZZ" == "1" ]]; then
+  echo "=== [2/4] wire-protocol grammar gate (hvt_lint proto) ==="
+  python -m horovod_tpu.tools.hvt_lint proto
+  echo "=== [3/4] UBSan decoder build ==="
+  make -C horovod_tpu/csrc ubsan
+  FUZZ_CORE="$PWD/horovod_tpu/csrc/build-ubsan/libhvt_core.so"
+  UBSAN_LIB="$(gcc -print-file-name=libubsan.so 2>/dev/null || true)"
+  FUZZ_ENV=()
+  if [[ "$UBSAN_LIB" == /* && -e "$UBSAN_LIB" ]]; then
+    # halt_on_error: any UB report inside a decoder aborts the
+    # campaign — a typed rejection must come from C++ control flow,
+    # never from UB that happened to not crash
+    FUZZ_ENV=(LD_PRELOAD="$UBSAN_LIB"
+              UBSAN_OPTIONS="halt_on_error=1 print_stacktrace=1")
+  else
+    echo "WARN: libubsan not found — campaign runs on the" \
+         "uninstrumented production build" >&2
+    FUZZ_CORE="$PWD/horovod_tpu/csrc/build/libhvt_core.so"
+  fi
+  echo "=== [4/4] deterministic frame-fuzz campaign + corpus replay ==="
+  # fixed mutant count + fixed seed: the lane is byte-reproducible, a
+  # red run replays exactly with the same command
+  timeout -k 30 "$PYTEST_GUARD_SEC" \
+    env HVT_CORE_LIB="$FUZZ_CORE" "${FUZZ_ENV[@]}" \
+    python -m horovod_tpu.tools.hvt_fuzz --campaign 2500 --seed 20 \
+    --replay tests/corpus/proto_frames.jsonl
+  echo "CI OK (fuzz)"
   exit 0
 fi
 

@@ -574,6 +574,125 @@ int hvt_transport_bench(int role, const char* host, int port,
   }
 }
 
+// ---- wire-grammar decode probe -------------------------------------------
+
+// Feeds raw bytes into one decoder family and classifies the outcome —
+// the C-side half of the deterministic frame fuzzer
+// (tools/hvt_fuzz.py). The control probes check the abort bit first,
+// exactly like the engine readers' IsAbortFrame guard, and the codec
+// probe enforces the transfer-size agreement the data plane pins
+// before any decompress. Families:
+//   0 announce frame     (DecodeAnnounceFrame)
+//   1 leader aggregate   (dispatch flag byte + DecodeAggregateFrame)
+//   2 response frame     (Engine::DecodeResponseFrame frame grammar)
+//   3 session HELLO      (TcpLink::ReadHello grammar)
+//   4 session ACK        (TcpLink reconnect-ack grammar)
+//   5 codec block stream (leading wire-codec id byte + blocks)
+//   6 request list       (DecodeRequestList)
+//   7 response list      (DecodeResponseList)
+// Returns 0 = decoded clean, 1 = typed rejection (TruncatedFrameError
+// or the documented magic/size agreement check), 2 = any OTHER
+// exception — a containment failure the fuzzer reports as a bug —
+// and -1 for a null buffer or unknown family.
+int hvt_decode_probe(int family, const void* data, long long nbytes) {
+  if (nbytes < 0 || (nbytes > 0 && data == nullptr)) return -1;
+  const auto* p = static_cast<const uint8_t*>(data);
+  std::vector<uint8_t> buf(p, p + static_cast<size_t>(nbytes));
+  try {
+    hvt::Reader rd(buf);
+    switch (family) {
+      case 0:
+      case 1:
+      case 2: {
+        if (!buf.empty() && (buf[0] & hvt::kAbortFrameFlag) != 0) {
+          // an ABORT replaces any expected control frame (engine.cc
+          // ParseAbortFrame): u8 flag | i32 origin | str reason
+          rd.u8();
+          (void)rd.i32();
+          (void)rd.str();
+        } else if (family == 0) {
+          (void)hvt::DecodeAnnounceFrame(rd, 0);
+        } else if (family == 1) {
+          rd.u8();  // the kCtrlFlagAggregate dispatch byte
+          (void)hvt::DecodeAggregateFrame(rd);
+        } else {
+          // rank-0 → worker response frame (Engine::DecodeResponseFrame
+          // minus the engine-state side effects): flags | tuned cycle |
+          // tuned bits | evictions | positions form or full list
+          uint8_t first = rd.u8();
+          (void)rd.i32();
+          (void)rd.u8();
+          (void)rd.i64vec();
+          if (first & hvt::kRespFlagPositions) {
+            (void)rd.u8();
+            (void)rd.u8();
+            (void)rd.i64();
+            (void)rd.i64vec();
+          } else {
+            (void)hvt::DecodeResponseList(rd);
+          }
+        }
+        break;
+      }
+      case 3: {  // HELLO: magic | rank | plane | epoch | rx
+        if (rd.i32() != hvt::kLinkHelloMagic) return 1;
+        (void)rd.i32();
+        (void)rd.u8();
+        (void)rd.i64();
+        (void)rd.i64();
+        break;
+      }
+      case 4: {  // ACK: magic | epoch | rx
+        if (rd.i32() != hvt::kLinkHelloMagic) return 1;
+        (void)rd.i64();
+        (void)rd.i64();
+        break;
+      }
+      case 5: {
+        // The data plane never decodes a stream whose byte count
+        // disagrees with CompressedSize(n) — both ends derive the
+        // transfer size from the negotiated element count — so a size
+        // with no matching n is the typed rejection here.
+        uint8_t id = rd.u8();
+        const hvt::Codec* c = hvt::CodecFor(static_cast<hvt::WireCodec>(id));
+        if (c == nullptr) return 1;  // RAW / unknown id: no block grammar
+        const size_t s = rd.remaining();
+        const size_t wbb = c->WireBlockBytes();
+        const int64_t be = c->BlockElems();
+        int64_t n = static_cast<int64_t>(s / wbb) * be;
+        const size_t tail = s % wbb;
+        if (tail != 0) {
+          int64_t rem = -1;
+          for (int64_t k = 1; k < be; ++k)
+            if (c->CompressedSize(k) == tail) {
+              rem = k;
+              break;
+            }
+          if (rem < 0) return 1;
+          n += rem;
+        }
+        if (c->CompressedSize(n) != s) return 1;
+        std::vector<float> out(static_cast<size_t>(n));
+        c->Decompress(out.data(), buf.data() + 1, n);
+        break;
+      }
+      case 6:
+        (void)hvt::DecodeRequestList(rd);
+        break;
+      case 7:
+        (void)hvt::DecodeResponseList(rd);
+        break;
+      default:
+        return -1;
+    }
+  } catch (const hvt::TruncatedFrameError&) {
+    return 1;
+  } catch (const std::exception&) {
+    return 2;
+  }
+  return 0;
+}
+
 // JSON diagnostics snapshot: engine queue depth, pending tensors with
 // ages, and (on rank 0) the negotiation arrival table with per-tensor
 // missing-rank sets — the machine-readable face of the stall inspector.

@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cfloat>
 #include <cmath>
+#include <cstring>
 #include <string>
 
 namespace hvt {
@@ -30,16 +31,25 @@ class Bf16Codec final : public Codec {
   }
   size_t WireBlockBytes() const override { return 2; }
   int64_t BlockElems() const override { return 1; }
+  // memcpy, not a reinterpret_cast walk: the codec stream sits at an
+  // arbitrary byte offset inside a frame buffer (codec id byte, frame
+  // headers), so 2-byte-aligned access is not guaranteed — a punned
+  // uint16_t* load/store is UB there (fuzzer-found under UBSan).
   void Compress(uint8_t* dst, const float* src, int64_t n) const override {
-    auto* __restrict d = reinterpret_cast<uint16_t*>(dst);
     const float* __restrict s = src;
-    for (int64_t i = 0; i < n; ++i) d[i] = FloatToBf16(s[i]);
+    for (int64_t i = 0; i < n; ++i) {
+      uint16_t v = FloatToBf16(s[i]);
+      memcpy(dst + 2 * i, &v, 2);
+    }
   }
   void Decompress(float* dst, const uint8_t* src,
                   int64_t n) const override {
     float* __restrict d = dst;
-    const auto* __restrict s = reinterpret_cast<const uint16_t*>(src);
-    for (int64_t i = 0; i < n; ++i) d[i] = Bf16ToFloat(s[i]);
+    for (int64_t i = 0; i < n; ++i) {
+      uint16_t v;
+      memcpy(&v, src + 2 * i, 2);
+      d[i] = Bf16ToFloat(v);
+    }
   }
   void Roundtrip(float* dst, int64_t n) const override {
     float* __restrict d = dst;

@@ -54,6 +54,7 @@
 #include "common.h"
 #include "events.h"
 #include "net.h"
+#include "wire.h"
 
 namespace hvt {
 
@@ -837,16 +838,20 @@ class TcpLink : public Transport {
     if (!s.valid()) return false;
     int64_t peer_epoch = 0, peer_rx = -1;
     try {
+      // HELLO: magic | rank | plane | epoch | rx — built with the
+      // wire.h Writer/Reader pair, so the session handshake rides the
+      // same bounds-checked containment path as every control frame
+      // (a truncated ACK throws TruncatedFrameError, caught below).
       Writer w;
-      w.i32_raw(kLinkHelloMagic);
-      w.i32_raw(hub_ ? hub_->my_rank : -1);
-      w.u8_raw(static_cast<uint8_t>(plane_));
-      w.i64_raw(epoch_);
-      w.i64_raw(rx_);
+      w.i32(kLinkHelloMagic);
+      w.i32(hub_ ? hub_->my_rank : -1);
+      w.u8(static_cast<uint8_t>(plane_));
+      w.i64(epoch_);
+      w.i64(rx_);
       s.SendFrame(w.buf, 2000);
       auto ack = s.RecvFrame(std::min<int64_t>(
           3000, std::max<int64_t>(100, ack_deadline_ms - NowMs())));
-      Reader2 rd(ack);
+      Reader rd(ack);
       if (rd.i32() != kLinkHelloMagic) return false;
       peer_epoch = rd.i64();
       peer_rx = rd.i64();
@@ -933,7 +938,7 @@ class TcpLink : public Transport {
                  int64_t* rx) {
     try {
       auto f = s.RecvFrame(2000);
-      Reader2 rd(f);
+      Reader rd(f);
       if (rd.i32() != kLinkHelloMagic) return false;
       *rank = rd.i32();
       *plane = rd.u8();
@@ -948,9 +953,9 @@ class TcpLink : public Transport {
   bool TryAck(Sock& s, int64_t peer_epoch) {
     try {
       Writer w;
-      w.i32_raw(kLinkHelloMagic);
-      w.i64_raw(std::max(epoch_.load(), peer_epoch) + 1);
-      w.i64_raw(rx_);
+      w.i32(kLinkHelloMagic);
+      w.i64(std::max(epoch_.load(), peer_epoch) + 1);
+      w.i64(rx_);
       s.SendFrame(w.buf, 2000);
       return true;
     } catch (const std::exception&) {
@@ -981,47 +986,6 @@ class TcpLink : public Transport {
     }
     return true;
   }
-
-  // Minimal little-endian writer/reader for the handshake frames —
-  // wire.h's Writer/Reader live above the transport layer, so the
-  // link speaks its own 29-byte hello to avoid a dependency cycle.
-  struct Writer {
-    std::vector<uint8_t> buf;
-    void append(const void* p, size_t n) {
-      auto* b = static_cast<const uint8_t*>(p);
-      buf.insert(buf.end(), b, b + n);
-    }
-    void u8_raw(uint8_t v) { buf.push_back(v); }
-    void i32_raw(int32_t v) { append(&v, 4); }
-    void i64_raw(int64_t v) { append(&v, 8); }
-  };
-  struct Reader2 {
-    const std::vector<uint8_t>& b;
-    size_t pos = 0;
-    explicit Reader2(const std::vector<uint8_t>& v) : b(v) {}
-    void need(size_t n) {
-      if (b.size() - pos < n)
-        throw PeerLostError("hvt: truncated reconnect handshake");
-    }
-    uint8_t u8() {
-      need(1);
-      return b[pos++];
-    }
-    int32_t i32() {
-      need(4);
-      int32_t v;
-      memcpy(&v, b.data() + pos, 4);
-      pos += 4;
-      return v;
-    }
-    int64_t i64() {
-      need(8);
-      int64_t v;
-      memcpy(&v, b.data() + pos, 8);
-      pos += 8;
-      return v;
-    }
-  };
 
   Sock sock_;
   LinkPlane plane_;

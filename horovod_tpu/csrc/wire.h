@@ -192,6 +192,15 @@ class Reader {
   size_t pos_ = 0;
 };
 
+// Grammar-derived minimum encoded sizes (every variable-length field
+// empty): the sum of the fixed-width writer calls in the matching
+// Encode* body, counting 4 bytes for each length-prefixed str/i64vec.
+// The proto pass in tools/hvt_lint.py re-derives these totals from the
+// encoder bodies and fails lint when a field is added to an encoder
+// without updating the paired Reader::count() bound below.
+constexpr size_t kMinEncodedRequestBytes = 51;
+constexpr size_t kMinEncodedResponseBytes = 58;
+
 inline void EncodeRequest(Writer& w, const Request& r) {
   w.i32(r.rank);
   w.u8(static_cast<uint8_t>(r.op));
@@ -232,9 +241,9 @@ inline void EncodeRequestList(Writer& w, const std::vector<Request>& rs) {
 }
 
 inline std::vector<Request> DecodeRequestList(Reader& rd) {
-  // every encoded request occupies well over 16 bytes — the count
-  // bound rejects corrupt lengths before the allocation
-  size_t n = rd.count(16);
+  // per-element bound = the exact empty-field encoded size of one
+  // Request — rejects corrupt lengths before the allocation
+  size_t n = rd.count(kMinEncodedRequestBytes);
   std::vector<Request> rs(n);
   for (auto& r : rs) r = DecodeRequest(rd);
   return rs;
@@ -264,7 +273,10 @@ inline Response DecodeResponse(Reader& rd) {
   Response r;
   r.kind = static_cast<Response::Kind>(rd.u8());
   r.op = static_cast<OpType>(rd.u8());
-  int32_t n = rd.i32();
+  // each name is a length-prefixed str (>= 4 bytes); routing the count
+  // through the bound rejects a negative/huge names count before the
+  // resize can allocate from wire data
+  size_t n = rd.count(4);
   r.names.resize(n);
   for (auto& s : r.names) s = rd.str();
   r.error = rd.str();
@@ -289,7 +301,9 @@ inline void EncodeResponseList(Writer& w, const std::vector<Response>& rs) {
 }
 
 inline std::vector<Response> DecodeResponseList(Reader& rd) {
-  size_t n = rd.count(16);  // see DecodeRequestList
+  // per-element bound pinned independently of DecodeRequestList: the
+  // exact empty-field encoded size of one Response
+  size_t n = rd.count(kMinEncodedResponseBytes);
   std::vector<Response> rs(n);
   for (auto& r : rs) r = DecodeResponse(rd);
   return rs;
@@ -466,7 +480,8 @@ inline std::vector<Announce> DecodeAggregateFrame(Reader& rd) {
   auto invalids = rd.i64vec();
   if (!anns.empty())
     anns[0].invalids = std::move(invalids);  // rank-agnostic broadcast
-  size_t n_reqs = rd.count(16);  // see DecodeRequestList
+  // each group: one full Request body + its announcing-rank i64vec
+  size_t n_reqs = rd.count(kMinEncodedRequestBytes + 4);
   for (size_t g = 0; g < n_reqs; ++g) {
     Request proto = DecodeRequest(rd);
     auto ranks = rd.i64vec();

@@ -109,6 +109,12 @@ def _load():
                                              ctypes.c_longlong]
             lib.hvt_engine_broken.argtypes = [ctypes.c_char_p,
                                               ctypes.c_int]
+        if getattr(lib, "hvt_decode_probe", None) is not None:
+            # wire-grammar decode probe (tools/hvt_fuzz.py); absent in
+            # a stale .so — decode_probe() returns None
+            lib.hvt_decode_probe.argtypes = [ctypes.c_int,
+                                             ctypes.c_char_p,
+                                             ctypes.c_longlong]
         lib.hvt_result_read.argtypes = [ctypes.c_int, ctypes.c_void_p,
                                         ctypes.c_longlong]
         lib.hvt_result_recv_splits.argtypes = [
@@ -484,6 +490,24 @@ def uring_supported() -> bool:
     if lib is None or getattr(lib, "hvt_uring_supported", None) is None:
         return False
     return bool(lib.hvt_uring_supported())
+
+
+def decode_probe(family: int, data: bytes):
+    """Feed raw ``data`` into one wire-decoder family
+    (``hvt_decode_probe``) and return the classified outcome: ``0``
+    decoded clean, ``1`` typed rejection (``TruncatedFrameError`` or the
+    documented magic/size agreement check), ``2`` any other exception —
+    a containment failure — and ``-1`` for an unknown family. Returns
+    ``None`` when the library or symbol is absent (stale .so). Families
+    (see c_api.cc): 0 announce, 1 aggregate, 2 response frame, 3 HELLO,
+    4 ACK, 5 codec block stream, 6 request list, 7 response list. The
+    deterministic fuzzer (tools/hvt_fuzz.py) and the corpus replay test
+    drive every family through this probe."""
+    lib = _load()
+    if lib is None or getattr(lib, "hvt_decode_probe", None) is None:
+        return None
+    return int(lib.hvt_decode_probe(int(family), bytes(data),
+                                    len(data)))
 
 
 def link_sockopt_probe(plane: int, peer: int):

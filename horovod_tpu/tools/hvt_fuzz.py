@@ -1,0 +1,500 @@
+"""Deterministic structure-aware fuzzer for the hvt wire grammar.
+
+The Python half of the hvt_proto frame-fuzz campaign: this module
+re-implements the ``csrc/wire.h`` encoders just far enough to build
+VALID grammar seeds for every decoder family, records each field
+boundary and every i32 length/count field while encoding, and then
+derives the mutation classes straight from that structure —
+
+* ``truncate``   — cut the frame at EVERY recorded field boundary
+* ``inflate``    — patch each length/count field to negative, huge,
+                   off-by-one and mid-range values (count overflow)
+* ``flagflip``   — flip each bit of the leading flag byte
+* ``dup_rank``   — aggregate roster with a duplicated rank (must land
+                   on the duplicate-roster rejection, PR 8)
+* ``random``     — seeded byte flips/splices to fill the campaign quota
+
+Every mutant is fed to the C decoder through ``hvt_decode_probe``
+(csrc/c_api.cc) and must classify as ``0`` (decoded clean) or ``1``
+(typed rejection — ``TruncatedFrameError`` or the documented
+magic/size agreement check). Outcome ``2`` (any other exception) or a
+crash is a containment failure and fails the campaign. Everything is
+driven by one ``random.Random(seed)`` — same seed, same build → the
+byte-identical campaign, which is what lets CI replay it.
+
+Usage (also the ``ci.sh --fuzz`` lane):
+
+    python -m horovod_tpu.tools.hvt_fuzz --campaign 10000 --seed 20
+    python -m horovod_tpu.tools.hvt_fuzz --replay tests/corpus/proto_frames.jsonl
+    python -m horovod_tpu.tools.hvt_fuzz --campaign 2500 --write-corpus tests/corpus/proto_frames.jsonl
+
+Run it against a sanitizer build via ``HVT_CORE_LIB`` (see
+tests/test_sanitizers.py, which replays the committed corpus under
+ASan and UBSan).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import struct
+import sys
+from random import Random
+
+from horovod_tpu.engine import native
+
+# family ids must match the hvt_decode_probe switch in csrc/c_api.cc
+FAMILIES = {
+    "announce": 0,
+    "aggregate": 1,
+    "response_frame": 2,
+    "hello": 3,
+    "ack": 4,
+    "codec_block": 5,
+    "request_list": 6,
+    "response_list": 7,
+}
+
+_LINK_HELLO_MAGIC = 0x4856524C  # transport.h kLinkHelloMagic ("HVRL")
+_CTRL_FLAG_BITMASK = 0x04
+_CTRL_FLAG_AGGREGATE = 0x08
+_RESP_FLAG_POSITIONS = 0x02
+
+# values a corrupted length/count field takes: negative, i32 max
+# (count overflow past remaining()/min_elem), a mid-range lie, zero,
+# and off-by-one in both directions relative to the true count
+_INFLATE_VALUES = (-1, -2147483648, 0x7FFFFFFF, 0x10000, 0)
+
+
+class FrameWriter:
+    """wire.h ``Writer`` mirror that records the frame structure.
+
+    ``bounds`` holds every field boundary offset (truncation points);
+    ``counts`` holds the offset of every i32 that the decoder reads as
+    a length or element count (inflation points).
+    """
+
+    def __init__(self):
+        self.buf = bytearray()
+        self.bounds = [0]
+        self.counts = []
+
+    def _mark(self):
+        self.bounds.append(len(self.buf))
+
+    def u8(self, v):
+        self.buf.append(v & 0xFF)
+        self._mark()
+
+    def i32(self, v, is_count=False):
+        if is_count:
+            self.counts.append(len(self.buf))
+        self.buf += struct.pack("<i", v)
+        self._mark()
+
+    def i64(self, v):
+        self.buf += struct.pack("<q", v)
+        self._mark()
+
+    def f64(self, v):
+        self.buf += struct.pack("<d", v)
+        self._mark()
+
+    def str_(self, s):
+        b = s.encode()
+        self.i32(len(b), is_count=True)
+        self.buf += b
+        self._mark()
+
+    def i64vec(self, v):
+        self.i32(len(v), is_count=True)
+        for x in v:
+            self.i64(x)
+
+    def raw(self, b):
+        self.buf += bytes(b)
+        self._mark()
+
+
+def _encode_request(w, rank=0, name="t", dims=(4, 2), splits=(),
+                    members=(), group_id=-1, group_size=0):
+    w.i32(rank)
+    w.u8(0)                      # op = ALLREDUCE
+    w.u8(0)                      # reduce = SUM
+    w.str_(name)
+    w.u8(7)                      # dtype = FLOAT32
+    w.i64vec(list(dims))
+    w.i32(0)                     # root_rank
+    w.f64(1.0)
+    w.f64(1.0)
+    w.i64vec(list(splits))
+    w.i32(group_id)
+    w.i32(group_size)
+    w.i64vec(list(members))
+
+
+def _encode_response(w, names=("t",), numels=(8,)):
+    w.u8(0)                      # kind = TENSOR
+    w.u8(0)                      # op = ALLREDUCE
+    w.i32(len(names), is_count=True)
+    for n in names:
+        w.str_(n)
+    w.str_("")                   # error
+    w.u8(7)                      # dtype
+    w.u8(0)                      # reduce
+    w.i32(0)                     # root
+    w.f64(1.0)
+    w.f64(1.0)
+    w.i64vec(list(numels))
+    w.i64vec([])                 # rows_flat
+    w.i64(1)                     # trailing
+    w.i32(-1)                    # group_id
+    w.i64vec([])                 # members
+    w.u8(0)                      # wire_intra
+    w.u8(0)                      # wire_inter
+
+
+def _seed_announce_plain():
+    w = FrameWriter()
+    w.u8(0)                      # flags
+    w.i64vec([1, 5, 9])          # hits
+    w.i64vec([2])                # invalids
+    w.i32(2, is_count=True)      # request list
+    _encode_request(w, rank=3, name="grad/a", dims=(16,))
+    _encode_request(w, rank=3, name="grad/b", dims=(3, 3),
+                    members=(0, 1, 2), group_id=1, group_size=2)
+    return w
+
+
+def _seed_announce_bitmask():
+    w = FrameWriter()
+    w.u8(_CTRL_FLAG_BITMASK)
+    mask = bytearray(4)
+    for p in (0, 9, 30):
+        mask[p // 8] |= 1 << (p % 8)
+    w.i32(len(mask), is_count=True)
+    w.raw(mask)
+    return w
+
+
+def _seed_aggregate(dup_rank=False):
+    w = FrameWriter()
+    w.u8(_CTRL_FLAG_AGGREGATE)   # dispatch byte (probe consumes it)
+    roster = [(0, 0), (1, 0), (1 if dup_rank else 2, 2)]
+    w.i32(len(roster), is_count=True)
+    for rank, flags in roster:
+        w.i32(rank)
+        w.u8(flags)
+    w.i32(1, is_count=True)      # hit groups
+    w.i64vec([0, 1])             # ranks
+    w.i64vec([3, 7])             # positions
+    w.i64vec([5])                # invalids
+    w.i32(1, is_count=True)      # request groups
+    _encode_request(w, rank=-1, name="grad/x", dims=(8,))
+    w.i64vec([0, 2])             # announcing ranks
+    return w
+
+
+def _seed_response_frame_full():
+    w = FrameWriter()
+    w.u8(0)                      # resp flags
+    w.i32(10)                    # tuned cycle
+    w.u8(1)                      # tuned bits
+    w.i64vec([4])                # evictions
+    w.i32(2, is_count=True)      # response list
+    _encode_response(w, names=("grad/a",), numels=(16,))
+    _encode_response(w, names=("grad/b", "grad/c"), numels=(9, 9))
+    return w
+
+
+def _seed_response_frame_positions():
+    w = FrameWriter()
+    w.u8(_RESP_FLAG_POSITIONS)
+    w.i32(0)                     # tuned cycle
+    w.u8(3)                      # tuned bits
+    w.i64vec([])                 # evictions
+    w.u8(0)                      # wire_intra
+    w.u8(2)                      # wire_inter
+    w.i64(2048)                  # fusion threshold
+    w.i64vec([0, 1, 2])          # cache positions
+    return w
+
+
+def _seed_abort():
+    # an ABORT replaces any expected control frame (engine.cc)
+    w = FrameWriter()
+    w.u8(0x80)                   # kAbortFrameFlag
+    w.i32(4)                     # origin rank
+    w.str_("chaos: injected fault")
+    return w
+
+
+def _seed_hello():
+    w = FrameWriter()
+    w.i32(_LINK_HELLO_MAGIC)
+    w.i32(3)                     # rank
+    w.u8(1)                      # plane
+    w.i64(2)                     # epoch
+    w.i64(4096)                  # rx
+    return w
+
+
+def _seed_ack():
+    w = FrameWriter()
+    w.i32(_LINK_HELLO_MAGIC)
+    w.i64(3)                     # epoch
+    w.i64(8192)                  # rx
+    return w
+
+
+def _seed_codec(codec_id, nelems):
+    # stream = codec id byte + CompressedSize(nelems) payload bytes
+    # (codecs.cc: bf16 2n; int8/fp8 blocks of 4-byte scale + 256 lanes)
+    w = FrameWriter()
+    w.u8(codec_id)
+    if codec_id == 1:            # BF16
+        size = 2 * nelems
+    else:                        # INT8_BLOCK / FP8_BLOCK
+        full, rem = divmod(nelems, 256)
+        size = full * (4 + 256) + ((4 + rem) if rem else 0)
+    w.raw(bytes((i * 37 + codec_id) & 0xFF for i in range(size)))
+    return w
+
+
+def _seed_request_list():
+    w = FrameWriter()
+    w.i32(2, is_count=True)
+    _encode_request(w, rank=0, name="grad/p", dims=(32,))
+    _encode_request(w, rank=1, name="grad/q", dims=(2, 2),
+                    splits=(1, 3))
+    return w
+
+
+def _seed_response_list():
+    w = FrameWriter()
+    w.i32(1, is_count=True)
+    _encode_response(w, names=("grad/p",), numels=(32,))
+    return w
+
+
+def seeds(family):
+    """Grammar seeds per family: (kind, FrameWriter, expect) where
+    ``expect`` is the probe outcome of the UNMUTATED seed."""
+    if family == "announce":
+        return [("plain", _seed_announce_plain(), 0),
+                ("bitmask", _seed_announce_bitmask(), 0),
+                ("abort", _seed_abort(), 0)]
+    if family == "aggregate":
+        return [("plain", _seed_aggregate(), 0),
+                ("dup_rank", _seed_aggregate(dup_rank=True), 1)]
+    if family == "response_frame":
+        return [("full", _seed_response_frame_full(), 0),
+                ("positions", _seed_response_frame_positions(), 0),
+                ("abort", _seed_abort(), 0)]
+    if family == "hello":
+        return [("hello", _seed_hello(), 0)]
+    if family == "ack":
+        return [("ack", _seed_ack(), 0)]
+    if family == "codec_block":
+        return [("bf16", _seed_codec(1, 48), 0),
+                ("int8_full", _seed_codec(2, 512), 0),
+                ("int8_tail", _seed_codec(2, 300), 0),
+                ("fp8_tail", _seed_codec(3, 70), 0)]
+    if family == "request_list":
+        return [("list", _seed_request_list(), 0)]
+    if family == "response_list":
+        return [("list", _seed_response_list(), 0)]
+    raise ValueError(family)
+
+
+def structured_mutations(seed_writer):
+    """Grammar-derived mutants of one seed: (kind, bytes) pairs."""
+    base = bytes(seed_writer.buf)
+    out = []
+    # truncation at each recorded field boundary (and one byte past
+    # each, to land mid-field)
+    for b in seed_writer.bounds:
+        if b < len(base):
+            out.append(("truncate", base[:b]))
+        if b + 1 < len(base):
+            out.append(("truncate", base[:b + 1]))
+    # length/count-field inflation + off-by-one count overflow
+    for off in seed_writer.counts:
+        (orig,) = struct.unpack_from("<i", base, off)
+        for v in _INFLATE_VALUES + (orig + 1, orig + 1000):
+            if v == orig:
+                continue
+            out.append(("inflate",
+                        base[:off] + struct.pack("<i", v)
+                        + base[off + 4:]))
+    # flag flips on the leading byte
+    if base:
+        for bit in range(8):
+            out.append(("flagflip",
+                        bytes([base[0] ^ (1 << bit)]) + base[1:]))
+    return out
+
+
+def random_mutation(rng, base):
+    """One seeded random mutant: byte flips, a splice, or a resize."""
+    b = bytearray(base)
+    choice = rng.randrange(4)
+    if not b or choice == 0:
+        return bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(64)))
+    if choice == 1:              # flip 1..8 bytes
+        for _ in range(rng.randrange(1, 9)):
+            i = rng.randrange(len(b))
+            b[i] ^= 1 << rng.randrange(8)
+    elif choice == 2:            # splice a random chunk in place
+        i = rng.randrange(len(b))
+        n = rng.randrange(1, 17)
+        b[i:i + n] = bytes(rng.randrange(256) for _ in range(n))
+    else:                        # resize: chop or append garbage
+        if rng.randrange(2):
+            b = b[:rng.randrange(len(b) + 1)]
+        else:
+            b += bytes(rng.randrange(256)
+                       for _ in range(rng.randrange(1, 33)))
+    return bytes(b)
+
+
+def _probe(family_id, data):
+    rc = native.decode_probe(family_id, data)
+    if rc is None:
+        raise SystemExit("hvt_fuzz: libhvt_core.so (hvt_decode_probe) "
+                         "unavailable — build csrc first")
+    return rc
+
+
+def run_campaign(families, per_family, seed, corpus_out=None,
+                 verbose=False):
+    """Deterministic campaign: per family, every structured mutant of
+    every grammar seed, then seeded random mutants up to ``per_family``
+    total. Returns (total_run, failures) where a failure is any mutant
+    classified OTHER (2) — a containment escape."""
+    failures = []
+    corpus = []
+    total = 0
+    for fam in families:
+        fam_id = FAMILIES[fam]
+        rng = Random(f"{seed}:{fam}")
+        outcomes = {0: 0, 1: 0, 2: 0}
+        ran = 0
+        first_reject = {}
+        fam_seeds = seeds(fam)
+        for kind, w, expect in fam_seeds:
+            data = bytes(w.buf)
+            rc = _probe(fam_id, data)
+            outcomes[rc] = outcomes.get(rc, 0) + 1
+            ran += 1
+            if rc != expect:
+                failures.append((fam, "seed:" + kind, data,
+                                 f"expect {expect} got {rc}"))
+            corpus.append({"family": fam_id, "name": fam,
+                           "kind": "seed:" + kind, "expect": rc,
+                           "hex": data.hex()})
+            for mkind, mdata in structured_mutations(w):
+                rc = _probe(fam_id, mdata)
+                outcomes[rc] = outcomes.get(rc, 0) + 1
+                ran += 1
+                if rc == 2:
+                    failures.append((fam, mkind, mdata, "OTHER"))
+                if rc == 1 and (kind, mkind) not in first_reject:
+                    first_reject[(kind, mkind)] = mdata
+        bases = [bytes(w.buf) for _, w, _ in fam_seeds]
+        while ran < per_family:
+            mdata = random_mutation(rng, rng.choice(bases))
+            rc = _probe(fam_id, mdata)
+            outcomes[rc] = outcomes.get(rc, 0) + 1
+            ran += 1
+            if rc == 2:
+                failures.append((fam, "random", mdata, "OTHER"))
+            elif rc == 1 and ("*", "random") not in first_reject:
+                first_reject[("*", "random")] = mdata
+        for (skind, mkind), mdata in sorted(first_reject.items()):
+            corpus.append({"family": fam_id, "name": fam,
+                           "kind": f"{skind}:{mkind}", "expect": 1,
+                           "hex": mdata.hex()})
+        total += ran
+        if verbose:
+            print(f"  {fam}: {ran} mutants — ok={outcomes.get(0, 0)} "
+                  f"rejected={outcomes.get(1, 0)} "
+                  f"other={outcomes.get(2, 0)}")
+    if corpus_out:
+        with open(corpus_out, "w") as f:
+            for entry in corpus:
+                f.write(json.dumps(entry, sort_keys=True) + "\n")
+        if verbose:
+            print(f"  corpus: {len(corpus)} frames -> {corpus_out}")
+    return total, failures
+
+
+def replay_corpus(path, verbose=False):
+    """Replay a committed corpus: every frame must classify exactly as
+    recorded. Returns (total, mismatches)."""
+    mismatches = []
+    total = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            e = json.loads(line)
+            rc = _probe(int(e["family"]), bytes.fromhex(e["hex"]))
+            total += 1
+            if rc != int(e["expect"]):
+                mismatches.append((e, rc))
+    if verbose:
+        print(f"  replay: {total} frames, {len(mismatches)} mismatches")
+    return total, mismatches
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="hvt_fuzz",
+        description="deterministic structure-aware wire-grammar fuzzer")
+    ap.add_argument("--campaign", type=int, default=0, metavar="N",
+                    help="run N mutants per decoder family")
+    ap.add_argument("--seed", type=int, default=20,
+                    help="campaign PRNG seed (default 20)")
+    ap.add_argument("--families", nargs="*", default=sorted(FAMILIES),
+                    choices=sorted(FAMILIES), metavar="FAM",
+                    help="restrict to these families")
+    ap.add_argument("--write-corpus", metavar="PATH",
+                    help="write seeds + first-found rejections as JSONL")
+    ap.add_argument("--replay", metavar="PATH",
+                    help="replay a JSONL corpus and verify outcomes")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    verbose = not args.quiet
+    rc = 0
+    if args.replay:
+        total, mismatches = replay_corpus(args.replay, verbose=verbose)
+        for e, got in mismatches[:20]:
+            print(f"MISMATCH {e['name']}/{e['kind']}: expect "
+                  f"{e['expect']} got {got}", file=sys.stderr)
+        if mismatches:
+            rc = 1
+        elif verbose:
+            print(f"hvt_fuzz: corpus replay clean ({total} frames)")
+    if args.campaign > 0 or args.write_corpus:
+        total, failures = run_campaign(
+            args.families, max(args.campaign, 1), args.seed,
+            corpus_out=args.write_corpus, verbose=verbose)
+        for fam, kind, data, why in failures[:20]:
+            print(f"FAIL {fam}/{kind} ({why}): {data.hex()[:160]}",
+                  file=sys.stderr)
+        if failures:
+            rc = 1
+        elif verbose:
+            print(f"hvt_fuzz: campaign clean ({total} mutants, "
+                  f"seed {args.seed})")
+    if not args.replay and args.campaign <= 0 and not args.write_corpus:
+        ap.error("nothing to do: pass --campaign and/or --replay")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
+

@@ -9,7 +9,7 @@ in 3-4 places (``csrc/``, ``engine/native.py``, ``common/basics.py``,
 discipline kept them in sync (the reference pins the same class of
 contract with FlatBuffers codegen + a CI sanitizer matrix, SURVEY §5.2).
 
-Four passes, each dependency-free (stdlib ``re``/``ast`` text analysis —
+Six passes, each dependency-free (stdlib ``re``/``ast`` text analysis —
 no compiler, no imports of the checked modules):
 
 ``capi``
@@ -42,6 +42,22 @@ no compiler, no imports of the checked modules):
     ``WIRE_CODECS``) and the ``docs/performance.md`` codec table all
     in lockstep — a drifted id would make ranks disagree on transfer
     sizes, a drifted name would mislabel every per-codec metric.
+``proto``
+    the wire-protocol grammar, extracted statically from
+    ``csrc/wire.h`` / ``csrc/transport.h`` (see
+    docs/development.md §Protocol grammar): every ``EncodeX`` writes
+    the same field sequence its ``DecodeX`` reads; every list
+    allocation in a decoder is sized through the bounds-checked
+    ``Reader::count`` whose per-element minimum equals the
+    grammar-derived minimum encoded size of one element (re-derived
+    from the encoder body, so adding a field without updating the
+    bound fails lint); no second Reader/Writer definition and no
+    cursor-style ``memcpy(&v, …)`` frame reads outside wire.h's
+    ``Reader`` (the Reader2 fork this pass exists to prevent); flag
+    bytes tested only against the registry names, never hex literals;
+    and the Python-side decoders (``elastic/state.py`` shard frames,
+    the kvbulk envelopes between ``metrics/telemetry.py`` and
+    ``runner/http_server.py``) matching their documented framing.
 
 Run ``python -m horovod_tpu.tools.hvt_lint`` (all passes), optionally
 naming a subset, ``--root`` for an alternate tree (the fixture tests
@@ -69,6 +85,10 @@ ENGINE_H = "horovod_tpu/csrc/engine.h"
 ENGINE_CC = "horovod_tpu/csrc/engine.cc"
 EVENTS_H = "horovod_tpu/csrc/events.h"
 WIRE_H = "horovod_tpu/csrc/wire.h"
+TRANSPORT_H = "horovod_tpu/csrc/transport.h"
+STATE_PY = "horovod_tpu/elastic/state.py"
+TELEMETRY_PY = "horovod_tpu/metrics/telemetry.py"
+HTTP_SERVER_PY = "horovod_tpu/runner/http_server.py"
 STATS_SLOTS_H = "horovod_tpu/csrc/stats_slots.h"
 NATIVE_PY = "horovod_tpu/engine/native.py"
 BASICS_PY = "horovod_tpu/common/basics.py"
@@ -812,6 +832,400 @@ def check_codecs(root: Path):
 
 
 # ---------------------------------------------------------------------------
+# pass 6: wire-protocol grammar (hvt_proto)
+# ---------------------------------------------------------------------------
+# Extracts the frame grammar from the Encode*/Decode* bodies in wire.h
+# (docs/development.md §Protocol grammar) and checks, without compiling
+# anything:
+#   * encoder↔decoder field symmetry per pair,
+#   * count()-routed allocations with a per-element minimum that equals
+#     the minimum encoded element size RE-DERIVED from the encoder,
+#   * the Reader containment boundary (no Reader/Writer forks, no
+#     cursor-style memcpy reads outside wire.h's Reader),
+#   * flag-byte tests only against the registry names, and
+#   * the Python-side framing contracts (state shards, kvbulk).
+
+# bytes contributed by one writer/reader primitive when the frame is
+# minimal (every variable-length field empty): str/i64vec cost their
+# 4-byte length prefix
+_WIRE_TOK_BYTES = {"u8": 1, "i32": 4, "i64": 8, "f64": 8,
+                   "str": 4, "i64vec": 4}
+
+_PROTO_FN_RE = re.compile(
+    r'\binline\s+[^;{}()]*?\b((?:Encode|Decode)\w+)\s*\(')
+_ENC_TOK_RE = re.compile(
+    r'\bw\.(u8|i32|i64|f64|str|i64vec)\s*\('
+    r'|\bEncode(\w+)\s*\(\s*w\s*,')
+_DEC_TOK_RE = re.compile(
+    r'\brd\.(u8|i32|i64|f64|str|i64vec|count)\s*\('
+    r'|\bDecode(\w+)\s*\(\s*rd\b')
+_COUNT_ASSIGN_RE = re.compile(
+    r'(\w+)\s*=\s*rd\.count\(([^()]*(?:\([^()]*\)[^()]*)*)\)')
+_RESIZE_RE = re.compile(r'[\w\].]+\.resize\(\s*([^()]+?)\s*\)')
+_VEC_ALLOC_RE = re.compile(r'\bstd::vector<[^<>]*(?:<[^<>]*>)?[^<>]*>\s+'
+                           r'(\w+)\s*\(\s*(\w+)\s*\)')
+_READER_FORK_RE = re.compile(r'\b(?:struct|class)\s+((?:Reader|Writer)\w*)'
+                             r'\s*(?::[^{;]*)?\{')
+_FLAG_LITERAL_RE = re.compile(
+    r'\b(?:first|flags|resp_flags|frame\[0\]|f\[0\])\s*[&|]\s*'
+    r'(?:~\s*)?(0x[0-9A-Fa-f]+|\d+)\b')
+_PROTO_CONST_RE = re.compile(
+    r'constexpr\s+(?:size_t|int|int32_t|int64_t|uint8_t)\s+(\w+)\s*=\s*'
+    r'(0x[0-9A-Fa-f]+|\d+)')
+
+
+def _strip_c_comments(text: str) -> str:
+    text = re.sub(r'//[^\n]*', '', text)
+    return re.sub(r'/\*.*?\*/', '', text, flags=re.S)
+
+
+def _balanced_span(text: str, start: int, open_ch='{', close_ch='}'):
+    """(inner, end_index) of the balanced open/close group whose opener
+    is at/after ``start``; (None, start) when there is none."""
+    i = text.find(open_ch, start)
+    if i < 0:
+        return None, start
+    depth = 0
+    for j in range(i, len(text)):
+        if text[j] == open_ch:
+            depth += 1
+        elif text[j] == close_ch:
+            depth -= 1
+            if depth == 0:
+                return text[i + 1:j], j
+    return None, start
+
+
+def _proto_fn_bodies(text: str):
+    """``{name: body}`` of the inline Encode*/Decode* free functions
+    (comment-stripped, brace-matched)."""
+    text = _strip_c_comments(text)
+    out = {}
+    for m in _PROTO_FN_RE.finditer(text):
+        params, end = _balanced_span(text, m.end() - 1, '(', ')')
+        if params is None:
+            continue
+        body, _ = _balanced_span(text, end)
+        if body is not None:
+            out[m.group(1)] = body
+    return out
+
+
+def _strip_loops(body: str) -> str:
+    """Remove every ``for(...)`` loop (header + body) — what remains is
+    the straight-line, executed-exactly-once part of the function."""
+    out = []
+    i = 0
+    while True:
+        m = re.search(r'\bfor\s*\(', body[i:])
+        if not m:
+            out.append(body[i:])
+            return ''.join(out)
+        out.append(body[i:i + m.start()])
+        _, hdr_end = _balanced_span(body, i + m.end() - 1, '(', ')')
+        j = hdr_end + 1
+        while j < len(body) and body[j] in ' \t\n':
+            j += 1
+        if j < len(body) and body[j] == '{':
+            _, blk_end = _balanced_span(body, j)
+            i = blk_end + 1
+        else:
+            k = body.find(';', j)
+            i = (k + 1) if k >= 0 else len(body)
+
+
+def _call_tokens(body: str, call_re):
+    """Ordered (kind, arg) primitive tokens of an encoder/decoder body.
+    Nested ``EncodeX(w, …)`` / ``DecodeX(rd)`` becomes ``("call", "X")``;
+    writes to side buffers (``EncodeX(kw, …)``) are not frame fields and
+    do not appear. Args are captured with balanced parens (casts)."""
+    toks = []
+    for m in call_re.finditer(body):
+        if m.group(1):
+            arg, _ = _balanced_span(body, m.end() - 1, '(', ')')
+            toks.append((m.group(1), (arg or "").strip()))
+        else:
+            toks.append(("call", m.group(2)))
+    return toks
+
+
+def _enc_tokens(body: str):
+    return _call_tokens(body, _ENC_TOK_RE)
+
+
+def _dec_tokens(body: str):
+    """``count`` reads an i32 length field on the wire."""
+    return _call_tokens(body, _DEC_TOK_RE)
+
+
+def _min_encoded_sizes(bodies: dict):
+    """Grammar-derived minimum encoded size per struct: the byte cost
+    of the loop-stripped ``Encode<Name>`` body (variable-length fields
+    contribute their length prefix; nested encodes recurse)."""
+    enc = {name[len("Encode"):]: _enc_tokens(_strip_loops(body))
+           for name, body in bodies.items() if name.startswith("Encode")}
+    mins = {}
+
+    def size_of(name, stack=()):
+        if name in mins:
+            return mins[name]
+        if name not in enc or name in stack:
+            return None
+        total = 0
+        for kind, arg in enc[name]:
+            if kind == "call":
+                sub = size_of(arg, stack + (name,))
+                if sub is None:
+                    return None
+                total += sub
+            else:
+                total += _WIRE_TOK_BYTES[kind]
+        mins[name] = total
+        return total
+
+    for name in enc:
+        size_of(name)
+    return mins
+
+
+def _eval_const_expr(expr: str, consts: dict):
+    """Integer value of a count() bound: a literal, a constexpr name,
+    or a ``name + literal`` sum. None when it cannot be resolved."""
+    total = 0
+    for term in expr.split('+'):
+        term = term.strip()
+        if not term:
+            return None
+        if re.fullmatch(r'\d+', term):
+            total += int(term)
+        elif re.fullmatch(r'0x[0-9A-Fa-f]+', term):
+            total += int(term, 16)
+        elif term in consts:
+            total += consts[term]
+        else:
+            return None
+    return total
+
+
+def _loop_elem_bytes(body: str, after: int, var: str, containers: set,
+                     mins: dict):
+    """Minimum encoded bytes of one element of the loop that consumes
+    ``var`` (or iterates a container sized by it): the token cost of
+    the first matching ``for`` body after position ``after``. None when
+    no such loop exists or a nested decode is unknown."""
+    for m in re.finditer(r'\bfor\s*\(', body[after:]):
+        start = after + m.start()
+        hdr, hdr_end = _balanced_span(body, after + m.end() - 1, '(', ')')
+        if hdr is None:
+            return None
+        names = set(re.findall(r'[A-Za-z_][A-Za-z0-9_.]*', hdr))
+        if var not in names and not (containers & names):
+            continue
+        j = hdr_end + 1
+        while j < len(body) and body[j] in ' \t\n':
+            j += 1
+        if j < len(body) and body[j] == '{':
+            loop_body, _ = _balanced_span(body, j)
+        else:
+            loop_body = body[j:body.find(';', j) + 1]
+        total = 0
+        for kind, arg in _dec_tokens(loop_body or ""):
+            if kind == "call":
+                if mins.get(arg) is None:
+                    return None
+                total += mins[arg]
+            elif kind == "count":
+                total += 4
+            else:
+                total += _WIRE_TOK_BYTES[kind]
+        return total if total > 0 else None
+    return None
+
+
+def check_proto(root: Path):
+    vios = []
+    wire = _read(root, WIRE_H, vios, "proto")
+    if wire is None:
+        return vios
+    bodies = _proto_fn_bodies(wire)
+    consts = {n: int(v, 0)
+              for n, v in _PROTO_CONST_RE.findall(_strip_c_comments(wire))}
+    mins = _min_encoded_sizes(bodies)
+
+    # rule 1: encoder↔decoder field symmetry. A leading flag-registry
+    # u8 the decoder never reads is the dispatch byte (the engine
+    # consumes it to pick the decoder — DecodeAggregateFrame's
+    # contract) and is allowed.
+    for name, body in sorted(bodies.items()):
+        if not name.startswith("Encode"):
+            continue
+        struct = name[len("Encode"):]
+        dec_body = bodies.get("Decode" + struct)
+        if dec_body is None:
+            continue
+        enc = [("i32" if k == "count" else k, a)
+               for k, a in _enc_tokens(body)]
+        dec = [("i32" if k == "count" else k, a)
+               for k, a in _dec_tokens(dec_body)]
+        enc_kinds = [k for k, _ in enc]
+        dec_kinds = [k for k, _ in dec]
+        if enc_kinds != dec_kinds:
+            if (enc and enc[0][0] == "u8"
+                    and re.match(r'k\w*Flag', enc[0][1] or "")
+                    and enc_kinds[1:] == dec_kinds):
+                continue
+            vios.append(
+                f"proto: {WIRE_H}: Encode{struct} writes "
+                f"[{', '.join(enc_kinds)}] but Decode{struct} reads "
+                f"[{', '.join(dec_kinds)}] — encoder/decoder field "
+                f"symmetry broken (a peer running this build would "
+                f"mis-frame the stream)")
+
+    # rule 2: every decoder-side list allocation is sized through
+    # Reader::count, and each count() bound equals the grammar-derived
+    # minimum encoded size of one element of the loop it feeds.
+    for name, body in sorted(bodies.items()):
+        if not name.startswith("Decode"):
+            continue
+        counts = list(_COUNT_ASSIGN_RE.finditer(body))
+        safe = {m.group(1) for m in counts}
+        sized = {}  # count var -> containers it sizes
+        for m in _RESIZE_RE.finditer(body):
+            expr = m.group(1).strip()
+            if expr not in safe:
+                vios.append(
+                    f"proto: {WIRE_H}: {name} resizes from '{expr}', "
+                    f"which is not routed through Reader::count — a "
+                    f"corrupt length would size an allocation before "
+                    f"any bounds check")
+        # container name left of `.resize(var)` — range-for loops over
+        # it consume the counted elements
+        for m in re.finditer(r'([\w.]+)\.resize\(\s*(\w+)\s*\)', body):
+            sized.setdefault(m.group(2), set()).add(
+                m.group(1).split('.')[-1])
+        for m in _VEC_ALLOC_RE.finditer(body):
+            if m.group(2) not in safe:
+                vios.append(
+                    f"proto: {WIRE_H}: {name} constructs "
+                    f"'{m.group(1)}' sized by '{m.group(2)}', which is "
+                    f"not routed through Reader::count — a corrupt "
+                    f"length would size an allocation before any "
+                    f"bounds check")
+            else:
+                sized.setdefault(m.group(2), set()).add(m.group(1))
+        for m in counts:
+            var, bound_expr = m.group(1), m.group(2).strip()
+            declared = _eval_const_expr(bound_expr, consts)
+            if declared is None:
+                vios.append(
+                    f"proto: {WIRE_H}: {name} uses rd.count"
+                    f"({bound_expr}) — bound not resolvable to an "
+                    f"integer (use a literal or a wire.h constexpr)")
+                continue
+            derived = _loop_elem_bytes(body, m.end(), var,
+                                       sized.get(var, set()), mins)
+            if derived is not None and derived != declared:
+                vios.append(
+                    f"proto: {WIRE_H}: {name} bounds rd.count"
+                    f"({bound_expr}) = {declared}, but the element "
+                    f"grammar it decodes occupies at least {derived} "
+                    f"bytes — update the bound (a too-small bound "
+                    f"over-allows attacker-sized allocations; too "
+                    f"large rejects valid frames)")
+
+    # rule 3: the Reader containment boundary. wire.h may memcpy /
+    # reinterpret_cast only inside its Writer/Reader class bodies; no
+    # other csrc file may define a Reader/Writer (the transport.h
+    # Reader2 fork) or read frames with cursor-style memcpy.
+    wire_nc = _strip_c_comments(wire)
+    spans = []
+    for m in re.finditer(r'\bclass\s+(?:Reader|Writer)\b', wire_nc):
+        body, end = _balanced_span(wire_nc, m.end())
+        if body is not None:
+            spans.append((m.start(), end))
+    outside = list(wire_nc)
+    for a, b in spans:
+        outside[a:b + 1] = ' ' * (b + 1 - a)
+    outside = ''.join(outside)
+    for pat, what in ((r'\bmemcpy\s*\(', "memcpy"),
+                      (r'\breinterpret_cast\s*<', "reinterpret_cast")):
+        if re.search(pat, outside):
+            vios.append(
+                f"proto: {WIRE_H}: {what} outside the Writer/Reader "
+                f"class bodies — all frame-buffer byte access must go "
+                f"through the bounds-checked Reader")
+    csrc = root / CSRC_DIR
+    if csrc.is_dir():
+        for p in sorted(csrc.iterdir()):
+            if p.suffix not in (".h", ".cc") or p.name == "wire.h":
+                continue
+            text = _strip_c_comments(p.read_text())
+            for m in _READER_FORK_RE.finditer(text):
+                vios.append(
+                    f"proto: {CSRC_DIR}/{p.name}: defines "
+                    f"'{m.group(1)}' — frame readers/writers live in "
+                    f"wire.h ONLY (a fork re-opens the unbounded-read "
+                    f"class Reader::count closed)")
+            if p.name == "transport.h" and re.search(r'memcpy\s*\(\s*&',
+                                                     text):
+                vios.append(
+                    f"proto: {CSRC_DIR}/{p.name}: cursor-style "
+                    f"memcpy(&…) frame read — session frames must be "
+                    f"parsed with the wire.h Reader")
+
+    # rule 4: flag bytes are tested against registry names, never
+    # numeric literals (a literal can silently collide with a
+    # registry bit — including the abort bit).
+    for rel in (WIRE_H, TRANSPORT_H, ENGINE_CC, ENGINE_H):
+        p = root / rel
+        if not p.is_file():
+            continue
+        for m in _FLAG_LITERAL_RE.finditer(_strip_c_comments(
+                p.read_text())):
+            vios.append(
+                f"proto: {rel}: flag byte tested against literal "
+                f"{m.group(1)} — use the wire.h registry constant "
+                f"(kCtrlFlag*/kRespFlag*/kAbortFrameFlag)")
+
+    # rule 5: Python-side decoders match their documented framing.
+    state_p = root / STATE_PY
+    if state_p.is_file():
+        state = state_p.read_text()
+        decode = re.search(r'\ndef decode_shard\b.*?(?=\ndef |\Z)',
+                           state, re.S)
+        if "_SHARD_HEADER" not in state or decode is None:
+            vios.append(
+                f"proto: {STATE_PY}: shard framing must be the single "
+                f"_SHARD_HEADER Struct shared by encode_shard and "
+                f"decode_shard")
+        else:
+            for needle, why in (
+                    ("_SHARD_HEADER", "parse the shared header Struct"),
+                    ("_SHARD_MAGIC", "check the magic"),
+                    ("crc32", "verify the payload CRC"),
+                    ("ShardCorruptError", "raise the typed rejection")):
+                if needle not in decode.group(0):
+                    vios.append(
+                        f"proto: {STATE_PY}: decode_shard does not "
+                        f"{why} ({needle}) — the shard frame would "
+                        f"decode without its documented validation")
+    telem_p, http_p = root / TELEMETRY_PY, root / HTTP_SERVER_PY
+    if telem_p.is_file() and http_p.is_file():
+        telem, http = telem_p.read_text(), http_p.read_text()
+        for key in ("scope", "key", "value_b64"):
+            missing = [rel for rel, text in ((TELEMETRY_PY, telem),
+                                             (HTTP_SERVER_PY, http))
+                       if f'"{key}"' not in text]
+            for rel in missing:
+                vios.append(
+                    f"proto: {rel}: kvbulk envelope key \"{key}\" "
+                    f"missing — producer (telemetry) and consumer "
+                    f"(http_server) must agree on the envelope "
+                    f"framing")
+    return vios
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -821,6 +1235,7 @@ PASSES = {
     "events": check_events,
     "env": check_env,
     "codecs": check_codecs,
+    "proto": check_proto,
 }
 
 

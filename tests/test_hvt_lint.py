@@ -557,6 +557,228 @@ def test_codec_enum_registry_mismatch_fails(tmp_path):
     assert any("enum" in v and "registry" in v for v in vios), vios
 
 
+# ---------------------------------------------------- proto pass
+
+
+def make_proto_tree(root: Path):
+    """Mini tree for the wire-grammar pass: one symmetric Encode/Decode
+    pair (2 fixed fields + a counted str list, min element 4 bytes), a
+    count()-routed list decoder, a clean transport.h, and the Python
+    framing files."""
+    make_clean_tree(root)
+    _write(root, hvt_lint.WIRE_H, """\
+        constexpr uint8_t kCtrlFlagShutdown = 0x01;
+        constexpr uint8_t kAbortFrameFlag = 0x80;
+        constexpr size_t kMinEncodedPingBytes = 16;
+
+        class Writer {
+         public:
+          void append(const void* p, size_t n) { memcpy(0, p, n); }
+        };
+        class Reader {
+         public:
+          int32_t i32() { int32_t v; memcpy(&v, 0, 4); return v; }
+        };
+
+        inline void EncodePing(Writer& w, const Ping& p) {
+          w.i32(p.rank);
+          w.i64(p.epoch);
+          w.i32(static_cast<int32_t>(p.tags.size()));
+          for (auto& t : p.tags) w.str(t);
+        }
+
+        inline Ping DecodePing(Reader& rd) {
+          Ping p;
+          p.rank = rd.i32();
+          p.epoch = rd.i64();
+          size_t n = rd.count(4);
+          p.tags.resize(n);
+          for (auto& t : p.tags) t = rd.str();
+          return p;
+        }
+
+        inline void EncodePingList(Writer& w, const std::vector<Ping>& ps) {
+          w.i32(static_cast<int32_t>(ps.size()));
+          for (auto& p : ps) EncodePing(w, p);
+        }
+
+        inline std::vector<Ping> DecodePingList(Reader& rd) {
+          size_t n = rd.count(kMinEncodedPingBytes);
+          std::vector<Ping> ps(n);
+          for (auto& p : ps) p = DecodePing(rd);
+          return ps;
+        }
+        """)
+    _write(root, hvt_lint.TRANSPORT_H, """\
+        #include "wire.h"
+        inline bool ReadHello(Reader& rd) { return rd.i32() == 7; }
+        """)
+    _write(root, hvt_lint.STATE_PY, """\
+        import struct
+        from zlib import crc32
+
+        _SHARD_MAGIC = b"HVTS"
+        _SHARD_HEADER = struct.Struct("<4sqiIq")
+
+
+        class ShardCorruptError(RuntimeError):
+            pass
+
+
+        def encode_shard(payload):
+            return _SHARD_HEADER.pack(_SHARD_MAGIC, 1, 0,
+                                      crc32(payload), len(payload)) + payload
+
+
+        def decode_shard(blob):
+            magic, _v, _o, crc, n = _SHARD_HEADER.unpack_from(blob)
+            if magic != _SHARD_MAGIC:
+                raise ShardCorruptError("bad magic")
+            payload = blob[_SHARD_HEADER.size:_SHARD_HEADER.size + n]
+            if crc32(payload) != crc:
+                raise ShardCorruptError("bad crc")
+            return payload
+        """)
+    _write(root, hvt_lint.TELEMETRY_PY, """\
+        def envelope(scope, key, blob):
+            return {"scope": scope, "key": key, "value_b64": blob}
+        """)
+    _write(root, hvt_lint.HTTP_SERVER_PY, """\
+        def handle_kvbulk(envs):
+            return [(e["scope"], e["key"], e["value_b64"]) for e in envs]
+        """)
+
+
+def test_proto_fixture_tree_is_clean(tmp_path):
+    make_proto_tree(tmp_path)
+    assert hvt_lint.check_proto(tmp_path) == []
+    assert hvt_lint.run(tmp_path) == [], hvt_lint.run(tmp_path)
+
+
+def test_proto_field_symmetry_drift_fails(tmp_path):
+    # encoder grows a field the decoder never reads
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.WIRE_H).read_text()
+    _write(tmp_path, hvt_lint.WIRE_H,
+           text.replace("w.i64(p.epoch);",
+                        "w.i64(p.epoch);\n  w.u8(p.plane);"))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("field symmetry broken" in v and "EncodePing " in v
+               for v in vios), vios
+
+
+def test_proto_raw_count_resize_fails(tmp_path):
+    # the DecodeResponse bug this pass was built to catch: a list
+    # allocation sized straight from rd.i32()
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.WIRE_H).read_text()
+    _write(tmp_path, hvt_lint.WIRE_H,
+           text.replace("size_t n = rd.count(4);\n  p.tags.resize(n);",
+                        "int32_t n = rd.i32();\n  p.tags.resize(n);"))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("not routed through Reader::count" in v
+               for v in vios), vios
+
+
+def test_proto_stale_count_bound_fails(tmp_path):
+    # a field lands in the encoder; the paired count() bound is stale
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.WIRE_H).read_text()
+    _write(tmp_path, hvt_lint.WIRE_H,
+           text.replace("w.i64(p.epoch);", "w.i64(p.epoch);\n  w.i64(p.t);")
+               .replace("p.epoch = rd.i64();",
+                        "p.epoch = rd.i64();\n  p.t = rd.i64();"))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("occupies at least 24 bytes" in v for v in vios), vios
+
+
+def test_proto_unresolvable_count_bound_fails(tmp_path):
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.WIRE_H).read_text()
+    _write(tmp_path, hvt_lint.WIRE_H,
+           text.replace("rd.count(kMinEncodedPingBytes)",
+                        "rd.count(sizeof(Ping))"))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("not resolvable" in v for v in vios), vios
+
+
+def test_proto_reader_fork_fails(tmp_path):
+    # the transport.h Reader2 this PR folded away must never come back
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.TRANSPORT_H).read_text()
+    _write(tmp_path, hvt_lint.TRANSPORT_H, text + textwrap.dedent("""\
+        struct Reader2 {
+          size_t pos = 0;
+          int32_t i32(const std::vector<uint8_t>& b) {
+            int32_t v;
+            memcpy(&v, b.data() + pos, 4);
+            pos += 4;
+            return v;
+          }
+        };
+        """))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("Reader2" in v and "wire.h ONLY" in v for v in vios), vios
+    assert any("cursor-style" in v for v in vios), vios
+
+
+def test_proto_memcpy_outside_reader_fails(tmp_path):
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.WIRE_H).read_text()
+    _write(tmp_path, hvt_lint.WIRE_H, text + textwrap.dedent("""\
+        inline int DecodePeek(const std::vector<uint8_t>& f) {
+          int32_t v;
+          memcpy(&v, f.data() + 1, 4);
+          return v;
+        }
+        """))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("outside the Writer/Reader" in v for v in vios), vios
+
+
+def test_proto_flag_literal_fails(tmp_path):
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.ENGINE_CC).read_text()
+    _write(tmp_path, hvt_lint.ENGINE_CC, text + textwrap.dedent("""\
+        bool is_special(uint8_t first) { return first & 0x40; }
+        """))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("literal 0x40" in v and "registry" in v for v in vios), vios
+
+
+def test_proto_shard_decode_validation_fails(tmp_path):
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.STATE_PY).read_text()
+    _write(tmp_path, hvt_lint.STATE_PY,
+           text.replace("    if crc32(payload) != crc:\n"
+                        "        raise ShardCorruptError(\"bad crc\")\n",
+                        ""))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any("verify the payload CRC" in v for v in vios), vios
+
+
+def test_proto_kvbulk_key_drift_fails(tmp_path):
+    # producer renames an envelope key the consumer still expects
+    make_proto_tree(tmp_path)
+    text = (tmp_path / hvt_lint.TELEMETRY_PY).read_text()
+    _write(tmp_path, hvt_lint.TELEMETRY_PY,
+           text.replace('"value_b64"', '"payload_b64"'))
+    vios = hvt_lint.check_proto(tmp_path)
+    assert any('"value_b64"' in v and "telemetry" in v for v in vios), vios
+
+
+def test_proto_real_wire_minimums_match_grammar():
+    """The pinned constants in the REAL wire.h equal what the pass
+    derives from the real encoder bodies — the self-checking contract
+    (add a Request field → this and the proto pass both fail until
+    kMinEncodedRequestBytes moves)."""
+    text = (REPO_ROOT / hvt_lint.WIRE_H).read_text()
+    bodies = hvt_lint._proto_fn_bodies(text)
+    mins = hvt_lint._min_encoded_sizes(bodies)
+    assert mins["Request"] == 51
+    assert mins["Response"] == 58
+
+
 # ---------------------------------------------------- the real tree
 
 def test_real_tree_passes_every_lint_pass():

@@ -1,0 +1,103 @@
+"""Tier-1 wire-grammar regression tests (hvt_proto).
+
+Replays the committed frame corpus (``tests/corpus/proto_frames.jsonl``
+— grammar seeds plus the first fuzzer-found rejection per mutation
+class) through ``hvt_decode_probe`` and runs a small deterministic
+campaign per decoder family. The full ≥10k-per-family campaign runs in
+the ``ci.sh --fuzz`` lane and, via ``tests/test_sanitizers.py``, under
+ASan/UBSan builds; this file is the quick always-on slice.
+"""
+
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from horovod_tpu.engine import native
+from horovod_tpu.tools import hvt_fuzz
+
+REPO_ROOT = Path(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CORPUS = REPO_ROOT / "tests" / "corpus" / "proto_frames.jsonl"
+
+pytestmark = pytest.mark.skipif(
+    native.decode_probe(0, b"") is None,
+    reason="libhvt_core.so not built (make -C horovod_tpu/csrc)")
+
+
+def test_corpus_replays_exactly():
+    """Every committed frame classifies exactly as recorded — a drifted
+    outcome means the wire grammar changed without regenerating the
+    corpus (hvt_fuzz --write-corpus) and bumping the protocol notes."""
+    total, mismatches = hvt_fuzz.replay_corpus(str(CORPUS))
+    assert total >= 40  # seeds + at least one rejection per family
+    assert mismatches == [], mismatches[:10]
+
+
+def test_corpus_covers_every_family_both_ways():
+    families = {}
+    with open(CORPUS) as f:
+        for line in f:
+            e = json.loads(line)
+            families.setdefault(e["name"], set()).add(e["expect"])
+    assert set(families) == set(hvt_fuzz.FAMILIES)
+    for fam, outcomes in families.items():
+        # at least one accepted seed and one typed rejection per family
+        # (the dup_rank aggregate seed is itself the rejection seed)
+        assert 1 in outcomes, fam
+    accepted = {fam for fam, o in families.items() if 0 in o}
+    assert accepted == set(hvt_fuzz.FAMILIES)
+
+
+def test_quick_campaign_has_no_containment_escapes():
+    """300 grammar-derived mutants per family: every outcome must be
+    ok (0) or typed rejection (1) — outcome 2 is an exception class
+    escaping the TruncatedFrameError containment path."""
+    total, failures = hvt_fuzz.run_campaign(
+        sorted(hvt_fuzz.FAMILIES), 300, seed=20, verbose=False)
+    assert total >= 300 * len(hvt_fuzz.FAMILIES)
+    assert failures == [], failures[:5]
+
+
+def test_campaign_is_deterministic():
+    """Same seed → byte-identical mutant stream (what makes the CI
+    campaign and the sanitizer replays reproducible)."""
+    def stream(seed):
+        out = []
+        for fam in sorted(hvt_fuzz.FAMILIES):
+            rng = hvt_fuzz.Random(f"{seed}:{fam}")
+            bases = [bytes(w.buf) for _, w, _ in hvt_fuzz.seeds(fam)]
+            for _, w, _ in hvt_fuzz.seeds(fam):
+                out.extend(m for _, m in hvt_fuzz.structured_mutations(w))
+            out.extend(hvt_fuzz.random_mutation(rng, rng.choice(bases))
+                       for _ in range(50))
+        return out
+
+    assert stream(20) == stream(20)
+    assert stream(20) != stream(21)
+
+
+def test_known_malformed_frames_reject_typed():
+    """Hand-written malformations per ISSUE 20's mutation classes land
+    on the typed-rejection path (probe outcome 1, never 2)."""
+    import struct
+
+    magic = struct.pack("<i", 0x4856524C)
+    cases = [
+        # truncation at a field boundary
+        (3, magic + struct.pack("<i", 1)),
+        # length-field inflation: announce hits vector claims 2^31-1
+        (0, bytes([0]) + struct.pack("<i", 0x7FFFFFFF)),
+        # count overflow: response list one past remaining/min
+        (7, struct.pack("<i", 1)),
+        # duplicate roster ranks (PR 8 rejection, via the fuzzer seed)
+        (1, bytes(hvt_fuzz._seed_aggregate(dup_rank=True).buf)),
+        # codec block with impossible stream size
+        (5, bytes([2]) + b"\x00" * 3),
+        # negative i64vec length inside a request list
+        (6, struct.pack("<i", 1) + struct.pack("<i", 0)
+            + bytes([0, 0]) + struct.pack("<i", -5)),
+    ]
+    for family, frame in cases:
+        assert native.decode_probe(family, frame) == 1, (family,
+                                                        frame.hex())

@@ -12,6 +12,10 @@ fatal.
 - UBSan: undefined behavior in the wire codec / reduce kernels
   (misaligned loads, overflow, bad enum casts) aborts the job via
   halt_on_error.
+- ASan/UBSan fuzz replay: the committed wire-frame corpus
+  (tests/corpus/proto_frames.jsonl) plus a deterministic mini-campaign
+  runs through hvt_decode_probe under each instrumented build, so a
+  decoder bounds bug the grammar fuzzer can reach fails here too.
 """
 
 import os
@@ -35,6 +39,12 @@ def _gcc_lib(name):
 
 TSAN_LIB = _gcc_lib("libtsan.so")
 UBSAN_LIB = _gcc_lib("libubsan.so")
+ASAN_LIB = _gcc_lib("libasan.so")
+# co-preloaded with libasan for the fuzz replay: python itself is not
+# linked against libstdc++, so without it in the initial library list
+# ASan's __cxa_throw interceptor finds no real symbol and aborts on the
+# first TruncatedFrameError ("real___cxa_throw != 0" CHECK)
+STDCXX_LIB = _gcc_lib("libstdc++.so.6")
 
 
 def _gcc_major():
@@ -79,6 +89,15 @@ WORKER = textwrap.dedent("""
 """).format(repo=REPO)
 
 
+def _build_sanitized(target):
+    rc = subprocess.run(["make", "-C",
+                         os.path.join(REPO, "horovod_tpu", "csrc"),
+                         target], capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    return os.path.join(REPO, "horovod_tpu", "csrc",
+                        f"build-{target}", "libhvt_core.so")
+
+
 def _run_sanitized_gang(tmp_path, target, preload, extra_env):
     """Build `make -C csrc <target>` and drive the 2-proc multi-threaded
     gang against it; returns (proc, report_files).
@@ -88,17 +107,13 @@ def _run_sanitized_gang(tmp_path, target, preload, extra_env):
     the launcher: libtsan's fork interceptors deadlock the launcher's
     multi-threaded spawn path, wedging the whole gang before any worker
     runs — and the launcher is not what the test instruments anyway."""
-    rc = subprocess.run(["make", "-C",
-                         os.path.join(REPO, "horovod_tpu", "csrc"),
-                         target], capture_output=True, text=True)
-    assert rc.returncode == 0, rc.stderr[-2000:]
+    core = _build_sanitized(target)
     worker = tmp_path / "w.py"
     worker.write_text(WORKER)
     env = dict(os.environ)
     env.update({
         "PYTHONPATH": REPO,
-        "HVT_CORE_LIB": os.path.join(REPO, "horovod_tpu", "csrc",
-                                     f"build-{target}", "libhvt_core.so"),
+        "HVT_CORE_LIB": core,
         "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
         "XLA_FLAGS": "",
     })
@@ -131,6 +146,60 @@ def test_engine_threading_clean_under_tsan(tmp_path):
         f"rc={proc.returncode} reports={reports}\n{proc.stdout[-2000:]}"
         f"\n{proc.stderr[-2000:]}")
     assert proc.stdout.count("SANITIZER OK") == 2, proc.stdout[-1000:]
+
+
+def _run_sanitized_fuzz(tmp_path, target, preload, extra_env):
+    """Build `make -C csrc <target>` and replay the committed wire-frame
+    corpus — plus a small deterministic grammar-derived campaign — with
+    the sanitizer runtime preloaded into hvt_fuzz's decode process.
+    Single-process (no gang): every frame goes straight into the decoder
+    families via hvt_decode_probe, which is exactly the surface the
+    fuzzer exercises."""
+    core = _build_sanitized(target)
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": REPO, "HVT_CORE_LIB": core,
+                "LD_PRELOAD": preload})
+    env.update(extra_env)
+    corpus = os.path.join(REPO, "tests", "corpus", "proto_frames.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.tools.hvt_fuzz",
+         "--replay", corpus, "--campaign", "500", "--seed", "20", "-q"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    reports = [f for f in os.listdir(tmp_path)
+               if f.startswith("sanitizer_report")]
+    return proc, reports
+
+
+@pytest.mark.slow  # cold `make asan` is a multi-minute build; the
+#                    UBSan twin below shares its build with the engine
+#                    gang and stays in the tier-1 window
+@pytest.mark.skipif(not ASAN_LIB or not STDCXX_LIB,
+                    reason="libasan/libstdc++ not available")
+@pytest.mark.timeout(600)
+def test_fuzz_corpus_clean_under_asan(tmp_path):
+    report = str(tmp_path / "sanitizer_report")
+    # detect_leaks off: CPython itself leaks by LSan's definition; the
+    # target here is heap overflow/UAF in the decoders, not leaks
+    proc, reports = _run_sanitized_fuzz(
+        tmp_path, "asan", f"{ASAN_LIB} {STDCXX_LIB}",
+        {"ASAN_OPTIONS": f"detect_leaks=0:halt_on_error=1:"
+                         f"log_path={report}"})
+    assert proc.returncode == 0 and not reports, (
+        f"rc={proc.returncode} reports={reports}\n{proc.stdout[-2000:]}"
+        f"\n{proc.stderr[-2000:]}")
+
+
+@pytest.mark.skipif(not UBSAN_LIB, reason="libubsan not available")
+@pytest.mark.timeout(600)
+def test_fuzz_corpus_clean_under_ubsan(tmp_path):
+    report = str(tmp_path / "sanitizer_report")
+    proc, reports = _run_sanitized_fuzz(
+        tmp_path, "ubsan", UBSAN_LIB,
+        {"UBSAN_OPTIONS": f"halt_on_error=1 print_stacktrace=1 "
+                          f"log_path={report}"})
+    assert proc.returncode == 0 and not reports, (
+        f"rc={proc.returncode} reports={reports}\n{proc.stdout[-2000:]}"
+        f"\n{proc.stderr[-2000:]}")
 
 
 @pytest.mark.skipif(not UBSAN_LIB, reason="libubsan not available")
